@@ -1,0 +1,133 @@
+"""Typed configuration for the framework.
+
+The reference scatters configuration across argparse defaults and inline
+literals (and some flags are silently ignored — reference
+``model_parallel.py:89-97`` re-hard-codes batch size 512 / 12 workers over the
+``-b``/``-j`` flags; see SURVEY.md §1 "Notable coupling"). Here every knob
+lives in one dataclass tree with no hidden hard-coding; entry scripts parse CLI
+overrides into these dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axis sizes of 1 disable an axis.
+
+    Replaces the reference's ``--world-size`` + ``mp.spawn`` + NCCL process
+    group (``model_parallel.py:19-24,57,162``): on TPU the "backend choice" is
+    mesh/axis configuration, not a transport plugin (SURVEY.md §2.4).
+    """
+
+    data: int = 1          # data-parallel axis ("dp")
+    stage: int = 1         # pipeline-stage axis ("pp")
+    model: int = 1         # tensor-parallel axis ("tp")
+    seq: int = 1           # sequence/context-parallel axis ("sp")
+    expert: int = 1        # expert-parallel axis ("ep"), reserved
+
+    # Axis names as they appear in PartitionSpecs / collectives.
+    data_axis: str = "data"
+    stage_axis: str = "stage"
+    model_axis: str = "model"
+    seq_axis: str = "seq"
+    expert_axis: str = "expert"
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.stage * self.model * self.seq * self.expert
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            self.data_axis: self.data,
+            self.stage_axis: self.stage,
+            self.model_axis: self.model,
+            self.seq_axis: self.seq,
+            self.expert_axis: self.expert,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """SGD + cosine annealing + linear warmup.
+
+    Mirrors the reference's recipe: ``SGD(lr, momentum=0.9, weight_decay=1e-4)``
+    + ``CosineAnnealingLR(T_max=90)`` + ``UntunedLinearWarmup`` over ~10 epochs
+    (reference ``data_parallel.py:89-96``, ``model_parallel.py:105-108``).
+    """
+
+    name: str = "sgd"
+    learning_rate: float = 0.4
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    nesterov: bool = False
+    cosine_decay_steps: int | None = None   # if None: derived from epochs
+    warmup_steps: int = 0
+    grad_clip_norm: float | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model selection + model-family specific knobs."""
+
+    name: str = "mobilenetv2"               # registry key
+    num_classes: int = 10
+    # BatchNorm behavior: "local" = per-replica stats (nn.DataParallel / plain
+    # DDP semantics), "sync" = cross-replica stats (SyncBatchNorm), "none" =
+    # the no-BN variant (reference model/mobilenetv2.py:84-148).
+    batchnorm: str = "local"
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    dtype: str = "float32"                  # compute dtype ("bfloat16" on TPU)
+    param_dtype: str = "float32"
+    extra: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset + loader settings.
+
+    The reference's transforms: random crop 32 pad 4, horizontal flip,
+    normalize with CIFAR-10 stats (``data_parallel.py:31-40``); loaders bs 512
+    train / 1000 test (``data_parallel.py:44-51``).
+    """
+
+    name: str = "cifar10"                   # registry key
+    root: str = "./data"
+    batch_size: int = 512
+    eval_batch_size: int = 1000
+    image_size: int = 32
+    num_workers: int = 2
+    shuffle: bool = True
+    augment: bool = True
+    seed: int = 0
+    synthetic_ok: bool = True               # fall back to synthetic data offline
+    synthetic_train_size: int = 2048
+    synthetic_eval_size: int = 512
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Top-level run configuration."""
+
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    epochs: int = 100                       # reference data_parallel.py:160
+    seed: int = 0
+    log_dir: str = "./log"
+    log_name: str = "train"
+    checkpoint_dir: str = "./checkpoint"
+    resume: bool = False                    # reference data_parallel.py:21-22,80-87
+    log_every_n_steps: int = 30             # reference data_parallel.py:116
+    # Pipeline-specific knobs (used when mesh.stage > 1).
+    num_microbatches: int = 1               # 1 == reference's naive schedule
+    stage_boundaries: Sequence[int] | None = None  # unit indices; None = balanced
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

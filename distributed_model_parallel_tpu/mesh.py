@@ -1,0 +1,145 @@
+"""Device discovery and mesh construction.
+
+Replaces the reference's process bootstrap — ``mp.spawn`` +
+``dist.init_process_group('nccl', 'tcp://127.0.0.1:1224')`` +
+``torch.cuda.set_device(rank)`` (reference ``model_parallel.py:57-62,162``) —
+with the TPU-native runtime: ``jax.distributed.initialize`` for multi-host
+rendezvous and a ``jax.sharding.Mesh`` with named axes for everything else.
+All parallelism in this framework is expressed as PartitionSpecs over these
+axes; XLA inserts the collectives (psum/ppermute/all_gather) over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.config import MeshConfig
+
+logger = logging.getLogger(__name__)
+
+
+def best_effort_distributed_init() -> bool:
+    """Initialize the multi-host JAX runtime if the environment asks for it.
+
+    The reference requires explicit ``--dist-url``/``--world-size`` flags and a
+    TCP rendezvous even on one node (``model_parallel.py:19-24,57``). On TPU,
+    single-host needs nothing, and multi-host pods are auto-detected by
+    ``jax.distributed.initialize()`` from the cluster environment. Returns True
+    if a multi-process runtime was initialized.
+    """
+    if jax.process_count() > 1:
+        return True  # already initialized
+    want = os.environ.get("DMP_TPU_DISTRIBUTED", "auto")
+    if want == "0":
+        return False
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if want == "1" or coordinator:
+        try:
+            jax.distributed.initialize()
+            return True
+        except Exception as e:  # pragma: no cover - environment dependent
+            logger.warning("jax.distributed.initialize failed: %s", e)
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A constructed mesh plus canonical PartitionSpecs.
+
+    Axis order is (data, stage, model, seq, expert) with size-1 axes kept in
+    the mesh (they cost nothing and keep PartitionSpecs uniform).
+    """
+
+    mesh: Mesh
+    config: MeshConfig
+
+    # -- canonical axis names ------------------------------------------------
+    @property
+    def data_axis(self) -> str:
+        return self.config.data_axis
+
+    @property
+    def stage_axis(self) -> str:
+        return self.config.stage_axis
+
+    @property
+    def model_axis(self) -> str:
+        return self.config.model_axis
+
+    @property
+    def seq_axis(self) -> str:
+        return self.config.seq_axis
+
+    @property
+    def expert_axis(self) -> str:
+        return self.config.expert_axis
+
+    # -- canonical shardings -------------------------------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharded(self) -> NamedSharding:
+        """Batch-dim sharding: the TPU equivalent of DataParallel's ``scatter``
+        (reference ``Readme.md:20,28-29``)."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def num_data(self) -> int:
+        return self.config.data
+
+    @property
+    def num_stages(self) -> int:
+        return self.config.stage
+
+    def stage_devices(self) -> list[jax.Device]:
+        """One representative device per pipeline stage (data index 0).
+
+        Used by the per-stage pipeline runtime (parallel/pipeline.py) for
+        computation-follows-data placement.
+        """
+        devs = np.asarray(self.mesh.devices)
+        axes = list(self.mesh.axis_names)
+        idx = [slice(None) if a == self.stage_axis else 0 for a in axes]
+        return list(np.atleast_1d(devs[tuple(idx)]).ravel())
+
+
+def make_mesh(config: MeshConfig | None = None,
+              devices: Sequence[jax.Device] | None = None) -> MeshSpec:
+    """Build a named mesh from a MeshConfig.
+
+    If ``config`` is None, all local devices go on the data axis — mirroring
+    the reference's default of one DP replica per visible GPU
+    (``data_parallel.py:77``, ``model_parallel.py:20``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(data=len(devices))
+    n = config.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices ({config.axis_sizes()}), "
+            f"only {len(devices)} available")
+    shape = (config.data, config.stage, config.model, config.seq, config.expert)
+    names = (config.data_axis, config.stage_axis, config.model_axis,
+             config.seq_axis, config.expert_axis)
+    grid = np.asarray(devices[:n]).reshape(shape)
+    return MeshSpec(mesh=Mesh(grid, names), config=config)
+
+
+def local_batch_slice(global_batch: int, spec: MeshSpec) -> int:
+    """Per-data-shard batch size; errors on uneven split (static shapes)."""
+    d = spec.num_data
+    if global_batch % d:
+        raise ValueError(f"global batch {global_batch} not divisible by data={d}")
+    return global_batch // d

@@ -1,0 +1,52 @@
+"""Test scaffolding: run everything on 8 virtual CPU devices.
+
+The reference has zero tests (SURVEY.md §4). Our strategy: exercise real mesh
+collectives (psum, ppermute, all_gather) on fake CPU devices via
+``--xla_force_host_platform_device_count``, so multi-chip semantics are tested
+without hardware. This block must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests never touch the real TPU tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment may have imported jax at interpreter startup (sitecustomize)
+# with JAX_PLATFORMS=axon already baked into the config; override it before any
+# backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+import pytest  # noqa: E402
+
+from distributed_model_parallel_tpu.config import MeshConfig  # noqa: E402
+from distributed_model_parallel_tpu.mesh import make_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    """8-way data-parallel mesh."""
+    return make_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh4x2(devices):
+    """4-way data x 2-way stage mesh."""
+    return make_mesh(MeshConfig(data=4, stage=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_stage4(devices):
+    """4-stage pipeline mesh (matches the reference's 4-GPU pipeline,
+    model_parallel.py:99-157)."""
+    return make_mesh(MeshConfig(data=1, stage=4))
