@@ -1,0 +1,62 @@
+"""Model zoo registry.
+
+Mirrors the capability of the reference's model package
+(``model/__init__.py``, ``model/mobilenetv2.py``) plus the models promoted to
+scope by BASELINE.json (ResNet-18/50) and the Transformer LM flagship used for
+multi-axis mesh parallelism and long-context.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.config import ModelConfig
+from distributed_model_parallel_tpu.models.staged import (  # noqa: F401
+    StagedModel,
+    balanced_boundaries,
+    merge_tree,
+    partition_tree,
+    stage_slices,
+)
+from distributed_model_parallel_tpu.models.mobilenetv2 import build_mobilenetv2
+from distributed_model_parallel_tpu.models.resnet import build_resnet
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+def _cnn_kwargs(config: ModelConfig, axis_name: str | None):
+    bn_mode = config.batchnorm
+    if bn_mode == "sync" and axis_name is None:
+        raise ValueError("sync BatchNorm requires an axis_name")
+    return dict(
+        num_classes=config.num_classes,
+        bn_mode=bn_mode,
+        bn_momentum=config.bn_momentum,
+        bn_epsilon=config.bn_epsilon,
+        dtype=_DTYPES[config.dtype],
+        axis_name=axis_name,
+    )
+
+
+def get_model(config: ModelConfig, *, axis_name: str | None = None) -> StagedModel:
+    """Build a StagedModel from a ModelConfig.
+
+    ``axis_name`` is the mesh axis for cross-replica BatchNorm statistics;
+    only consulted when ``config.batchnorm == "sync"``.
+    """
+    name = config.name
+    if name in ("mobilenetv2", "mobilenetv2_nobn"):
+        kw = _cnn_kwargs(config, axis_name)
+        if name.endswith("_nobn"):
+            kw["bn_mode"] = "none"
+        return build_mobilenetv2(**kw)
+    if name in ("resnet18", "resnet34", "resnet50"):
+        return build_resnet(name, **_cnn_kwargs(config, axis_name))
+    if name == "transformer":
+        from distributed_model_parallel_tpu.models.transformer import build_transformer
+        return build_transformer(config)
+    if name == "embedding_bow":
+        from distributed_model_parallel_tpu.models.embedding import build_embedding_bow
+        return build_embedding_bow(config)
+    raise KeyError(f"unknown model {name!r}; known: mobilenetv2[_nobn], "
+                   f"resnet18/34/50, transformer, embedding_bow")

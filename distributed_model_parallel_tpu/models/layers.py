@@ -1,0 +1,108 @@
+"""Shared flax building blocks for the CNN model zoo.
+
+NHWC layout throughout (TPU-native; XLA tiles NHWC convs onto the MXU
+directly). BatchNorm supports three modes, selected by ``bn_mode``:
+
+* ``"local"`` — per-shard batch statistics. Under ``shard_map`` this gives the
+  semantics of per-replica BN in ``nn.DataParallel`` / plain DDP (each replica
+  normalizes with its own shard's stats).
+* ``"sync"``  — cross-replica statistics via ``axis_name`` psum: the
+  SyncBatchNorm capability (BASELINE.json config 3; reference ``Readme.md:157``
+  discusses the DDP sync-BN prep pass).
+* ``"none"``  — no normalization: the reference's ``MobileNetV2_nobn``
+  large-batch study variant (``model/mobilenetv2.py:84-148``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+def _norm(bn_mode: str, *, momentum: float, epsilon: float, dtype,
+          axis_name: str | None, name: str):
+    """Norm factory. Returns a callable (x, train) -> x."""
+    if bn_mode == "none":
+        return lambda x, train: x
+    bn = nn.BatchNorm(
+        use_running_average=None,  # passed at call time
+        momentum=momentum,
+        epsilon=epsilon,
+        dtype=dtype,
+        axis_name=axis_name if bn_mode == "sync" else None,
+        name=name,
+    )
+    return lambda x, train: bn(x, use_running_average=not train)
+
+
+class ConvUnit(nn.Module):
+    """Conv → (BN) → (activation), one or more times.
+
+    ``ops`` is a sequence of dicts with keys: features, kernel, stride,
+    groups, act (bool). A ``feature_group_count == features`` conv is a
+    depthwise conv (MXU-friendly form of the reference's ``groups=planes``
+    depthwise, ``model/mobilenetv2.py:19``).
+    """
+
+    ops: Sequence[dict]
+    bn_mode: str = "local"
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+    axis_name: str | None = None
+    activation: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        for i, op in enumerate(self.ops):
+            x = nn.Conv(
+                features=op["features"],
+                kernel_size=(op.get("kernel", 3),) * 2,
+                strides=(op.get("stride", 1),) * 2,
+                padding=op.get("padding", "SAME"),
+                feature_group_count=op.get("groups", 1),
+                use_bias=self.bn_mode == "none",
+                dtype=self.dtype,
+                name=f"conv{i}",
+            )(x)
+            x = _norm(self.bn_mode, momentum=self.bn_momentum,
+                      epsilon=self.bn_epsilon, dtype=self.dtype,
+                      axis_name=self.axis_name, name=f"bn{i}")(x, train)
+            if op.get("act", True):
+                x = self.activation(x)
+        return x
+
+
+class ClassifierHead(nn.Module):
+    """(Conv 1x1 expand) → ReLU → global/window avg-pool → flatten → Dense.
+
+    The reference's tail: ``conv2(1x1,1280)+bn2`` then ``Reshape1`` =
+    relu → avg_pool(4) → flatten, then ``linear`` (``model/mobilenetv2.py:
+    60-61,74-76,150-158``; pipeline use ``model_parallel.py:143-144``).
+    """
+
+    num_classes: int
+    conv_features: int | None = None     # e.g. 1280 for MobileNetV2; None=skip
+    pool: str = "avg"                    # "avg" = global average pool
+    bn_mode: str = "local"
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        if self.conv_features is not None:
+            x = nn.Conv(self.conv_features, (1, 1), use_bias=self.bn_mode == "none",
+                        dtype=self.dtype, name="conv")(x)
+            x = _norm(self.bn_mode, momentum=self.bn_momentum,
+                      epsilon=self.bn_epsilon, dtype=self.dtype,
+                      axis_name=self.axis_name, name="bn")(x, train)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))     # global average pool → (N, C)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="linear")(x)
+        return x

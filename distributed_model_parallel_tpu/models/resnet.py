@@ -1,0 +1,91 @@
+"""ResNet-18/34/50, CIFAR-adapted, as staged unit sequences.
+
+The reference's DP driver lists ResNet in its (commented-out) model menu
+(``data_parallel.py:58-73``) and BASELINE.json promotes ResNet-18 (config 1)
+and ResNet-50 (configs 2-3, the north-star throughput metric) to in-scope.
+CIFAR adaptation follows the same convention as the reference's MobileNetV2
+(stride-1 3x3 stem, no max-pool; ``model/mobilenetv2.py:42,51``).
+
+Units: stem, then one unit per residual block (8 for R18, 16 for R50),
+then head — so pipeline partitioning is uniform with MobileNetV2.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models.layers import ClassifierHead, ConvUnit, _norm
+from distributed_model_parallel_tpu.models.staged import StagedModel
+
+# name -> (block kind, blocks per group)
+ARCH = {
+    "resnet18": ("basic", (2, 2, 2, 2)),
+    "resnet34": ("basic", (3, 4, 6, 3)),
+    "resnet50": ("bottleneck", (3, 4, 6, 3)),
+}
+GROUP_FEATURES = (64, 128, 256, 512)
+
+
+class ResBlock(nn.Module):
+    """Basic (3x3,3x3) or bottleneck (1x1,3x3,1x1 x4) residual block."""
+
+    kind: str                # "basic" | "bottleneck"
+    features: int            # base width of the group
+    stride: int
+    bn_mode: str = "local"
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        use_bias = self.bn_mode == "none"
+        out_features = self.features * (4 if self.kind == "bottleneck" else 1)
+
+        def norm(name):
+            return _norm(self.bn_mode, momentum=self.bn_momentum,
+                         epsilon=self.bn_epsilon, dtype=self.dtype,
+                         axis_name=self.axis_name, name=name)
+
+        y = x
+        if self.kind == "basic":
+            specs = [(self.features, 3, self.stride), (self.features, 3, 1)]
+        else:
+            specs = [(self.features, 1, 1), (self.features, 3, self.stride),
+                     (out_features, 1, 1)]
+        for i, (f, k, s) in enumerate(specs):
+            y = nn.Conv(f, (k, k), strides=(s, s), padding="SAME",
+                        use_bias=use_bias, dtype=self.dtype, name=f"conv{i}")(y)
+            y = norm(f"bn{i}")(y, train)
+            if i < len(specs) - 1:
+                y = nn.relu(y)
+
+        if self.stride != 1 or x.shape[-1] != out_features:
+            x = nn.Conv(out_features, (1, 1), strides=(self.stride,) * 2,
+                        use_bias=use_bias, dtype=self.dtype, name="shortcut")(x)
+            x = norm("shortcut_bn")(x, train)
+        return nn.relu(y + x)
+
+
+def build_resnet(arch: str = "resnet18", num_classes: int = 10, *,
+                 bn_mode: str = "local", bn_momentum: float = 0.9,
+                 bn_epsilon: float = 1e-5, dtype: Any = jnp.float32,
+                 axis_name: str | None = None) -> StagedModel:
+    kind, groups = ARCH[arch]
+    common = dict(bn_mode=bn_mode, bn_momentum=bn_momentum,
+                  bn_epsilon=bn_epsilon, dtype=dtype, axis_name=axis_name)
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 64, "kernel": 3, "stride": 1},), **common)
+    ]
+    for g, num_blocks in enumerate(groups):
+        for b in range(num_blocks):
+            units.append(ResBlock(
+                kind=kind, features=GROUP_FEATURES[g],
+                stride=(2 if g > 0 and b == 0 else 1), **common))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **common))
+    return StagedModel(units=tuple(units), name=arch)

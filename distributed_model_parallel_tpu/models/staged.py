@@ -1,0 +1,138 @@
+"""Stage-able model representation.
+
+The reference hard-codes its pipeline split per-rank in the entry script
+(``model_parallel.py:102-144``: rank 0 = conv1+bn1+layers[0:3], middle ranks =
+``layers[6*rank-3 : 6*rank+3]``, last = layers[15:]+conv2+bn2+Reshape1+linear),
+which only works because its MobileNetV2 is a flat ``nn.Sequential``
+(``model/mobilenetv2.py:62-68``). Here the same idea is first-class data: every
+model is an ordered tuple of *units* (flax modules), and a stage partition is
+just a list of unit-index boundaries. Pipeline, data-parallel and single-device
+execution all consume the same representation.
+
+Parameters are a tuple of per-unit variable dicts — a plain pytree, so optax,
+jit, shardings and checkpointing all work unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+# Per-unit variables: {"params": {...}, "batch_stats": {...}} (batch_stats may
+# be absent for norm-free units).
+UnitVars = dict[str, Any]
+Params = tuple[Any, ...]        # tuple over units of params subtrees
+State = tuple[Any, ...]         # tuple over units of batch_stats subtrees ({} if none)
+
+
+@dataclasses.dataclass(frozen=True)
+class StagedModel:
+    """An ordered sequence of flax unit modules with functional apply.
+
+    ``units[i]`` must be callable as ``unit.apply(variables, x, train=...)``
+    and may carry ``batch_stats`` state (BatchNorm running averages).
+    """
+
+    units: tuple[nn.Module, ...]
+    name: str = "staged"
+
+    @property
+    def num_units(self) -> int:
+        return len(self.units)
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng: jax.Array, sample: jax.Array) -> tuple[Params, State]:
+        """Initialize all units by threading a sample batch through them."""
+        params, state = [], []
+        x = sample
+        for i, unit in enumerate(self.units):
+            rng, sub = jax.random.split(rng)
+            variables = unit.init(sub, x, train=False)
+            params.append(variables.get("params", {}))
+            state.append(variables.get("batch_stats", {}))
+            x = unit.apply(variables, x, train=False)
+        return tuple(params), tuple(state)
+
+    def output_shape(self, sample_shape: Sequence[int]) -> tuple[int, ...]:
+        """Shape of the final output for a given input shape (eval_shape)."""
+        def run(x):
+            p, s = self.init(jax.random.key(0), x)
+            y, _ = self.apply(p, s, x, train=False)
+            return y
+        return tuple(jax.eval_shape(run, jnp.zeros(sample_shape)).shape)
+
+    # -- apply --------------------------------------------------------------
+    def apply_unit(self, i: int, params_i, state_i, x, *, train: bool):
+        """Apply unit i. Returns (y, new_state_i)."""
+        variables = {"params": params_i}
+        has_state = bool(state_i)
+        if has_state:
+            variables["batch_stats"] = state_i
+        if train and has_state:
+            y, updated = self.units[i].apply(
+                variables, x, train=True, mutable=["batch_stats"])
+            return y, updated["batch_stats"]
+        y = self.units[i].apply(variables, x, train=train and not has_state)
+        return y, state_i
+
+    def apply_range(self, params: Params, state: State, x, lo: int, hi: int,
+                    *, train: bool):
+        """Apply units [lo, hi). Returns (y, new_state_slice)."""
+        new_state = list(state[lo:hi])
+        for i in range(lo, hi):
+            x, new_state[i - lo] = self.apply_unit(
+                i, params[i], state[i], x, train=train)
+        return x, tuple(new_state)
+
+    def apply(self, params: Params, state: State, x, *, train: bool):
+        """Full forward. Returns (logits, new_state)."""
+        return self.apply_range(params, state, x, 0, self.num_units, train=train)
+
+
+def balanced_boundaries(num_units: int, num_stages: int) -> list[int]:
+    """Split ``num_units`` units into ``num_stages`` contiguous stages.
+
+    Returns boundaries ``b`` of length num_stages+1 with b[0]=0,
+    b[-1]=num_units; stage s owns units [b[s], b[s+1]). Remainder units go to
+    the earliest stages (front-loaded, like the reference's split which gives
+    rank 0 the stem plus the first blocks, ``model_parallel.py:102-104``).
+    """
+    if not (1 <= num_stages <= num_units):
+        raise ValueError(f"cannot split {num_units} units into {num_stages} stages")
+    base, rem = divmod(num_units, num_stages)
+    bounds = [0]
+    for s in range(num_stages):
+        bounds.append(bounds[-1] + base + (1 if s < rem else 0))
+    return bounds
+
+
+def stage_slices(num_units: int, num_stages: int,
+                 boundaries: Sequence[int] | None = None) -> list[tuple[int, int]]:
+    """(lo, hi) unit ranges per stage, honoring explicit boundaries if given."""
+    if boundaries is None:
+        b = balanced_boundaries(num_units, num_stages)
+    else:
+        b = list(boundaries)
+        if b[0] != 0 or b[-1] != num_units or len(b) != num_stages + 1:
+            raise ValueError(
+                f"boundaries {b} invalid for {num_units} units / {num_stages} stages")
+        if any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+            raise ValueError(f"boundaries {b} must be strictly increasing")
+    return [(b[s], b[s + 1]) for s in range(num_stages)]
+
+
+def partition_tree(tree: tuple, slices: Sequence[tuple[int, int]]) -> list[tuple]:
+    """Split a per-unit tuple pytree into per-stage tuples."""
+    return [tuple(tree[lo:hi]) for lo, hi in slices]
+
+
+def merge_tree(parts: Sequence[tuple]) -> tuple:
+    """Inverse of partition_tree."""
+    out: list = []
+    for p in parts:
+        out.extend(p)
+    return tuple(out)
