@@ -1,0 +1,187 @@
+"""Dataset registry.
+
+Capability parity with the reference's ``DatasetCollection`` factory keyed on a
+string type — Imagenet / CUB200 / CIFAR10 / Place365
+(``dataset/dataset_collection.py:28-69``) — behind one interface that returns
+in-memory or lazily-decoded arrays in NHWC uint8. This environment has zero
+egress, so every dataset falls back to a deterministic synthetic stand-in of
+the right shape when the on-disk data is absent (``DataConfig.synthetic_ok``);
+real data is read when present:
+
+* ``cifar10``   — the standard ``cifar-10-batches-py`` pickle format.
+* ``imagenet`` / ``place365`` — ImageFolder layout (``root/train/<cls>/*.jpg``,
+  ``root/val/<cls>/*.jpg``), decoded with PIL (reference
+  ``dataset_collection.py:36-47,66-69``).
+* ``cub200``    — the CUB-200-2011 metadata files ``images.txt``,
+  ``image_class_labels.txt``, ``train_test_split.txt`` joined on image id
+  (reference ``dataset_collection.py:8-27,48-61``, which does the same join
+  with pandas).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Callable
+
+import numpy as np
+
+# Reference normalization stats (data_parallel.py:31-40 uses the standard
+# CIFAR-10 mean/std).
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+@dataclasses.dataclass
+class ArrayDataset:
+    """A materialized (or lazily-decoded) labeled image set, NHWC uint8."""
+
+    images: np.ndarray          # (N, H, W, C) uint8
+    labels: np.ndarray          # (N,) int32
+    num_classes: int
+    mean: np.ndarray = dataclasses.field(default_factory=lambda: CIFAR10_MEAN)
+    std: np.ndarray = dataclasses.field(default_factory=lambda: CIFAR10_STD)
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+
+def _synthetic(n: int, image_size: int, num_classes: int, seed: int,
+               mean=CIFAR10_MEAN, std=CIFAR10_STD) -> ArrayDataset:
+    """Deterministic class-conditional synthetic images (learnable signal, so
+    smoke-training shows decreasing loss rather than pure noise)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    base = rng.integers(0, 256, size=(num_classes, image_size, image_size, 3))
+    noise = rng.integers(-40, 41, size=(n, image_size, image_size, 3))
+    images = np.clip(base[labels] + noise, 0, 255).astype(np.uint8)
+    return ArrayDataset(images=images, labels=labels, num_classes=num_classes,
+                        mean=mean, std=std)
+
+
+def _load_cifar10(root: str) -> tuple[ArrayDataset, ArrayDataset] | None:
+    d = os.path.join(root, "cifar-10-batches-py")
+    if not os.path.isdir(d):
+        return None
+
+    def read(names):
+        xs, ys = [], []
+        for name in names:
+            with open(os.path.join(d, name), "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(batch[b"data"], np.uint8))
+            ys.append(np.asarray(batch[b"labels"], np.int32))
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return np.ascontiguousarray(x), np.concatenate(ys)
+
+    xtr, ytr = read([f"data_batch_{i}" for i in range(1, 6)])
+    xte, yte = read(["test_batch"])
+    mk = lambda x, y: ArrayDataset(x, y, 10, CIFAR10_MEAN, CIFAR10_STD)
+    return mk(xtr, ytr), mk(xte, yte)
+
+
+def _load_imagefolder(root: str, image_size: int,
+                      mean=IMAGENET_MEAN, std=IMAGENET_STD
+                      ) -> tuple[ArrayDataset, ArrayDataset] | None:
+    """ImageFolder layout: root/{train,val}/<class>/<img>. Eagerly decodes and
+    resizes with PIL (adequate for the subset-scale runs this environment can
+    hold in memory)."""
+    tr, va = os.path.join(root, "train"), os.path.join(root, "val")
+    if not (os.path.isdir(tr) and os.path.isdir(va)):
+        return None
+    from PIL import Image
+
+    def read(split_dir, class_to_idx=None):
+        classes = sorted(e.name for e in os.scandir(split_dir) if e.is_dir())
+        if class_to_idx is None:
+            class_to_idx = {c: i for i, c in enumerate(classes)}
+        xs, ys = [], []
+        for c in classes:
+            cdir = os.path.join(split_dir, c)
+            for e in sorted(os.scandir(cdir), key=lambda e: e.name):
+                if not e.is_file():
+                    continue
+                with Image.open(e.path) as im:
+                    im = im.convert("RGB").resize((image_size, image_size))
+                    xs.append(np.asarray(im, np.uint8))
+                ys.append(class_to_idx[c])
+        return (np.stack(xs), np.asarray(ys, np.int32), class_to_idx)
+
+    xtr, ytr, c2i = read(tr)
+    xte, yte, _ = read(va, c2i)
+    n = len(c2i)
+    return (ArrayDataset(xtr, ytr, n, mean, std),
+            ArrayDataset(xte, yte, n, mean, std))
+
+
+def _load_cub200(root: str, image_size: int
+                 ) -> tuple[ArrayDataset, ArrayDataset] | None:
+    """CUB-200-2011: join images.txt / image_class_labels.txt /
+    train_test_split.txt on image id (reference dataset_collection.py:48-61)."""
+    meta = {n: os.path.join(root, n) for n in
+            ("images.txt", "image_class_labels.txt", "train_test_split.txt")}
+    if not all(os.path.isfile(p) for p in meta.values()):
+        return None
+    from PIL import Image
+
+    def read_table(path):
+        out = {}
+        with open(path) as f:
+            for line in f:
+                k, v = line.split()
+                out[int(k)] = v
+        return out
+
+    paths = read_table(meta["images.txt"])
+    labels = {k: int(v) - 1 for k, v in read_table(meta["image_class_labels.txt"]).items()}
+    is_train = {k: v == "1" for k, v in read_table(meta["train_test_split.txt"]).items()}
+    splits = {True: ([], []), False: ([], [])}
+    for img_id, rel in sorted(paths.items()):
+        with Image.open(os.path.join(root, "images", rel)) as im:
+            arr = np.asarray(im.convert("RGB").resize((image_size, image_size)),
+                             np.uint8)
+        xs, ys = splits[is_train[img_id]]
+        xs.append(arr)
+        ys.append(labels[img_id])
+    n = max(labels.values()) + 1
+    mk = lambda xs, ys: ArrayDataset(np.stack(xs), np.asarray(ys, np.int32), n,
+                                     IMAGENET_MEAN, IMAGENET_STD)
+    return mk(*splits[True]), mk(*splits[False])
+
+
+_LOADERS: dict[str, Callable] = {
+    "cifar10": lambda cfg: _load_cifar10(cfg.root),
+    "imagenet": lambda cfg: _load_imagefolder(
+        os.path.join(cfg.root, "imagenet"), cfg.image_size),
+    "place365": lambda cfg: _load_imagefolder(
+        os.path.join(cfg.root, "place365"), cfg.image_size),
+    "cub200": lambda cfg: _load_cub200(
+        os.path.join(cfg.root, "CUB_200_2011"), cfg.image_size),
+}
+_NUM_CLASSES = {"cifar10": 10, "imagenet": 1000, "place365": 365, "cub200": 200}
+
+
+def load_dataset(cfg) -> tuple[ArrayDataset, ArrayDataset]:
+    """(train, eval) for ``cfg.name`` (a DataConfig); synthetic fallback."""
+    if cfg.name == "synthetic":
+        loaded = None
+        num_classes = 10
+    else:
+        if cfg.name not in _LOADERS:
+            raise KeyError(f"unknown dataset {cfg.name!r}; known: "
+                           f"{sorted(_LOADERS)} + synthetic")
+        loaded = _LOADERS[cfg.name](cfg)
+        num_classes = _NUM_CLASSES[cfg.name]
+    if loaded is not None:
+        return loaded
+    if not cfg.synthetic_ok and cfg.name != "synthetic":
+        raise FileNotFoundError(
+            f"dataset {cfg.name!r} not found under {cfg.root!r} and "
+            f"synthetic_ok=False")
+    return (_synthetic(cfg.synthetic_train_size, cfg.image_size, num_classes,
+                       cfg.seed),
+            _synthetic(cfg.synthetic_eval_size, cfg.image_size, num_classes,
+                       cfg.seed + 1))

@@ -52,6 +52,10 @@ def get_model(config: ModelConfig, *, axis_name: str | None = None) -> StagedMod
         return build_mobilenetv2(**kw)
     if name in ("resnet18", "resnet34", "resnet50"):
         return build_resnet(name, **_cnn_kwargs(config, axis_name))
+    if name == "tinycnn":
+        from distributed_model_parallel_tpu.models.tinycnn import build_tinycnn
+        return build_tinycnn(**_cnn_kwargs(config, axis_name),
+                             **dict(config.extra))
     if name == "transformer":
         from distributed_model_parallel_tpu.models.transformer import build_transformer
         return build_transformer(config)

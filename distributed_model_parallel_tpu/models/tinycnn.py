@@ -1,0 +1,36 @@
+"""A small staged CNN for fast tests and CPU smoke runs.
+
+Not part of the reference's zoo; exists so the test suite (SURVEY.md §4's
+invented-from-scratch strategy) can exercise every parallelism path in
+seconds on the 8-virtual-CPU-device mesh without paying MobileNetV2 compile
+times. Same staged-unit contract as the real models.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models.layers import ClassifierHead, ConvUnit
+from distributed_model_parallel_tpu.models.staged import StagedModel
+
+
+def build_tinycnn(num_classes: int = 10, *, bn_mode: str = "local",
+                  bn_momentum: float = 0.9, bn_epsilon: float = 1e-5,
+                  dtype: Any = jnp.float32,
+                  axis_name: str | None = None,
+                  width: int = 16, depth: int = 4) -> StagedModel:
+    """stem + ``depth`` conv units (stride 2 on the middle one) + head."""
+    common = dict(bn_mode=bn_mode, bn_momentum=bn_momentum,
+                  bn_epsilon=bn_epsilon, dtype=dtype, axis_name=axis_name)
+    units = [ConvUnit(ops=({"features": width, "kernel": 3, "stride": 1},),
+                      **common)]
+    for i in range(depth):
+        stride = 2 if i == depth // 2 else 1
+        units.append(ConvUnit(
+            ops=({"features": width, "kernel": 3, "stride": stride},),
+            **common))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **common))
+    return StagedModel(units=tuple(units), name="tinycnn")

@@ -1,0 +1,122 @@
+"""Collective/communication layer.
+
+TPU-native replacements for the native communication machinery the reference
+consumes (SURVEY.md §2.2/§2.4):
+
+* ``dist.send``/``dist.recv`` P2P with a 3-message dynamic-shape wire protocol
+  (reference ``distributed_layers.py:11-13,20-24,42-45,52,58-60``) →
+  ``ppermute_shift``: shapes are static under ``jit`` so the shape negotiation
+  disappears; a stage-to-stage transfer is one collective-permute over ICI.
+* the DDP ``Reducer``'s bucketed NCCL ring-allreduce fired from autograd hooks
+  (reference ``Readme.md:14,148-157``) → ``psum_mean`` (XLA schedules
+  overlap with the backward) and ``bucketed_psum`` (explicit flat-bucket
+  allreduce — fewer, larger collectives, the Reducer's actual trick).
+* ``comm.scatter``/``broadcast_coalesced``/``comm.gather`` used by
+  DataParallel (``Readme.md:20,28-30,49-56,109-143``) → sharding-based
+  ``scatter``/``replicate``/``gather`` in ``parallel/data_parallel.py``.
+
+All functions taking ``axis_name`` must be called inside ``shard_map`` (or
+another named-axis context) over that axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def psum_mean(tree: Any, axis_name: str) -> Any:
+    """Gradient averaging over the data axis — DDP's allreduce-mean."""
+    n = jax.lax.psum(1, axis_name)
+    return jax.tree.map(lambda g: jax.lax.psum(g, axis_name) / n, tree)
+
+
+def ppermute_shift(x: jax.Array, axis_name: str, *, shift: int = 1) -> jax.Array:
+    """Rotate values around a mesh axis ring: src i -> dst (i+shift) % n.
+
+    The TPU-native equivalent of the reference's rank-to-rank activation
+    send/recv (``distributed_layers.py:7-62``); on hardware this rides the ICI
+    ring neighbor links.
+    """
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_gather_concat(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
+    """Gather shards along ``axis`` (DataParallel's output ``gather``)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reduce_scatter_mean(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
+    """psum_scatter-mean: each shard gets one slice of the reduced result —
+    the building block of ZeRO-style sharded optimizers and of halving
+    allreduce traffic when parameters are sharded."""
+    n = jax.lax.axis_size(axis_name)
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True) / n
+
+
+# ----------------------------------------------------------------------------
+# Bucketed allreduce: the DDP Reducer capability (reference Readme.md:148-157).
+# ----------------------------------------------------------------------------
+
+def plan_buckets(tree: Any, bucket_bytes: int = 25 * 1024 * 1024
+                 ) -> list[list[int]]:
+    """Group flattened leaf indices into size-capped buckets, in reverse leaf
+    order (the Reducer fills buckets in (roughly) reverse parameter order so
+    early buckets become ready first during backward)."""
+    leaves = jax.tree.leaves(tree)
+    buckets: list[list[int]] = [[]]
+    used = 0
+    for idx in reversed(range(len(leaves))):
+        nbytes = leaves[idx].size * np.dtype(leaves[idx].dtype).itemsize
+        if buckets[-1] and used + nbytes > bucket_bytes:
+            buckets.append([])
+            used = 0
+        buckets[-1].append(idx)
+        used += nbytes
+    return buckets
+
+
+def bucketed_psum(tree: Any, axis_name: str, *,
+                  bucket_bytes: int = 25 * 1024 * 1024,
+                  mean: bool = True) -> Any:
+    """Allreduce a gradient pytree in flat coalesced buckets.
+
+    Each bucket is flattened+concatenated into one vector, reduced with a
+    single ``psum``, then split back — mirroring
+    ``_broadcast_coalesced``/Reducer bucketing (``Readme.md:49-56,148-157``)
+    with XLA free to overlap bucket collectives with compute.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    n = jax.lax.psum(1, axis_name) if mean else 1
+    out: list[Any] = [None] * len(leaves)
+    for bucket in plan_buckets(tree, bucket_bytes):
+        flat = jnp.concatenate(
+            [leaves[i].astype(jnp.float32).reshape(-1) for i in bucket])
+        red = jax.lax.psum(flat, axis_name)
+        if mean:
+            red = red / n
+        offset = 0
+        for i in bucket:
+            size = leaves[i].size
+            out[i] = red[offset:offset + size].reshape(
+                leaves[i].shape).astype(leaves[i].dtype)
+            offset += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def unused_param_mask(grads: Any) -> Any:
+    """Per-leaf boolean: True where a gradient is identically zero.
+
+    The capability analog of DDP's ``find_unused_parameters``
+    (``Readme.md:153-157``): JAX autodiff already produces zero gradients for
+    parameters not on the loss path (no hang to avoid — there are no autograd
+    hooks waiting), so "detection" reduces to reporting which leaves were
+    untouched, useful for debugging partially-frozen models.
+    """
+    return jax.tree.map(lambda g: jnp.all(g == 0), grads)

@@ -1,0 +1,58 @@
+"""Single-host DataParallel: scatter → replicate → parallel apply → gather.
+
+The reference wraps its model in ``nn.DataParallel`` (``data_parallel.py:77``)
+whose mechanism — batch ``scatter``, ``broadcast_coalesced`` parameter
+``replicate``, threaded ``parallel_apply``, output ``gather`` onto device 0 —
+it studies at length (``Readme.md:17-143``). On TPU the whole choreography is
+sharding metadata: scatter = batch-dim ``NamedSharding``, replicate =
+replicated sharding, parallel apply = the jitted SPMD program, gather = one
+``device_put``/unshard. These helpers expose the four phases *explicitly* so
+the CPU correctness-diffing path demanded by BASELINE.json config 1
+("single-process nn.DataParallel, CPU, 2 virtual devices") can compare a
+sharded apply against an unsharded one step by step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from distributed_model_parallel_tpu.mesh import MeshSpec
+
+
+def scatter(batch: Any, spec: MeshSpec) -> Any:
+    """Split arrays along dim 0 across the data axis (comm.scatter)."""
+    return jax.device_put(batch, spec.batch_sharded())
+
+
+def replicate(tree: Any, spec: MeshSpec) -> Any:
+    """Copy a pytree to every device (broadcast_coalesced; XLA coalesces)."""
+    return jax.device_put(tree, spec.replicated())
+
+
+def gather(x: jax.Array) -> np.ndarray:
+    """Materialize a (possibly sharded) array on the host (comm.gather;
+    the reference gathers onto device 0 — host is the TPU analog)."""
+    return jax.device_get(x)
+
+
+def parallel_apply(fn: Callable, spec: MeshSpec, *, static_argnames=()) -> Callable:
+    """Jit ``fn(params, batch)`` so replicated params + scattered batch run as
+    one SPMD program — the equivalent of one-thread-per-replica
+    ``parallel_apply`` (``Readme.md:70-107``) without threads or GIL games.
+    """
+    return jax.jit(
+        fn,
+        in_shardings=(spec.replicated(), spec.batch_sharded()),
+        static_argnames=static_argnames,
+    )
+
+
+def data_parallel_apply(fn: Callable, params: Any, batch: Any,
+                        spec: MeshSpec) -> np.ndarray:
+    """The full DataParallel.forward: scatter → replicate → apply → gather."""
+    p = replicate(params, spec)
+    b = scatter(batch, spec)
+    return gather(parallel_apply(fn, spec)(p, b))

@@ -1,0 +1,131 @@
+"""Explicit DDP: shard_map per-replica programs + gradient allreduce.
+
+The reference *analyzes* DistributedDataParallel — per-process replicas, a C++
+``Reducer`` doing bucketed ring-allreduce from autograd hooks, optional
+SyncBatchNorm, ``find_unused_parameters`` (``Readme.md:144-157``) — and
+BASELINE.json promotes it to in-scope (configs 2-5). This module is the
+TPU-native equivalent with *explicit* per-replica semantics, as opposed to the
+GSPMD path in ``train/trainer.py`` where XLA infers the allreduce:
+
+* each data shard runs its own forward/backward inside ``shard_map`` — a real
+  per-replica program, like one DDP rank;
+* BatchNorm statistics are **per-replica** (each replica carries its own
+  running stats, sharded over the data axis — faithful to DDP-without-SyncBN)
+  unless the model was built with ``bn_mode="sync"``, in which case the BN
+  layers psum their batch stats over the axis (SyncBatchNorm);
+* gradients are averaged with either a straight ``psum`` or the bucketed
+  coalesced allreduce (``ops/collectives.bucketed_psum``), selectable like
+  DDP's bucket_cap_mb;
+* parameters stay replicated and the optimizer step runs identically on every
+  replica (DDP's invariant).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributed_model_parallel_tpu.data.loader import augment_batch, normalize
+from distributed_model_parallel_tpu.mesh import MeshSpec
+from distributed_model_parallel_tpu.models.staged import StagedModel
+from distributed_model_parallel_tpu.ops.collectives import bucketed_psum, psum_mean
+from distributed_model_parallel_tpu.train.metrics import topk_correct
+from distributed_model_parallel_tpu.train.trainer import TrainState, cross_entropy
+
+
+def replicate_model_state(state: Any, num_replicas: int) -> Any:
+    """Give BN state a leading per-replica axis (to be sharded over 'data')."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_replicas,) + x.shape), state)
+
+
+def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
+                        spec: MeshSpec, *, mean, std, augment: bool = True,
+                        dtype=jnp.float32, bucket_bytes: int | None = None
+                        ) -> Callable:
+    """Returns jitted step(state, rng, images_u8, labels) -> (state, metrics).
+
+    ``state.model_state`` must carry a leading per-replica axis
+    (``replicate_model_state``). ``bucket_bytes=None`` uses per-leaf psum;
+    otherwise the coalesced bucketed allreduce.
+    """
+    axis = spec.data_axis
+
+    def loss_fn(params, model_state, images, labels):
+        logits, new_state = model.apply(params, model_state, images, train=True)
+        loss = cross_entropy(logits, labels)
+        return loss, (logits, new_state)
+
+    def replica_step(state: TrainState, rng, images_u8, labels):
+        # Per-replica program: local shard of the batch, own BN state.
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        local_state = jax.tree.map(lambda x: x[0], state.model_state)
+        images_u8 = augment_batch(rng, images_u8) if augment else images_u8
+        images = normalize(images_u8, mean, std, dtype)
+        (loss, (logits, new_local_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, local_state, images, labels)
+
+        # The Reducer equivalent: average gradients across replicas.
+        if bucket_bytes is None:
+            grads = psum_mean(grads, axis)
+        else:
+            grads = bucketed_psum(grads, axis, bucket_bytes=bucket_bytes)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # Metrics reduce over replicas; loss is the global-batch mean.
+        n = jax.lax.psum(1, axis)
+        metrics = {
+            "loss": jax.lax.psum(loss, axis) / n,
+            "batch": jax.lax.psum(jnp.asarray(labels.shape[0], jnp.float32), axis),
+            **{k: jax.lax.psum(v, axis)
+               for k, v in topk_correct(logits, labels).items()},
+        }
+        new_state = TrainState(
+            step=state.step + 1, params=new_params,
+            model_state=jax.tree.map(lambda x: x[None], new_local_state),
+            opt_state=new_opt_state)
+        return new_state, metrics
+
+    # Pytree-prefix specs: BN state is sharded per-replica on its leading
+    # axis; everything else is replicated.
+    state_specs = TrainState(step=P(), params=P(), model_state=P(axis),
+                             opt_state=P())
+
+    shard_fn = jax.shard_map(
+        replica_step, mesh=spec.mesh,
+        in_specs=(state_specs, P(), P(axis), P(axis)),
+        out_specs=(state_specs, P()),
+        check_vma=False)
+    return jax.jit(shard_fn, donate_argnums=(0,))
+
+
+def make_ddp_eval_step(model: StagedModel, spec: MeshSpec, *, mean, std,
+                       dtype=jnp.float32) -> Callable:
+    axis = spec.data_axis
+
+    def replica_eval(state: TrainState, images_u8, labels):
+        local_state = jax.tree.map(lambda x: x[0], state.model_state)
+        images = normalize(images_u8, mean, std, dtype)
+        logits, _ = model.apply(state.params, local_state, images, train=False)
+        n = jax.lax.psum(1, axis)
+        return {
+            "loss": jax.lax.psum(cross_entropy(logits, labels), axis) / n,
+            "batch": jax.lax.psum(jnp.asarray(labels.shape[0], jnp.float32), axis),
+            **{k: jax.lax.psum(v, axis)
+               for k, v in topk_correct(logits, labels).items()},
+        }
+
+    state_specs = TrainState(step=P(), params=P(), model_state=P(axis),
+                             opt_state=P())
+    shard_fn = jax.shard_map(
+        replica_eval, mesh=spec.mesh,
+        in_specs=(state_specs, P(axis), P(axis)), out_specs=P(),
+        check_vma=False)
+    return jax.jit(shard_fn)

@@ -1,0 +1,46 @@
+"""Run logging: reference-format text lines + structured JSONL.
+
+The reference appends one line per epoch to a text file —
+``step/loss_train/acc1_train/loss_val/acc1_val`` (+ per-batch timings in the
+pipeline driver) — ``data_parallel.py:167-171``, ``model_parallel.py:119-124``,
+and prints every 30 batches (``data_parallel.py:116-117``, ``utils.py:69-70``).
+We keep that text format for diffability and add a JSONL stream for tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+class RunLogger:
+    def __init__(self, log_dir: str, name: str, *, echo: bool = True):
+        os.makedirs(log_dir, exist_ok=True)
+        self.txt_path = os.path.join(log_dir, f"{name}.txt")
+        self.jsonl_path = os.path.join(log_dir, f"{name}.jsonl")
+        self.echo = echo
+
+    def log_epoch(self, epoch: int, **metrics: Any) -> None:
+        # Text line mirrors the reference's epoch record (data_parallel.py:167-171).
+        parts = [f"epoch:{epoch}"] + [
+            f"{k}:{v:.6g}" if isinstance(v, float) else f"{k}:{v}"
+            for k, v in metrics.items()
+        ]
+        line = " ".join(parts)
+        with open(self.txt_path, "a") as f:
+            f.write(line + "\n")
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps({"ts": time.time(), "epoch": epoch, **{
+                k: (float(v) if hasattr(v, "__float__") else v)
+                for k, v in metrics.items()}}) + "\n")
+        if self.echo:
+            print(line, flush=True)
+
+    def log_step(self, epoch: int, step: int, **metrics: Any) -> None:
+        if self.echo:
+            parts = [f"[{epoch}:{step}]"] + [
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in metrics.items()]
+            print(" ".join(parts), flush=True)

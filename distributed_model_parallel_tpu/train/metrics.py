@@ -1,0 +1,70 @@
+"""Metrics: top-k accuracy and running meters.
+
+Parity with the reference's ``accuracy(output, target, topk)``
+(``utils.py:215-229``) and its per-batch/data-load timing meters
+(``utils.py:41-74``). The accuracy math runs on-device inside the jitted step
+(no logits transfer to host); meters are host-side plain Python.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray,
+                 ks: tuple[int, ...] = (1, 5)) -> dict[str, jnp.ndarray]:
+    """Number of correct predictions at each k (summed over the batch).
+
+    Returns counts rather than percentages so values psum/accumulate cleanly
+    across shards and batches.
+    """
+    k_max = max(ks)
+    # top-k via sorted indices; k is static so this lowers to a single sort.
+    top = jnp.argsort(-logits, axis=-1)[..., :k_max]
+    hit = top == labels[..., None]
+    return {f"correct@{k}": jnp.sum(hit[..., :k]) for k in ks}
+
+
+class AverageMeter:
+    """Running average (reference keeps ad-hoc ``x_avg = x_avg + x`` sums,
+    ``utils.py:64-74`` — including the latent bug of accumulating live graph
+    tensors, ``utils.py:68,102``; here values must be plain floats)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, value: float, n: int = 1):
+        self.sum += float(value) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(1, self.count)
+
+
+class StepTimer:
+    """Separates data-loading time from step (compute) time per batch,
+    like the reference's ``time_load``/``time_batch`` meters
+    (``utils.py:41,48,64-67``)."""
+
+    def __init__(self):
+        self.data = AverageMeter("data_time")
+        self.step = AverageMeter("step_time")
+        self._mark = time.perf_counter()
+
+    def data_ready(self):
+        now = time.perf_counter()
+        self.data.update(now - self._mark)
+        self._mark = now
+
+    def step_done(self):
+        now = time.perf_counter()
+        self.step.update(now - self._mark)
+        self._mark = now
