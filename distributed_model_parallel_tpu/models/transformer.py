@@ -1,0 +1,181 @@
+"""Decoder-only Transformer LM — the multi-axis-parallelism flagship.
+
+The reference's zoo is CNN-only, so this model exists for the capabilities the
+framework must carry beyond it: tensor parallelism, single-program SPMD
+pipelining (homogeneous stacked blocks), and long-context sequence parallelism
+(ring attention / Ulysses). It is written as pure functions over an explicit
+parameter pytree — not linen — because every parallel path wants direct
+control of array layout:
+
+* ``params["blocks"]`` holds all L blocks *stacked* on a leading axis, so
+  ``lax.scan`` runs them on one device, the ``stage`` mesh axis shards them
+  for the SPMD pipeline, and PartitionSpecs shard head/ffn dims for tensor
+  parallelism (Megatron split: column-parallel qkv/ffn-in, row-parallel
+  out/ffn-out with a trailing psum).
+* attention dispatches on the bound sequence axis: full causal attention by
+  default, ring attention inside a ``seq`` shard_map.
+
+Pre-LN, GELU MLP, learned positional embeddings, weight-tied LM head kept
+separate (simplicity > tying).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.ops.ring_attention import (
+    full_attention,
+    ring_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 1024
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 512
+    max_seq_len: int = 256
+    dtype: Any = jnp.float32
+    # Parallelism hooks (None = off). These name mesh axes and only take
+    # effect inside a shard_map that binds them.
+    tp_axis: str | None = None     # tensor parallel: heads/ffn sharded
+    sp_axis: str | None = None     # sequence parallel: ring attention
+    sp_impl: str = "ring"          # "ring" | "ulysses"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Parameter pytree; blocks stacked on a leading [n_layers] axis."""
+    k = jax.random.split(rng, 8)
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dt) * (fan_in ** -0.5))
+
+    def stack(key, shape, fan_in):
+        return dense(key, (L,) + shape, fan_in)
+
+    return {
+        "embed": jax.random.normal(k[0], (cfg.vocab_size, d), dt) * 0.02,
+        "pos": jax.random.normal(k[1], (cfg.max_seq_len, d), dt) * 0.02,
+        "blocks": {
+            "ln1_scale": jnp.ones((L, d), dt),
+            "ln1_bias": jnp.zeros((L, d), dt),
+            "wqkv": stack(k[2], (d, 3 * d), d),
+            "wo": stack(k[3], (d, d), d),
+            "ln2_scale": jnp.ones((L, d), dt),
+            "ln2_bias": jnp.zeros((L, d), dt),
+            "w1": stack(k[4], (d, f), d),
+            "b1": jnp.zeros((L, f), dt),
+            "w2": stack(k[5], (f, d), f),
+            "b2": jnp.zeros((L, d), dt),
+        },
+        "ln_f_scale": jnp.ones((d,), dt),
+        "ln_f_bias": jnp.zeros((d,), dt),
+        "head": dense(k[6], (d, cfg.vocab_size), d),
+    }
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _attention(q, k, v, cfg: TransformerConfig):
+    if cfg.sp_axis is not None:
+        if cfg.sp_impl == "ring":
+            return ring_attention(q, k, v, cfg.sp_axis, causal=True)
+        from distributed_model_parallel_tpu.ops.ring_attention import (
+            ulysses_attention,
+        )
+        return ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+    return full_attention(q, k, v, causal=True)
+
+
+def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """One transformer block on [B, T(_local), d]. ``bp`` holds *unstacked*
+    per-layer arrays (a leaf slice of params["blocks"]).
+
+    Tensor parallelism: when ``cfg.tp_axis`` is bound, wqkv/w1 arrive
+    column-sharded and wo/w2 row-sharded (shard_map hands each device its
+    slice); the two psums below complete the Megatron pattern.
+    """
+    b, t, d = x.shape
+
+    h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+    qkv = h @ bp["wqkv"]                     # [B,T,3*d/tp]
+    n_local_heads = qkv.shape[-1] // (3 * cfg.head_dim)
+    qkv = qkv.reshape(b, t, 3, n_local_heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o = _attention(q, k, v, cfg)             # [B,T,H_local,Dh]
+    o = o.reshape(b, t, -1) @ bp["wo"]       # row-parallel: partial sums
+    if cfg.tp_axis is not None:
+        o = jax.lax.psum(o, cfg.tp_axis)
+    x = x + o
+
+    h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
+    h = h @ bp["w2"]
+    if cfg.tp_axis is not None:
+        h = jax.lax.psum(h, cfg.tp_axis)
+        h = h + bp["b2"]                     # bias added once, post-psum
+    else:
+        h = h + bp["b2"]
+    return x + h
+
+
+def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Run all stacked blocks with lax.scan (single device / per-stage)."""
+
+    def body(carry, bp):
+        return block_apply(bp, carry, cfg), None
+
+    out, _ = jax.lax.scan(body, x, blocks)
+    return out
+
+
+def embed(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+          *, pos_offset: int = 0) -> jax.Array:
+    t = tokens.shape[1]
+    pos = jax.lax.dynamic_slice_in_dim(params["pos"], pos_offset, t)
+    return params["embed"][tokens] + pos[None]
+
+
+def unembed(params: dict, x: jax.Array) -> jax.Array:
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    return x @ params["head"]
+
+
+def apply(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+          *, pos_offset: int = 0) -> jax.Array:
+    """Full forward: [B, T] int tokens -> [B, T, V] logits."""
+    x = embed(params, tokens, cfg, pos_offset=pos_offset)
+    x = blocks_scan(params["blocks"], x, cfg)
+    return unembed(params, x)
+
+
+def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,
+            cfg: TransformerConfig) -> jax.Array:
+    """Mean next-token cross-entropy."""
+    logits = apply(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def build_transformer(model_config) -> "TransformerConfig":
+    """Registry adapter: ModelConfig.extra carries TransformerConfig fields."""
+    extra = dict(model_config.extra)
+    extra.setdefault("vocab_size", max(model_config.num_classes, 32))
+    return TransformerConfig(**extra)

@@ -1,0 +1,140 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no attention at all (SURVEY.md §5 — pure-CNN workload), but
+long-context is first-class for this framework. Two standard schemes, both
+expressed as named-axis collectives so they compose with the ``data``/
+``stage``/``model`` axes:
+
+* **Ring attention** (`ring_attention`): Q stays put; (K, V) blocks rotate
+  around the ``seq`` axis ring via ``ppermute`` while an online-softmax
+  accumulator (running max / denominator / weighted values, à la
+  Flash/blockwise attention) folds in one block per hop. Peak memory is one
+  (K, V) block per device and comms ride the ICI ring — the long-context
+  workhorse.
+* **Ulysses** (`ulysses_attention`): ``all_to_all`` re-shards from
+  sequence-sharded to head-sharded, runs ordinary full attention on complete
+  sequences for a subset of heads, and re-shards back. Cheaper compute
+  plumbing when heads ≥ axis size; 2 all-to-alls per call.
+
+Both must be called inside ``shard_map`` with ``axis_name`` bound, with
+inputs sharded on the sequence dimension: q, k, v are the *local* shards
+``[B, T_local, H, Dh]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, *, scale, q_pos, k_pos, causal):
+    """Scores + masking for one (Q_local, K_block) pair.
+
+    Returns (m, l, o): per-query running max, softmax denominator terms and
+    value accumulator contributions for this block.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]        # [Tq, Tk]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                            # [B,H,Tq]
+    # Guard fully-masked rows (exp(-inf - -inf)): zero them via finite max.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])                 # [B,H,Tq,Tk]
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)                            # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)            # [B,Tq,H,Dh]
+    return m_safe, l, o
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   *, causal: bool = True) -> jax.Array:
+    """Blockwise ring attention over ``axis_name``.
+
+    q/k/v: local shards [B, T_local, H, Dh]; the global sequence is the
+    concatenation of shards in axis-index order. Returns the local output
+    shard [B, T_local, H, Dh].
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    # Online-softmax accumulators.
+    m_acc = jnp.full(q.shape[:1] + (q.shape[2], t_local), -jnp.inf,
+                     q.dtype)                           # [B,H,Tq]
+    l_acc = jnp.zeros_like(m_acc)
+    o_acc = jnp.zeros_like(q)
+
+    def body(t, carry):
+        m_acc, l_acc, o_acc, k_t, v_t = carry
+        src = (idx - t) % n                             # origin of this block
+        k_pos = src * t_local + jnp.arange(t_local)
+        m_b, l_b, o_b = _block_attn(q, k_t, v_t, scale=scale, q_pos=q_pos,
+                                    k_pos=k_pos, causal=causal)
+        m_new = jnp.maximum(m_acc, m_b)
+        # Rescale old and new contributions onto the common max.
+        a = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new), 0.0)
+        b = jnp.exp(m_b - m_new) * jnp.where(l_b > 0, 1.0, 0.0)
+        l_new = a * l_acc + b * l_b
+        o_new = (a[..., None].transpose(0, 2, 1, 3) * o_acc
+                 + b[..., None].transpose(0, 2, 1, 3) * o_b)
+        # Rotate (K, V) one hop around the ring.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return m_new, l_new, o_new, k_t, v_t
+
+    carry = (m_acc, l_acc, o_acc, k, v)
+    for t in range(n):   # static unroll: n is the mesh-axis size
+        carry = body(t, carry)
+    _, l_acc, o_acc, _, _ = carry
+    denom = jnp.where(l_acc > 0, l_acc, 1.0)[..., None].transpose(0, 2, 1, 3)
+    return o_acc / denom
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, *, causal: bool = True) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Re-shards [B, T/n, H, Dh] -> [B, T, H/n, Dh], runs full softmax attention
+    over the complete sequence for the local head subset, then re-shards back.
+    Requires H % axis_size == 0.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
+
+    def seq_to_heads(x):   # [B, T/n, H, Dh] -> [B, T, H/n, Dh]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):   # [B, T, H/n, Dh] -> [B, T/n, H, Dh]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    t = qh.shape[1]
+    scale = qh.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return heads_to_seq(o)
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *, causal: bool = True) -> jax.Array:
+    """Reference single-device attention ([B, T, H, Dh]) for parity tests and
+    the non-sequence-parallel path."""
+    t = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,48 @@
+"""Tensor-parallel sharding rules for the Transformer.
+
+Megatron-style intra-layer parallelism expressed as PartitionSpecs over the
+``model`` mesh axis: column-parallel first matmuls (wqkv, w1 — output dim
+sharded, heads/ffn split across devices), row-parallel second matmuls (wo,
+w2 — input dim sharded) completed by one psum each, done inside
+``models/transformer.block_apply``. The reference has no TP (SURVEY.md §2.3
+"Absent"); on TPU it is nearly free to expose because it is only metadata:
+these specs + the two psums.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+
+def block_specs(stage_axis: str | None, model_axis: str | None) -> dict:
+    """PartitionSpecs for the stacked ``params["blocks"]`` pytree.
+
+    Leading dim is the layer stack (sharded over ``stage`` for the SPMD
+    pipeline); head/ffn dims shard over ``model``.
+    """
+    s, m = stage_axis, model_axis
+    return {
+        "ln1_scale": P(s, None),
+        "ln1_bias": P(s, None),
+        "wqkv": P(s, None, m),     # column-parallel
+        "wo": P(s, m, None),       # row-parallel
+        "ln2_scale": P(s, None),
+        "ln2_bias": P(s, None),
+        "w1": P(s, None, m),       # column-parallel
+        "b1": P(s, m),
+        "w2": P(s, m, None),       # row-parallel
+        "b2": P(s, None),
+    }
+
+
+def param_specs(stage_axis: str | None, model_axis: str | None) -> dict:
+    """Specs for the full transformer parameter pytree. Embedding/head stay
+    replicated (small at test scale; shard over ``model`` later if needed)."""
+    return {
+        "embed": P(),
+        "pos": P(),
+        "blocks": block_specs(stage_axis, model_axis),
+        "ln_f_scale": P(),
+        "ln_f_bias": P(),
+        "head": P(),
+    }
