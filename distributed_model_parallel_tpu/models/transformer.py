@@ -71,7 +71,9 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         "blocks": {
             "ln1_scale": jnp.ones((L, d), dt),
             "ln1_bias": jnp.zeros((L, d), dt),
-            "wqkv": stack(k[2], (d, 3 * d), d),
+            # [d, H, 3*Dh]: head dim explicit so tensor parallelism shards
+            # whole heads (column-parallel over the H axis).
+            "wqkv": stack(k[2], (d, cfg.n_heads, 3 * cfg.head_dim), d),
             "wo": stack(k[3], (d, d), d),
             "ln2_scale": jnp.ones((L, d), dt),
             "ln2_bias": jnp.zeros((L, d), dt),
@@ -114,10 +116,8 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
     b, t, d = x.shape
 
     h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-    qkv = h @ bp["wqkv"]                     # [B,T,3*d/tp]
-    n_local_heads = qkv.shape[-1] // (3 * cfg.head_dim)
-    qkv = qkv.reshape(b, t, 3, n_local_heads, cfg.head_dim)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])  # [B,T,H_local,3*Dh]
+    q, k, v = jnp.split(qkv, 3, axis=-1)              # each [B,T,H_local,Dh]
     o = _attention(q, k, v, cfg)             # [B,T,H_local,Dh]
     o = o.reshape(b, t, -1) @ bp["wo"]       # row-parallel: partial sums
     if cfg.tp_axis is not None:

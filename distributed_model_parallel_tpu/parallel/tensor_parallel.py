@@ -24,8 +24,9 @@ def block_specs(stage_axis: str | None, model_axis: str | None) -> dict:
     return {
         "ln1_scale": P(s, None),
         "ln1_bias": P(s, None),
-        "wqkv": P(s, None, m),     # column-parallel
-        "wo": P(s, m, None),       # row-parallel
+        "wqkv": P(s, None, m, None),  # column-parallel over heads
+        "wo": P(s, m, None),          # row-parallel (rows = heads x Dh,
+                                      # contiguous per head)
         "ln2_scale": P(s, None),
         "ln2_bias": P(s, None),
         "w1": P(s, None, m),       # column-parallel
