@@ -1,0 +1,123 @@
+"""Epoch driver for pipeline-parallel training.
+
+The counterpart of the reference's ``model_parallel.py`` main loop + the
+per-role loops in ``utils.py:34-210`` — but one driver instead of three
+role-specialized ones, because the single-controller runtime sees all stages.
+Metrics/logging/timing match the reference's rank-0 behavior
+(``model_parallel.py:110-125``): loss and accuracy are computed where the
+data lives (stage 0), per-batch compute and data-load times are averaged per
+epoch. Adds checkpoint/resume, which the reference's pipeline path lacks
+entirely (SURVEY.md §5 "Checkpoint/resume").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.config import TrainConfig
+from distributed_model_parallel_tpu.data.loader import BatchLoader
+from distributed_model_parallel_tpu.data.registry import load_dataset
+from distributed_model_parallel_tpu.models import get_model
+from distributed_model_parallel_tpu.parallel.pipeline import PipelineRunner
+from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
+from distributed_model_parallel_tpu.train.logging_util import RunLogger
+from distributed_model_parallel_tpu.train.metrics import AverageMeter, StepTimer
+from distributed_model_parallel_tpu.train.optim import make_optimizer
+from distributed_model_parallel_tpu.train.trainer import EpochResult
+
+
+class PipelineTrainer:
+    def __init__(self, config: TrainConfig, devices=None):
+        self.config = config
+        if devices is None:
+            devices = jax.devices()[:max(config.mesh.stage, 1)]
+        self.devices = devices
+
+        train_ds, eval_ds = load_dataset(config.data)
+        self.train_ds, self.eval_ds = train_ds, eval_ds
+        self.train_loader = BatchLoader(train_ds, config.data.batch_size,
+                                        shuffle=config.data.shuffle,
+                                        seed=config.data.seed)
+        self.eval_loader = BatchLoader(
+            eval_ds, min(config.data.eval_batch_size, len(eval_ds)),
+            shuffle=False)
+
+        model = get_model(config.model)
+        tx = make_optimizer(config.optimizer, len(self.train_loader),
+                            config.epochs)
+        self.runner = PipelineRunner(
+            model, devices, tx=tx, rng=jax.random.key(config.seed),
+            sample_shape=(2,) + train_ds.images.shape[1:],
+            mean=train_ds.mean, std=train_ds.std,
+            boundaries=config.stage_boundaries,
+            num_microbatches=config.num_microbatches,
+            augment=config.data.augment)
+
+        self.logger = RunLogger(config.log_dir, config.log_name)
+        self.ckpt = Checkpointer(config.checkpoint_dir)
+        self.best_acc = 0.0
+        self.start_epoch = 0
+        self._rng = jax.random.key(config.seed + 1)
+        if config.resume and self.ckpt.exists("pipeline"):
+            self._resume()
+
+    def _ckpt_tree(self):
+        return {"params": self.runner.merged_params(),
+                "model_state": self.runner.merged_model_state(),
+                "best_acc": jnp.asarray(self.best_acc, jnp.float32),
+                "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
+
+    def _resume(self):
+        restored = self.ckpt.restore(self._ckpt_tree(), "pipeline")
+        params, state = restored["params"], restored["model_state"]
+        for s, (lo, hi) in enumerate(self.runner.slices):
+            dev = self.runner.devices[s]
+            self.runner.stages[s].params = jax.device_put(
+                tuple(params[lo:hi]), dev)
+            self.runner.stages[s].model_state = jax.device_put(
+                tuple(state[lo:hi]), dev)
+        self.best_acc = float(restored["best_acc"])
+        self.start_epoch = int(restored["epoch"])
+
+    def _run_epoch(self, epoch: int, train: bool) -> EpochResult:
+        meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
+        timer = StepTimer()
+        loader = self.train_loader if train else self.eval_loader
+        for i, (images, labels) in enumerate(loader):
+            timer.data_ready()
+            if train:
+                self._rng, sub = jax.random.split(self._rng)
+                m = self.runner.train_step(sub, images, labels)
+            else:
+                m = self.runner.eval_step(images, labels)
+            timer.step_done()
+            b = m["batch"]
+            meters["loss"].update(m["loss"], int(b))
+            meters["acc1"].update(m["correct@1"] / b * 100, int(b))
+            meters["acc5"].update(m["correct@5"] / b * 100, int(b))
+            if train and i % self.config.log_every_n_steps == 0:
+                self.logger.log_step(epoch, i, loss=meters["loss"].avg,
+                                     acc1=meters["acc1"].avg,
+                                     step_time=timer.step.avg,
+                                     data_time=timer.data.avg)
+        return EpochResult(meters["loss"].avg, meters["acc1"].avg,
+                           meters["acc5"].avg, timer.step.avg, timer.data.avg)
+
+    def fit(self, epochs: int | None = None) -> list[dict]:
+        epochs = epochs if epochs is not None else self.config.epochs
+        history = []
+        for epoch in range(self.start_epoch, epochs):
+            tr = self._run_epoch(epoch, train=True)
+            ev = self._run_epoch(epoch, train=False)
+            record = dict(epoch=epoch, loss_train=tr.loss, acc1_train=tr.acc1,
+                          loss_val=ev.loss, acc1_val=ev.acc1,
+                          time_per_batch=tr.step_time,
+                          time_load_per_batch=tr.data_time)
+            self.logger.log_epoch(**record)
+            history.append(record)
+            if ev.acc1 > self.best_acc:
+                self.best_acc = ev.acc1
+                self.start_epoch = epoch + 1
+                self.ckpt.save(self._ckpt_tree(), "pipeline")
+        return history

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Data-parallel training driver.
+
+CLI parity with the reference's ``data_parallel.py`` (flags ``--lr``,
+``--resume``; ``data_parallel.py:19-23``) plus the knobs its pipeline script
+exposed (``model_parallel.py:15-42``: dataset, batch size, workers, wd,
+momentum, epochs) — all honored, none silently ignored (the reference ignores
+``-b``/``-j``/``-type``, SURVEY.md §1).
+
+Examples:
+  python scripts/train_data_parallel.py --lr 0.4 --batch-size 512
+  python scripts/train_data_parallel.py --resume --sync-bn --ddp
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from distributed_model_parallel_tpu.config import (
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    TrainConfig,
+)
+from distributed_model_parallel_tpu.mesh import best_effort_distributed_init
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("data", nargs="?", default="./data", help="dataset root")
+    p.add_argument("--dataset-type", "-type", default="cifar10",
+                   choices=["cifar10", "imagenet", "cub200", "place365",
+                            "synthetic"])
+    p.add_argument("--model", default="mobilenetv2")
+    p.add_argument("--lr", default=0.4, type=float)
+    p.add_argument("--momentum", default=0.9, type=float)
+    p.add_argument("--wd", default=1e-4, type=float)
+    p.add_argument("--epochs", default=100, type=int)
+    p.add_argument("--batch-size", "-b", default=512, type=int)
+    p.add_argument("--workers", "-j", default=2, type=int)
+    p.add_argument("--warmup-epochs", default=10, type=int)
+    p.add_argument("--resume", "-r", action="store_true")
+    p.add_argument("--sync-bn", action="store_true",
+                   help="SyncBatchNorm semantics (BASELINE config 3)")
+    p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    p.add_argument("--num-devices", default=0, type=int,
+                   help="data-parallel width (0 = all visible devices)")
+    p.add_argument("--log-name", default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    best_effort_distributed_init()
+    import jax
+
+    n = args.num_devices or len(jax.devices())
+    steps_per_epoch = max(1, 50000 // args.batch_size)
+    config = TrainConfig(
+        model=ModelConfig(name=args.model,
+                          batchnorm="sync" if args.sync_bn else "local",
+                          dtype="bfloat16" if args.bf16 else "float32"),
+        data=DataConfig(name=args.dataset_type, root=args.data,
+                        batch_size=args.batch_size, num_workers=args.workers,
+                        augment=not args.no_augment),
+        optimizer=OptimizerConfig(
+            learning_rate=args.lr, momentum=args.momentum,
+            weight_decay=args.wd,
+            warmup_steps=args.warmup_epochs * steps_per_epoch),
+        mesh=MeshConfig(data=n),
+        epochs=args.epochs,
+        resume=args.resume,
+        log_name=args.log_name or f"data_para_{args.batch_size}",
+    )
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    Trainer(config).fit()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Pipeline (model-parallel) training driver.
+
+CLI parity with the reference's ``model_parallel.py`` (``:15-42``) with the
+mesh replacing ``--dist-url``/``--dist-backend``/``--world-size`` +
+``mp.spawn`` (SURVEY.md §2.4): ``--stages`` is the pipeline depth,
+``--microbatches 1`` reproduces the reference's naive 1-batch-in-flight
+schedule, larger values give GPipe. Stage boundaries are configurable data
+(``--boundaries 0,4,10,16,19`` = the reference's hard-coded 4-GPU split,
+``model_parallel.py:102-144``), not per-rank code.
+
+Example:
+  python scripts/train_model_parallel.py --stages 4 --batch-size 512 --lr 0.4
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from distributed_model_parallel_tpu.config import (
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    TrainConfig,
+)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("data", nargs="?", default="./data")
+    p.add_argument("--dataset-type", "-type", default="cifar10",
+                   choices=["cifar10", "imagenet", "cub200", "place365",
+                            "synthetic"])
+    p.add_argument("--model", default="mobilenetv2")
+    p.add_argument("--stages", "--world-size", default=4, type=int)
+    p.add_argument("--microbatches", default=1, type=int,
+                   help="1 = reference's naive schedule; >1 = GPipe")
+    p.add_argument("--boundaries", default=None,
+                   help="comma-separated unit boundaries, e.g. 0,4,10,16,19")
+    p.add_argument("--lr", default=0.4, type=float)
+    p.add_argument("--momentum", default=0.9, type=float)
+    p.add_argument("--wd", default=1e-4, type=float)
+    p.add_argument("--epochs", default=100, type=int)
+    p.add_argument("--batch-size", "-b", default=512, type=int)
+    p.add_argument("--warmup-epochs", default=10, type=int)
+    p.add_argument("--resume", "-r", action="store_true")
+    p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--log-name", default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    boundaries = (None if args.boundaries is None else
+                  [int(x) for x in args.boundaries.split(",")])
+    steps_per_epoch = max(1, 50000 // args.batch_size)
+    config = TrainConfig(
+        model=ModelConfig(name=args.model),
+        data=DataConfig(name=args.dataset_type, root=args.data,
+                        batch_size=args.batch_size,
+                        augment=not args.no_augment),
+        optimizer=OptimizerConfig(
+            learning_rate=args.lr, momentum=args.momentum,
+            weight_decay=args.wd,
+            warmup_steps=args.warmup_epochs * steps_per_epoch),
+        mesh=MeshConfig(data=1, stage=args.stages),
+        epochs=args.epochs,
+        resume=args.resume,
+        num_microbatches=args.microbatches,
+        stage_boundaries=boundaries,
+        log_name=args.log_name or f"{args.batch_size}",
+    )
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+    PipelineTrainer(config).fit()
+
+
+if __name__ == "__main__":
+    main()
