@@ -1,0 +1,99 @@
+"""Bag-of-words embedding classifier — the sparse-gradient DDP workload.
+
+BASELINE.json config 5: "sparse-gradient DDP path (nn.Embedding bag-of-words
+classifier, sparse=True)". Mean-pooled token embeddings + linear head. The
+model is deliberately tiny-dense-head / huge-sparse-table so the embedding
+gradient path (ops/sparse.py) dominates, like its torch counterpart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_model_parallel_tpu.ops.sparse import (
+    apply_sparse_grad,
+    embedding_grad_sparse,
+    embedding_lookup,
+    sparse_allreduce,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BowConfig:
+    vocab_size: int = 10000
+    embed_dim: int = 64
+    num_classes: int = 10
+
+
+def init_params(rng: jax.Array, cfg: BowConfig) -> dict:
+    k1, k2 = jax.random.split(rng)
+    return {
+        "embedding": jax.random.normal(k1, (cfg.vocab_size, cfg.embed_dim)) * 0.1,
+        "w": jax.random.normal(k2, (cfg.embed_dim, cfg.num_classes))
+             * (cfg.embed_dim ** -0.5),
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+
+
+def apply(params: dict, tokens: jax.Array) -> jax.Array:
+    """[B, T] int tokens -> [B, C] logits (mean-pooled bag of words)."""
+    emb = embedding_lookup(params["embedding"], tokens)
+    pooled = jnp.mean(emb, axis=1)
+    return pooled @ params["w"] + params["b"]
+
+
+def loss_fn(params: dict, tokens: jax.Array, labels: jax.Array) -> jax.Array:
+    logits = apply(params, tokens)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_sparse_sgd_step(cfg: BowConfig, lr: float, axis_name: str | None = None):
+    """SGD step where the embedding gradient stays COO end-to-end.
+
+    Dense params (w, b) take the ordinary (psum-averaged) dense gradient;
+    the table takes a scatter-add sparse update. With ``axis_name`` set the
+    step must run inside shard_map over that axis and performs the DDP-style
+    sparse allreduce.
+    """
+
+    def head_loss(head, pooled, labels):
+        logits = pooled @ head["w"] + head["b"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    def step(params, tokens, labels):
+        b, t = tokens.shape
+        emb = embedding_lookup(params["embedding"], tokens)
+        pooled = jnp.mean(emb, axis=1)
+        head = {"w": params["w"], "b": params["b"]}
+        loss, (dense_grads, d_pooled) = jax.value_and_grad(
+            head_loss, argnums=(0, 1))(head, pooled, labels)
+        # d(emb) = d_pooled / T broadcast over the T axis -> COO directly.
+        d_emb = jnp.broadcast_to(d_pooled[:, None] / t, (b, t, d_pooled.shape[-1]))
+        ids, vals = embedding_grad_sparse(tokens, d_emb)
+
+        if axis_name is not None:
+            dense_grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, axis_name), dense_grads)
+            ids, vals = sparse_allreduce(ids, vals, axis_name)
+            loss = jax.lax.pmean(loss, axis_name)
+
+        new_params = {
+            "embedding": apply_sparse_grad(params["embedding"], ids, vals, lr),
+            "w": params["w"] - lr * dense_grads["w"],
+            "b": params["b"] - lr * dense_grads["b"],
+        }
+        return new_params, loss
+
+    return step
+
+
+def build_embedding_bow(model_config) -> BowConfig:
+    """Registry adapter (ModelConfig.extra carries BowConfig fields)."""
+    extra = dict(model_config.extra)
+    extra.setdefault("num_classes", model_config.num_classes)
+    return BowConfig(**extra)
