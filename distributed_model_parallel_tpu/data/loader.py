@@ -14,7 +14,9 @@ semantics), so every step compiles once.
 
 from __future__ import annotations
 
-from typing import Iterator
+import queue
+import threading
+from typing import Iterable, Iterator
 
 import jax
 import jax.numpy as jnp
@@ -24,10 +26,15 @@ from distributed_model_parallel_tpu.data.registry import ArrayDataset
 
 
 class BatchLoader:
-    """Epoch-shuffled uint8 batch iterator over an ArrayDataset."""
+    """Epoch-shuffled uint8 batch iterator over an ArrayDataset.
+
+    ``use_native=True`` assembles batches with the C++ row-gather
+    (data/native.py); falls back to numpy fancy indexing transparently.
+    """
 
     def __init__(self, ds: ArrayDataset, batch_size: int, *,
-                 shuffle: bool = True, seed: int = 0, drop_last: bool = True):
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 use_native: bool = False, num_workers: int = 4):
         if batch_size > len(ds):
             raise ValueError(
                 f"batch size {batch_size} exceeds dataset size {len(ds)}")
@@ -35,6 +42,8 @@ class BatchLoader:
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
+        self.use_native = use_native
+        self.num_workers = num_workers
         self._rng = np.random.default_rng(seed)
 
     def __len__(self) -> int:
@@ -45,9 +54,56 @@ class BatchLoader:
         n = len(self.ds)
         idx = self._rng.permutation(n) if self.shuffle else np.arange(n)
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
-        for lo in range(0, stop, self.batch_size):
-            sel = idx[lo:lo + self.batch_size]
-            yield self.ds.images[sel], self.ds.labels[sel]
+        if self.use_native:
+            from distributed_model_parallel_tpu.data import native
+            for lo in range(0, stop, self.batch_size):
+                sel = idx[lo:lo + self.batch_size]
+                yield (native.gather_rows(self.ds.images, sel,
+                                          n_threads=self.num_workers),
+                       self.ds.labels[sel])
+        else:
+            for lo in range(0, stop, self.batch_size):
+                sel = idx[lo:lo + self.batch_size]
+                yield self.ds.images[sel], self.ds.labels[sel]
+
+
+class PrefetchLoader:
+    """Background-thread prefetch over any batch iterable — the capability of
+    the reference's ``num_workers``/pinned-memory DataLoader settings
+    (``data_parallel.py:44-51``) in single-controller form: batch k+1 is
+    assembled on a host thread while the accelerator runs batch k."""
+
+    def __init__(self, loader: Iterable, depth: int = 2):
+        self.loader = loader
+        self.depth = depth
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        sentinel = object()
+        err: list[BaseException] = []
+
+        def worker():
+            try:
+                for item in self.loader:
+                    q.put(item)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
 
 
 def normalize(images_u8: jnp.ndarray, mean: np.ndarray, std: np.ndarray,

@@ -1,0 +1,132 @@
+// Native host-side data path for distributed_model_parallel_tpu.
+//
+// TPU-native equivalent of the native machinery the reference consumes from
+// PyTorch for input handling: multi-worker DataLoader batching + torchvision
+// C-backed transforms (reference data_parallel.py:31-51) and the C++
+// scatter/gather comm helpers of nn.DataParallel (Readme.md:20,109-143 —
+// scatter/gather on TPU is sharding metadata, so the real host-side work
+// left is batch assembly and augmentation). The hot loop feeding a TPU is
+// uint8 NHWC batch gather + pad-crop-flip augmentation; doing it here keeps
+// the Python loop free and the H2D wire uint8.
+//
+// Exposed via plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+// xorshift64* — deterministic, seedable, fast; one stream per image so
+// results are independent of thread scheduling.
+static inline uint64_t xorshift64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  *s = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+static inline int rand_below(uint64_t* s, int n) {
+  return static_cast<int>(xorshift64(s) % static_cast<uint64_t>(n));
+}
+
+template <typename F>
+void parallel_for(int n, int n_threads, F&& fn) {
+  if (n_threads <= 1 || n < 2) {
+    for (int i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  int t = std::min(n_threads, n);
+  std::vector<std::thread> workers;
+  workers.reserve(t);
+  int chunk = (n + t - 1) / t;
+  for (int w = 0; w < t; ++w) {
+    int lo = w * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([lo, hi, &fn]() {
+      for (int i = lo; i < hi; ++i) fn(i);
+    });
+  }
+  for (auto& th : workers) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows: out[i] = src[idx[i]], each row item_bytes long.
+// (The batch-assembly half of a DataLoader worker.)
+void dmp_gather_rows(const uint8_t* src, const int64_t* idx, uint8_t* out,
+                     int64_t n_sel, int64_t item_bytes, int n_threads) {
+  parallel_for(static_cast<int>(n_sel), n_threads, [&](int i) {
+    std::memcpy(out + static_cast<int64_t>(i) * item_bytes,
+                src + idx[i] * item_bytes, item_bytes);
+  });
+}
+
+// Random pad-crop + horizontal flip on a uint8 NHWC batch.
+// Equivalent of RandomCrop(h, padding=pad) + RandomHorizontalFlip
+// (reference data_parallel.py:33-35). Zero padding, per-image rng stream
+// derived from (seed, i).
+void dmp_augment_batch(const uint8_t* in, uint8_t* out, int64_t b, int64_t h,
+                       int64_t w, int64_t c, int pad, uint64_t seed,
+                       int n_threads) {
+  const int64_t img = h * w * c;
+  parallel_for(static_cast<int>(b), n_threads, [&](int i) {
+    uint64_t s = seed ^ (0x9E3779B97F4A7C15ULL * (i + 1));
+    xorshift64(&s);
+    const int dy = rand_below(&s, 2 * pad + 1) - pad;   // shift in [-pad, pad]
+    const int dx = rand_below(&s, 2 * pad + 1) - pad;
+    const bool flip = (xorshift64(&s) & 1) != 0;
+    const uint8_t* src = in + i * img;
+    uint8_t* dst = out + i * img;
+    for (int64_t y = 0; y < h; ++y) {
+      const int64_t sy = y + dy;
+      if (sy < 0 || sy >= h) {
+        std::memset(dst + y * w * c, 0, w * c);
+        continue;
+      }
+      for (int64_t x = 0; x < w; ++x) {
+        const int64_t sx = (flip ? (w - 1 - x) : x) + dx;
+        uint8_t* px = dst + (y * w + x) * c;
+        if (sx < 0 || sx >= w) {
+          std::memset(px, 0, c);
+        } else {
+          std::memcpy(px, src + (sy * w + sx) * c, c);
+        }
+      }
+    }
+  });
+}
+
+// uint8 NHWC -> normalized float32: (x/255 - mean[c]) / std[c].
+void dmp_normalize_batch(const uint8_t* in, float* out, int64_t n_pixels,
+                         int64_t c, const float* mean, const float* std_,
+                         int n_threads) {
+  std::vector<float> scale(c), shift(c);
+  for (int64_t k = 0; k < c; ++k) {
+    scale[k] = 1.0f / (255.0f * std_[k]);
+    shift[k] = -mean[k] / std_[k];
+  }
+  // chunk over pixels
+  const int chunks = n_threads > 1 ? n_threads * 4 : 1;
+  const int64_t per = (n_pixels + chunks - 1) / chunks;
+  parallel_for(chunks, n_threads, [&](int ci) {
+    const int64_t lo = ci * per, hi = std::min(n_pixels, lo + per);
+    for (int64_t p = lo; p < hi; ++p) {
+      const uint8_t* ip = in + p * c;
+      float* op = out + p * c;
+      for (int64_t k = 0; k < c; ++k) op[k] = ip[k] * scale[k] + shift[k];
+    }
+  });
+}
+
+int dmp_version() { return 1; }
+
+}  // extern "C"
