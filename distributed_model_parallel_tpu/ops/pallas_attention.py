@@ -1,0 +1,114 @@
+"""Pallas flash attention for TPU.
+
+The hand-written-kernel tier of the stack (the reference's analog is the CUDA
+kernels it consumes from PyTorch; SURVEY.md §2.2): a blockwise
+online-softmax causal attention kernel that keeps the [T, T] score matrix out
+of HBM entirely — scores live tile-by-tile in VMEM, the MXU does the two
+matmuls, and only O([T, Dh]) touches HBM. Composes with ring attention
+(ops/ring_attention.py) which handles the *cross-chip* blocking; this kernel
+is the *on-chip* blocking.
+
+Falls back to interpret mode off-TPU (tests run it on CPU), and pads the head
+dim to the 128-lane tile when needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
+                  causal: bool, scale: float):
+    """Grid: (batch*heads, num_q_blocks). Blocks: q/o [1, BQ, D]; k/v [1, T, D]."""
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0] * scale                                   # [BQ, D]
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    num_k = seq_len // block_k
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :]   # [BK, D]
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = alpha[:, None] * acc + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Skip K blocks entirely above the diagonal: the last contributing
+        # block covers query position (qi+1)*bq - 1.
+        num_k_eff = ((qi + 1) * bq - 1) // block_k + 1
+        m, l, acc = jax.lax.fori_loop(0, num_k_eff, body, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+
+    l = jnp.where(l == 0, 1.0, l)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """[B, T, H, D] -> [B, T, H, D] causal attention, pallas-blocked.
+
+    ``interpret=None`` auto-selects interpret mode off-TPU.
+    """
+    b, t, h, d = q.shape
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} must be divisible by block sizes "
+                         f"({block_q}, {block_k})")
+
+    # Pad head dim to the TPU lane width so tiles are legal.
+    d_pad = max(128, d) if not interpret else d
+    scale = d ** -0.5
+    if d_pad != d:
+        pad = [(0, 0)] * 3 + [(0, d_pad - d)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+
+    def bhtd(x):   # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d_pad)
+
+    qf, kf, vf = bhtd(q), bhtd(k), bhtd(v)
+    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=t,
+                               causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t, d_pad), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d_pad), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d_pad), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out.reshape(b, h, t, d_pad).transpose(0, 2, 1, 3)
+    return out[..., :d]

@@ -1,0 +1,53 @@
+"""Tracing / profiling.
+
+The reference's entire observability story is ``time.time()`` deltas averaged
+per epoch (``utils.py:41,48,64-74``; SURVEY.md §5). Equivalent meters live in
+``train/metrics.py`` (StepTimer). This module adds the TPU-native upgrade:
+``jax.profiler`` traces viewable in TensorBoard/Perfetto, plus a lightweight
+step-latency profiler for benchmarking jitted step functions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from typing import Callable
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/dmp_trace"):
+    """Capture an XLA/TPU profiler trace for the enclosed region."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10,
+              **kwargs) -> dict:
+    """Steady-state latency of a jitted callable (seconds).
+
+    Blocks on the last output each iteration, so async dispatch does not
+    fake the numbers.
+    """
+    out = None
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        samples.append(time.perf_counter() - t0)
+    return {
+        "mean_s": statistics.fmean(samples),
+        "median_s": statistics.median(samples),
+        "min_s": min(samples),
+        "max_s": max(samples),
+        "iters": iters,
+    }
