@@ -1,0 +1,106 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+Beyond the reference (SURVEY.md §2.3 lists EP as absent) but part of this
+framework's first-class parallelism set: top-1 (switch-style) token routing
+with static capacity, experts sharded one-per-device-group over the
+``expert`` axis, and token exchange via ``all_to_all`` — the TPU-native form
+of expert dispatch (dense einsum dispatch/combine against one-hot capacity
+masks, so everything is static-shaped MXU work; dropped tokens pass through
+on the residual path).
+
+Shapes (inside shard_map over the expert axis):
+  x_local:        [B_local, T, d]   tokens on this device group
+  expert params:  [E_local, ...]    experts owned by this group
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 4
+    d_model: int = 64
+    d_ff: int = 128
+    capacity_factor: float = 2.0
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    E, d, f = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "router": jax.random.normal(k1, (d, E)) * (d ** -0.5),
+        "w_in": jax.random.normal(k2, (E, d, f)) * (d ** -0.5),
+        "w_out": jax.random.normal(k3, (E, f, d)) * (f ** -0.5),
+    }
+
+
+def _route(router, x, cfg: MoEConfig):
+    """Top-1 routing with per-expert capacity.
+
+    Returns (dispatch [N, E, C] one-hot, combine [N, E, C] weighted,
+    aux_loss) for N flattened tokens.
+    """
+    n = x.shape[0]
+    E = cfg.num_experts
+    cap = max(1, int(cfg.capacity_factor * n / E))
+    logits = x @ router                               # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)               # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, E)                # [N, E]
+    # Position of each token within its expert's queue.
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+    keep = (pos < cap) * onehot                       # drop overflow
+    pos = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)   # [N]
+    dispatch = keep[:, :, None] * jax.nn.one_hot(pos, cap)[:, None, :]  # [N,E,C]
+    combine = dispatch * gate[:, None, None]
+
+    # Switch-transformer load-balancing loss.
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
+            ep_axis: str | None = None) -> tuple[jax.Array, jax.Array]:
+    """MoE FFN on [B, T, d]. Returns (y, aux_loss).
+
+    Without ``ep_axis``: all experts local (dense dispatch einsums).
+    With ``ep_axis`` (inside shard_map): params arrive expert-sharded
+    [E_local, ...]; expert inputs are exchanged with ``all_to_all`` so each
+    device group runs only its own experts, then results return the same way.
+    """
+    b, t, d = x.shape
+    xf = x.reshape(-1, d)                             # [N, d]
+    dispatch, combine, aux = _route(params["router"], xf, cfg)
+
+    # expert_in[e, c, :] = sum_n dispatch[n,e,c] * x[n]
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+
+    if ep_axis is not None:
+        ep = jax.lax.axis_size(ep_axis)
+        e_local = params["w_in"].shape[0]             # E / ep
+        # [E, C, d] -> exchange so this device holds its experts' tokens from
+        # ALL groups (tiled: split expert axis by ep, concat source-major on
+        # the capacity axis): -> [E_local, ep*C, d].
+        expert_in = jax.lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+        # Inverse exchange: [E_local, ep*C, d] -> [ep*E_local, C, d], chunks
+        # source-major on axis 0 == global expert order.
+        expert_out = jax.lax.all_to_all(
+            expert_out, ep_axis, split_axis=1, concat_axis=0, tiled=True)
+    else:
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+
+    y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return y.reshape(b, t, d), aux
