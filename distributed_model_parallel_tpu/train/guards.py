@@ -1,0 +1,100 @@
+"""Training guards: replica-divergence and non-finite detection.
+
+The reference has no race/failure detection at all (SURVEY.md §5): DDP's
+implicit guarantee that replicas stay in lockstep is trusted blindly, and a
+dead rank simply hangs the NCCL ring. The single-controller SPMD model
+removes whole classes of those failures (there is one program; collectives
+cannot mismatch), so the remaining failure surface is numerical and
+placement drift — which these guards check cheaply:
+
+* ``assert_replicated`` — verifies a pytree whose arrays claim to be
+  replicated really is bitwise-identical across devices (the invariant DDP
+  maintains by construction and silently corrupts when broken; here it can
+  only break through user error like donating a stale buffer, and a test
+  can check it directly).
+* ``check_finite`` — raises on NaN/Inf in a pytree (e.g. loss explosion),
+  replacing silent divergence with a loud failure; cheap enough to run every
+  N steps.
+* ``StallDetector`` — a watchdog flagging steps that exceed a wall-clock
+  budget (the observable symptom of a wedged collective/hardware hang, which
+  in the reference just blocks forever on ``dist.recv``,
+  ``distributed_layers.py:20``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class ReplicaDivergenceError(AssertionError):
+    pass
+
+
+def assert_replicated(tree: Any, *, atol: float = 0.0, name: str = "tree") -> None:
+    """Check every array's shards are identical across its devices."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) < 2:
+            continue
+        if shards[0].data.shape != leaf.shape:
+            continue  # actually sharded, not replicated
+        ref = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            got = np.asarray(s.data)
+            if not np.allclose(ref, got, atol=atol, rtol=0.0):
+                raise ReplicaDivergenceError(
+                    f"{name}{jax.tree_util.keystr(path)} diverges between "
+                    f"device {shards[0].device} and {s.device} "
+                    f"(max abs diff {np.abs(ref - got).max()})")
+
+
+class NonFiniteError(FloatingPointError):
+    pass
+
+
+def check_finite(tree: Any, *, name: str = "tree") -> None:
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if not np.isfinite(arr).all():
+            raise NonFiniteError(
+                f"{name}{jax.tree_util.keystr(path)} contains "
+                f"{np.isnan(arr).sum()} NaN / {np.isinf(arr).sum()} Inf values")
+
+
+class StallDetector:
+    """Flags steps exceeding ``budget_s``. Usage:
+
+        stall = StallDetector(budget_s=60)
+        with stall.step():
+            train_step(...)
+        if stall.stalled: ...
+    """
+
+    def __init__(self, budget_s: float):
+        self.budget_s = budget_s
+        self.stalled = False
+        self.worst_s = 0.0
+
+    class _Ctx:
+        def __init__(self, outer):
+            self.outer = outer
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            dt = time.perf_counter() - self.t0
+            self.outer.worst_s = max(self.outer.worst_s, dt)
+            if dt > self.outer.budget_s:
+                self.outer.stalled = True
+            return False
+
+    def step(self) -> "_Ctx":
+        return self._Ctx(self)

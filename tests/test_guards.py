@@ -1,0 +1,56 @@
+"""Guards: divergence, non-finite, stall detection."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.train.guards import (
+    NonFiniteError,
+    ReplicaDivergenceError,
+    StallDetector,
+    assert_replicated,
+    check_finite,
+)
+
+
+def test_assert_replicated_ok(mesh8):
+    tree = {"w": jax.device_put(jnp.ones((4, 4)), mesh8.replicated())}
+    assert_replicated(tree)  # no raise
+
+
+def test_assert_replicated_catches_divergence(mesh8):
+    devs = list(mesh8.mesh.devices.ravel())
+    shards = [jnp.full((2, 2), float(i)) for i in range(len(devs))]
+    arr = jax.make_array_from_single_device_arrays(
+        (2, 2),
+        jax.sharding.NamedSharding(mesh8.mesh, jax.sharding.PartitionSpec()),
+        [jax.device_put(s, d) for s, d in zip(shards, devs)])
+    with pytest.raises(ReplicaDivergenceError):
+        assert_replicated({"w": arr})
+
+
+def test_assert_replicated_ignores_sharded(mesh8):
+    x = jax.device_put(jnp.arange(16.0), mesh8.batch_sharded())
+    assert_replicated({"x": x})  # sharded arrays are skipped, no raise
+
+
+def test_check_finite():
+    check_finite({"a": jnp.ones(3)})
+    with pytest.raises(NonFiniteError):
+        check_finite({"a": jnp.array([1.0, float("nan")])})
+    with pytest.raises(NonFiniteError):
+        check_finite({"a": jnp.array([float("inf")])})
+
+
+def test_stall_detector():
+    s = StallDetector(budget_s=0.01)
+    with s.step():
+        pass
+    assert not s.stalled
+    with s.step():
+        time.sleep(0.02)
+    assert s.stalled
+    assert s.worst_s >= 0.02
