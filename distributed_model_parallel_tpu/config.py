@@ -120,6 +120,11 @@ class TrainConfig:
 
     epochs: int = 100                       # reference data_parallel.py:160
     seed: int = 0
+    # Data-parallel engine: "gspmd" = sharded jit (XLA infers the allreduce);
+    # "ddp" = explicit shard_map per-replica programs with psum gradient
+    # averaging and per-replica BatchNorm (parallel/ddp.py).
+    strategy: str = "gspmd"
+    ddp_bucket_bytes: int | None = None     # None = per-leaf psum
     log_dir: str = "./log"
     log_name: str = "train"
     checkpoint_dir: str = "./checkpoint"

@@ -132,24 +132,53 @@ class Trainer:
         params, model_state = self.model.init(
             jax.random.key(config.seed),
             normalize(sample, train_ds.mean, train_ds.std))
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                           model_state=model_state,
-                           opt_state=self.tx.init(params))
         # Replicate state over the mesh; shard batches on the data axis.
         self._repl = self.spec.replicated()
         self._batch_sh = self.spec.batch_sharded()
-        self.state = jax.device_put(state, self._repl)
-
         kw = dict(mean=train_ds.mean, std=train_ds.std)
-        self._train_step = jax.jit(
-            make_train_step(self.model, self.tx, augment=config.data.augment, **kw),
-            in_shardings=(self._repl, self._repl, self._batch_sh, self._batch_sh),
-            out_shardings=(self._repl, self._repl),
-            donate_argnums=(0,))
-        self._eval_step = jax.jit(
-            make_eval_step(self.model, **kw),
-            in_shardings=(self._repl, self._batch_sh, self._batch_sh),
-            out_shardings=self._repl)
+
+        if config.strategy == "ddp":
+            # Explicit per-replica engine: BN state carries a leading
+            # per-replica axis sharded over the data axis (parallel/ddp.py).
+            from distributed_model_parallel_tpu.parallel.ddp import (
+                make_ddp_eval_step,
+                make_ddp_train_step,
+                replicate_model_state,
+            )
+
+            model_state = replicate_model_state(model_state, self.spec.num_data)
+            state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                               model_state=model_state,
+                               opt_state=self.tx.init(params))
+            self._state_sh = TrainState(
+                step=self._repl, params=self._repl,
+                model_state=self.spec.batch_sharded(),
+                opt_state=self._repl)
+            self.state = jax.device_put(state, self._state_sh)
+            self._train_step = make_ddp_train_step(
+                self.model, self.tx, self.spec,
+                augment=config.data.augment,
+                bucket_bytes=config.ddp_bucket_bytes, **kw)
+            self._eval_step = make_ddp_eval_step(self.model, self.spec, **kw)
+        elif config.strategy == "gspmd":
+            state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                               model_state=model_state,
+                               opt_state=self.tx.init(params))
+            self._state_sh = self._repl
+            self.state = jax.device_put(state, self._repl)
+            self._train_step = jax.jit(
+                make_train_step(self.model, self.tx,
+                                augment=config.data.augment, **kw),
+                in_shardings=(self._repl, self._repl, self._batch_sh,
+                              self._batch_sh),
+                out_shardings=(self._repl, self._repl),
+                donate_argnums=(0,))
+            self._eval_step = jax.jit(
+                make_eval_step(self.model, **kw),
+                in_shardings=(self._repl, self._batch_sh, self._batch_sh),
+                out_shardings=self._repl)
+        else:
+            raise KeyError(f"unknown strategy {config.strategy!r}")
 
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)
@@ -167,7 +196,7 @@ class Trainer:
 
     def _resume(self):
         restored = self.ckpt.restore(self._ckpt_tree())
-        self.state = jax.device_put(restored["state"], self._repl)
+        self.state = jax.device_put(restored["state"], self._state_sh)
         self.best_acc = float(restored["best_acc"])
         self.start_epoch = int(restored["epoch"])
 

@@ -15,6 +15,10 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_model_parallel_tpu.config import (
     DataConfig,
@@ -43,6 +47,11 @@ def parse_args():
     p.add_argument("--resume", "-r", action="store_true")
     p.add_argument("--sync-bn", action="store_true",
                    help="SyncBatchNorm semantics (BASELINE config 3)")
+    p.add_argument("--ddp", action="store_true",
+                   help="explicit shard_map DDP engine (per-replica BN, "
+                        "psum grad averaging) instead of GSPMD")
+    p.add_argument("--bucket-mb", type=int, default=0,
+                   help="DDP gradient bucket size in MiB (0 = per-leaf psum)")
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     p.add_argument("--num-devices", default=0, type=int,
@@ -72,6 +81,8 @@ def main():
         mesh=MeshConfig(data=n),
         epochs=args.epochs,
         resume=args.resume,
+        strategy="ddp" if args.ddp else "gspmd",
+        ddp_bucket_bytes=args.bucket_mb * 1024 * 1024 or None,
         log_name=args.log_name or f"data_para_{args.batch_size}",
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer

@@ -16,6 +16,10 @@ Example:
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_model_parallel_tpu.config import (
     DataConfig,
