@@ -15,6 +15,8 @@ visible (per-chip = total / n_chips). bfloat16 compute, float32 params.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
@@ -22,6 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_GPU = 512 / 0.396 / 4  # Readme.md:286
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
 def main() -> None:
@@ -34,8 +40,15 @@ def main() -> None:
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer
 
+    t_start = time.perf_counter()
+    _log(f"devices: {jax.devices()}")
+    # Touch the device first so tunnel/bring-up cost is visible separately
+    # from model compile time.
+    jnp.ones((8, 8)).block_until_ready()
+    _log(f"device ready after {time.perf_counter() - t_start:.1f}s")
+
     n_chips = len(jax.devices())
-    batch = 512
+    batch = int(os.environ.get("DMP_BENCH_BATCH", "512"))
     cfg = TrainConfig(
         model=ModelConfig(name="mobilenetv2", dtype="bfloat16"),
         data=DataConfig(name="synthetic", batch_size=batch,
@@ -53,12 +66,14 @@ def main() -> None:
     rng = jax.random.key(0)
 
     # Warmup (compile) + steady-state timing.
-    for _ in range(3):
+    t0 = time.perf_counter()
+    for i in range(3):
         rng, sub = jax.random.split(rng)
         trainer.state, m = trainer._train_step(trainer.state, sub, images, labels)
-    jax.block_until_ready(m)
+        jax.block_until_ready(m)
+        _log(f"warmup step {i} done at {time.perf_counter() - t0:.1f}s")
 
-    n_steps = 20
+    n_steps = int(os.environ.get("DMP_BENCH_STEPS", "20"))
     t0 = time.perf_counter()
     for _ in range(n_steps):
         rng, sub = jax.random.split(rng)
