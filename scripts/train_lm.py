@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Transformer LM training driver over a dp x pp x tp x sp mesh.
+
+Example (8 virtual CPU devices):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/train_lm.py --dp 2 --pp 2 --tp 2 --layers 4 --steps 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--vocab", type=int, default=1024)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--d-ff", type=int, default=512)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--microbatches", type=int, default=1)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--resume", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    from distributed_model_parallel_tpu.config import MeshConfig, OptimizerConfig
+    from distributed_model_parallel_tpu.models.transformer import TransformerConfig
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+
+    if args.layers % max(args.pp, 1):
+        raise SystemExit("--layers must be divisible by --pp")
+    config = LMTrainConfig(
+        model=TransformerConfig(
+            vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
+            n_layers=args.layers, d_ff=args.d_ff,
+            max_seq_len=max(args.seq_len, 128),
+            tp_axis="model" if args.tp > 1 else None,
+            sp_axis="seq" if args.sp > 1 else None),
+        mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
+                        seq=args.sp),
+        optimizer=OptimizerConfig(learning_rate=args.lr, weight_decay=0.0,
+                                  warmup_steps=10),
+        batch_size=args.batch_size, seq_len=args.seq_len,
+        num_microbatches=args.microbatches,
+        steps_per_epoch=args.steps, epochs=args.epochs, resume=args.resume,
+    )
+    LMTrainer(config).fit()
+
+
+if __name__ == "__main__":
+    main()
