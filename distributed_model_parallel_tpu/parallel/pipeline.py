@@ -70,6 +70,7 @@ class PipelineRunner:
                  boundaries: Sequence[int] | None = None,
                  num_microbatches: int = 1,
                  augment: bool = True,
+                 schedule: str = "gpipe",
                  dtype=jnp.float32):
         self.model = model
         self.devices = list(devices)
@@ -78,6 +79,7 @@ class PipelineRunner:
         self.tx = tx
         self.num_microbatches = num_microbatches
         self.augment = augment
+        self.schedule = schedule
         self.mean, self.std, self.dtype = mean, std, dtype
 
         params, model_state = model.init(rng, jnp.zeros(sample_shape, dtype))
@@ -163,44 +165,76 @@ class PipelineRunner:
         return [tuple(a[i * (b // m):(i + 1) * (b // m)] for a in arrays)
                 for i in range(m)]
 
+    def _forward_micro(self, m, imgs, lbls, sub_rng, acts, new_states,
+                       logits_grads, micro_metrics):
+        """Forward one microbatch through all stages + loss on stage 0."""
+        S = self.num_stages
+        x = self._prep(self._to_stage(sub_rng, 0), self._to_stage(imgs, 0))
+        for s in range(S):
+            x = self._to_stage(x, s)
+            acts[m][s] = x
+            x, new_states[s] = self._fwd[s](
+                self.stages[s].params, self.stages[s].model_state, x, True)
+        # logits -> stage 0 for the loss (last→0 hop, utils.py:56).
+        loss, dlogits, mets = self._loss_grad(
+            self._to_stage(x, 0), self._to_stage(lbls, 0))
+        logits_grads[m] = dlogits
+        micro_metrics[m] = mets
+
+    def _backward_micro(self, m, acts, logits_grads, grads):
+        """Backward one microbatch: d(logits) 0→last, grads last→…→0."""
+        S = self.num_stages
+        g = self._to_stage(logits_grads[m], S - 1)   # 0→last hop
+        for s in reversed(range(S)):
+            g = self._to_stage(g, s)
+            dp, g = self._bwd[s](self.stages[s].params,
+                                 self.stages[s].model_state, acts[m][s], g)
+            grads[s] = dp if grads[s] is None else self._accum(grads[s], dp)
+        acts[m] = [None] * S                          # free stage inputs
+
+    def _schedule(self) -> list[tuple[str, int]]:
+        """Dispatch order of (op, microbatch) pairs.
+
+        "gpipe": all forwards, then all backwards (max in-flight
+        activations = M). "1f1b": after a warmup of S forwards, alternate
+        backward/forward so at most S microbatches are ever live — the
+        standard memory-optimal schedule; identical numerics.
+        """
+        S, M = self.num_stages, self.num_microbatches
+        if self.schedule == "gpipe" or M == 1:
+            return ([("F", m) for m in range(M)]
+                    + [("B", m) for m in range(M)])
+        if self.schedule == "1f1b":
+            ops: list[tuple[str, int]] = []
+            warm = min(S, M)
+            for m in range(warm):
+                ops.append(("F", m))
+            for m in range(warm, M):
+                ops.append(("B", m - warm))
+                ops.append(("F", m))
+            for m in range(M - warm, M):
+                ops.append(("B", m))
+            return ops
+        raise KeyError(f"unknown schedule {self.schedule!r}")
+
     def train_step(self, rng: jax.Array, images_u8, labels) -> dict[str, float]:
         """One optimizer step over the global batch (all microbatches)."""
         S, M = self.num_stages, self.num_microbatches
         grads: list[Any] = [None] * S
         new_states: list[Any] = [None] * S
-        total_loss = None
-        metrics_acc = None
 
-        # ---- forward wave: stage-by-stage per microbatch; async dispatch
-        # overlaps microbatches across stages (GPipe fill).
         micro = self._split(jnp.asarray(images_u8), jnp.asarray(labels))
         acts: list[list[Any]] = [[None] * S for _ in range(M)]  # stage inputs
         logits_grads: list[Any] = [None] * M
         micro_metrics: list[Any] = [None] * M
-        for m, (imgs, lbls) in enumerate(micro):
-            rng, sub = jax.random.split(rng)
-            x = self._prep(self._to_stage(sub, 0), self._to_stage(imgs, 0))
-            for s in range(S):
-                x = self._to_stage(x, s)
-                acts[m][s] = x
-                x, new_states[s] = self._fwd[s](
-                    self.stages[s].params, self.stages[s].model_state, x, True)
-            # logits -> stage 0 for the loss (last→0 hop, utils.py:56).
-            logits0 = self._to_stage(x, 0)
-            lbls0 = self._to_stage(lbls, 0)
-            loss, dlogits, mets = self._loss_grad(logits0, lbls0)
-            logits_grads[m] = dlogits
-            micro_metrics[m] = mets
 
-        # ---- backward wave: d(logits) 0→last, then grads last→…→0.
-        for m in range(M):
-            g = self._to_stage(logits_grads[m], S - 1)   # 0→last hop
-            for s in reversed(range(S)):
-                g = self._to_stage(g, s)
-                dp, g = self._bwd[s](self.stages[s].params,
-                                     self.stages[s].model_state,
-                                     acts[m][s], g)
-                grads[s] = dp if grads[s] is None else self._accum(grads[s], dp)
+        for op, m in self._schedule():
+            if op == "F":
+                rng, sub = jax.random.split(rng)
+                self._forward_micro(m, *micro[m], sub, acts, new_states,
+                                    logits_grads, micro_metrics)
+            else:
+                self._backward_micro(m, acts, logits_grads, grads)
 
         # ---- per-stage independent optimizer step (model_parallel.py:105,131,146)
         for s in range(S):

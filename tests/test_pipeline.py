@@ -24,7 +24,7 @@ from distributed_model_parallel_tpu.train.trainer import (
 
 
 def _setup(num_stages, *, model_name="tinycnn", bn="local", microbatches=1,
-           lr=0.1):
+           lr=0.1, schedule="gpipe"):
     devices = jax.devices()[:num_stages]
     model = get_model(ModelConfig(name=model_name, batchnorm=bn))
     tx = make_optimizer(OptimizerConfig(learning_rate=lr, warmup_steps=0,
@@ -32,7 +32,7 @@ def _setup(num_stages, *, model_name="tinycnn", bn="local", microbatches=1,
     runner = PipelineRunner(
         model, devices, tx=tx, rng=jax.random.key(0),
         sample_shape=(2, 32, 32, 3), mean=CIFAR10_MEAN, std=CIFAR10_STD,
-        num_microbatches=microbatches, augment=False)
+        num_microbatches=microbatches, augment=False, schedule=schedule)
     return model, tx, runner
 
 
@@ -79,6 +79,33 @@ def test_gpipe_microbatched_matches_full_batch_grad(batch):
     for a, b in zip(jax.tree.leaves(runner.merged_params()),
                     jax.tree.leaves(jax.device_get(ts.params))):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_matches_gpipe_exactly(batch):
+    """The 1F1B schedule reorders dispatch only — identical numerics."""
+    images, labels = batch
+    _, _, r_gpipe = _setup(3, bn="none", microbatches=4, schedule="gpipe")
+    _, _, r_1f1b = _setup(3, bn="none", microbatches=4, schedule="1f1b")
+    m1 = r_gpipe.train_step(jax.random.key(9), images, labels)
+    m2 = r_1f1b.train_step(jax.random.key(9), images, labels)
+    assert m1["loss"] == pytest.approx(m2["loss"], rel=1e-6)
+    for a, b in zip(jax.tree.leaves(r_gpipe.merged_params()),
+                    jax.tree.leaves(r_1f1b.merged_params())):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_1f1b_schedule_shape():
+    _, _, r = _setup(2, microbatches=4, schedule="1f1b")
+    ops = r._schedule()
+    assert ops == [("F", 0), ("F", 1), ("B", 0), ("F", 2), ("B", 1),
+                   ("F", 3), ("B", 2), ("B", 3)]
+    # every backward after its forward; all microbatches covered
+    seen_f = set()
+    for op, m in ops:
+        if op == "F":
+            seen_f.add(m)
+        else:
+            assert m in seen_f
 
 
 def test_pipeline_eval_matches_single_device(batch):
