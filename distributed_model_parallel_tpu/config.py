@@ -133,6 +133,7 @@ class TrainConfig:
     # Pipeline-specific knobs (used when mesh.stage > 1).
     num_microbatches: int = 1               # 1 == reference's naive schedule
     stage_boundaries: Sequence[int] | None = None  # unit indices; None = balanced
+    pipeline_schedule: str = "gpipe"        # "gpipe" | "1f1b"
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

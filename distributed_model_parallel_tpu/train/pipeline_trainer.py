@@ -52,7 +52,8 @@ class PipelineTrainer:
             mean=train_ds.mean, std=train_ds.std,
             boundaries=config.stage_boundaries,
             num_microbatches=config.num_microbatches,
-            augment=config.data.augment)
+            augment=config.data.augment,
+            schedule=config.pipeline_schedule)
 
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)

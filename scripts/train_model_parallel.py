@@ -39,7 +39,8 @@ def parse_args():
     p.add_argument("--model", default="mobilenetv2")
     p.add_argument("--stages", "--world-size", default=4, type=int)
     p.add_argument("--microbatches", default=1, type=int,
-                   help="1 = reference's naive schedule; >1 = GPipe")
+                   help="1 = reference's naive schedule; >1 = GPipe/1F1B")
+    p.add_argument("--schedule", default="gpipe", choices=["gpipe", "1f1b"])
     p.add_argument("--boundaries", default=None,
                    help="comma-separated unit boundaries, e.g. 0,4,10,16,19")
     p.add_argument("--lr", default=0.4, type=float)
@@ -73,6 +74,7 @@ def main():
         resume=args.resume,
         num_microbatches=args.microbatches,
         stage_boundaries=boundaries,
+        pipeline_schedule=args.schedule,
         log_name=args.log_name or f"{args.batch_size}",
     )
     from distributed_model_parallel_tpu.train.pipeline_trainer import (
