@@ -1,0 +1,23 @@
+"""Communication and kernel ops:
+
+* ``collectives`` — psum/ppermute/all_gather/reduce_scatter wrappers,
+  bucketed coalesced allreduce, unused-param reporting
+* ``ring_attention`` — ring + Ulysses sequence-parallel attention
+* ``pallas_attention`` — on-chip blockwise flash attention kernel
+* ``sparse`` — COO embedding gradients + DDP-style sparse allreduce
+* ``moe`` — top-1 routed mixture-of-experts with expert-parallel all_to_all
+"""
+
+from distributed_model_parallel_tpu.ops.collectives import (  # noqa: F401
+    all_gather_concat,
+    bucketed_psum,
+    ppermute_shift,
+    psum_mean,
+    reduce_scatter_mean,
+    unused_param_mask,
+)
+from distributed_model_parallel_tpu.ops.ring_attention import (  # noqa: F401
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,28 @@
+"""Parallelism strategies (SURVEY.md §2.3, all rows covered):
+
+* ``data_parallel`` — single-host scatter/replicate/apply/gather (DP)
+* ``ddp`` — explicit per-replica shard_map engine with psum allreduce (DDP)
+* ``zero`` — sharded-optimizer data parallelism (ZeRO 1+2)
+* ``pipeline`` — per-stage placement runtime: naive / GPipe / 1F1B (MP/PP)
+* ``spmd_pipeline`` — single-jit shard_map+ppermute pipeline (multi-host PP)
+* ``tensor_parallel`` — Megatron column/row PartitionSpecs (TP)
+"""
+
+from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: F401
+    data_parallel_apply,
+    gather,
+    parallel_apply,
+    replicate,
+    scatter,
+)
+from distributed_model_parallel_tpu.parallel.pipeline import (  # noqa: F401
+    PipelineRunner,
+    StageState,
+)
+from distributed_model_parallel_tpu.parallel.tensor_parallel import (  # noqa: F401
+    block_specs,
+    param_specs,
+)
+from distributed_model_parallel_tpu.parallel.zero import (  # noqa: F401
+    make_zero_train_step,
+)
