@@ -47,6 +47,9 @@ class TransformerConfig:
     tp_axis: str | None = None     # tensor parallel: heads/ffn sharded
     sp_axis: str | None = None     # sequence parallel: ring attention
     sp_impl: str = "ring"          # "ring" | "ulysses"
+    remat: bool = False            # jax.checkpoint each block: recompute
+                                   # activations in backward (HBM for FLOPs —
+                                   # the long-context memory lever)
 
     @property
     def head_dim(self) -> int:
@@ -137,9 +140,12 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
 
 def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
     """Run all stacked blocks with lax.scan (single device / per-stage)."""
+    apply = block_apply
+    if cfg.remat:
+        apply = jax.checkpoint(block_apply, static_argnums=(2,))
 
     def body(carry, bp):
-        return block_apply(bp, carry, cfg), None
+        return apply(bp, carry, cfg), None
 
     out, _ = jax.lax.scan(body, x, blocks)
     return out

@@ -114,6 +114,19 @@ def test_forward_shapes_and_loss(params, toks):
     assert float(loss) == pytest.approx(np.log(CFG.vocab_size), rel=0.2)
 
 
+def test_remat_matches_no_remat(params, toks):
+    """jax.checkpoint per block: same values/grads, recomputed backward."""
+    cfg_r = tfm.TransformerConfig(**{**CFG.__dict__, "remat": True})
+    l0, g0 = jax.value_and_grad(tfm.lm_loss)(params, toks[:, :-1],
+                                             toks[:, 1:], CFG)
+    l1, g1 = jax.value_and_grad(tfm.lm_loss)(params, toks[:, :-1],
+                                             toks[:, 1:], cfg_r)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_training_reduces_loss(params, toks):
     tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.5,
                                         momentum=0.9, weight_decay=0.0,
