@@ -137,6 +137,24 @@ def make_mesh(config: MeshConfig | None = None,
     return MeshSpec(mesh=Mesh(grid, names), config=config)
 
 
+def host_local_batch_to_global(batch, spec: MeshSpec,
+                               sharding: NamedSharding | None = None):
+    """Assemble a global sharded array from per-process local data.
+
+    Multi-host form of the reference's rank-0-only data loading
+    (``model_parallel.py:89-97`` loads on every rank and uses it on one):
+    each host loads only its slice of the global batch and
+    ``jax.make_array_from_process_local_data`` stitches the global
+    ``jax.Array`` across hosts. On a single process this degenerates to a
+    plain ``device_put``.
+    """
+    if sharding is None:
+        sharding = spec.batch_sharded()
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch)
+
+
 def local_batch_slice(global_batch: int, spec: MeshSpec) -> int:
     """Per-data-shard batch size; errors on uneven split (static shapes)."""
     d = spec.num_data

@@ -52,6 +52,17 @@ def test_local_batch_slice(mesh8):
         local_batch_slice(511, mesh8)
 
 
+def test_host_local_batch_to_global(mesh8):
+    from distributed_model_parallel_tpu.mesh import host_local_batch_to_global
+
+    batch = {"x": np.arange(32, dtype=np.float32).reshape(16, 2),
+             "y": np.arange(16, dtype=np.int32)}
+    out = host_local_batch_to_global(batch, mesh8)
+    assert out["x"].sharding == mesh8.batch_sharded()
+    np.testing.assert_array_equal(np.asarray(out["x"]), batch["x"])
+    np.testing.assert_array_equal(np.asarray(out["y"]), batch["y"])
+
+
 def test_psum_over_mesh(mesh8):
     """Real collective on fake devices — the core of the test strategy."""
     from jax.sharding import PartitionSpec as P
