@@ -107,6 +107,8 @@ class DataConfig:
     synthetic_ok: bool = True               # fall back to synthetic data offline
     synthetic_train_size: int = 2048
     synthetic_eval_size: int = 512
+    prefetch: int = 2                       # host-thread prefetch depth (0 = off)
+    use_native: bool = False                # C++ row-gather batch assembly
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +132,7 @@ class TrainConfig:
     checkpoint_dir: str = "./checkpoint"
     resume: bool = False                    # reference data_parallel.py:21-22,80-87
     log_every_n_steps: int = 30             # reference data_parallel.py:116
+    max_inflight_steps: int = 8             # bound on host run-ahead (async dispatch)
     # Pipeline-specific knobs (used when mesh.stage > 1).
     num_microbatches: int = 1               # 1 == reference's naive schedule
     stage_boundaries: Sequence[int] | None = None  # unit indices; None = balanced

@@ -83,27 +83,49 @@ class PrefetchLoader:
     def __iter__(self):
         q: queue.Queue = queue.Queue(maxsize=self.depth)
         sentinel = object()
+        stop = threading.Event()
         err: list[BaseException] = []
+
+        def put(item) -> bool:
+            # Bounded-wait put so the worker can never be stranded if the
+            # consumer abandons the loop mid-epoch (exception in the train
+            # step, KeyboardInterrupt, ...).
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for item in self.loader:
-                    q.put(item)
+                    if not put(item):
+                        return
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
-                q.put(sentinel)
+                put(sentinel)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        t.join()
-        if err:
-            raise err[0]
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join()
+            if err:
+                raise err[0]
+
+
+def maybe_prefetch(loader: Iterable, depth: int) -> Iterable:
+    """Wrap ``loader`` in a PrefetchLoader when ``depth > 0`` (else as-is)."""
+    return PrefetchLoader(loader, depth=depth) if depth > 0 else loader
 
 
 def normalize(images_u8: jnp.ndarray, mean: np.ndarray, std: np.ndarray,

@@ -68,3 +68,15 @@ class StepTimer:
         now = time.perf_counter()
         self.step.update(now - self._mark)
         self._mark = now
+
+    def window_done(self, n_steps: int):
+        """Attribute the time since the last mark to ``n_steps`` batches.
+
+        For async-dispatch loops that only synchronize every N steps: the
+        window's wall time (dispatch + the blocking drain) is compute time
+        spread evenly over the window's batches. No-op for an empty window.
+        """
+        now = time.perf_counter()
+        if n_steps > 0:
+            self.step.update((now - self._mark) / n_steps, n_steps)
+        self._mark = now

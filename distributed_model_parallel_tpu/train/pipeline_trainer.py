@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_model_parallel_tpu.config import TrainConfig
-from distributed_model_parallel_tpu.data.loader import BatchLoader
+from distributed_model_parallel_tpu.data.loader import BatchLoader, maybe_prefetch
 from distributed_model_parallel_tpu.data.registry import load_dataset
 from distributed_model_parallel_tpu.models import get_model
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineRunner
@@ -38,10 +38,13 @@ class PipelineTrainer:
         self.train_ds, self.eval_ds = train_ds, eval_ds
         self.train_loader = BatchLoader(train_ds, config.data.batch_size,
                                         shuffle=config.data.shuffle,
-                                        seed=config.data.seed)
+                                        seed=config.data.seed,
+                                        use_native=config.data.use_native,
+                                        num_workers=config.data.num_workers)
         self.eval_loader = BatchLoader(
             eval_ds, min(config.data.eval_batch_size, len(eval_ds)),
-            shuffle=False)
+            shuffle=False, use_native=config.data.use_native,
+            num_workers=config.data.num_workers)
 
         model = get_model(config.model)
         tx = make_optimizer(config.optimizer, len(self.train_loader),
@@ -85,6 +88,7 @@ class PipelineTrainer:
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
         timer = StepTimer()
         loader = self.train_loader if train else self.eval_loader
+        loader = maybe_prefetch(loader, self.config.data.prefetch)
         for i, (images, labels) in enumerate(loader):
             timer.data_ready()
             if train:

@@ -30,6 +30,7 @@ from distributed_model_parallel_tpu.config import TrainConfig
 from distributed_model_parallel_tpu.data.loader import (
     BatchLoader,
     augment_batch,
+    maybe_prefetch,
     normalize,
 )
 from distributed_model_parallel_tpu.data.registry import ArrayDataset, load_dataset
@@ -121,10 +122,12 @@ class Trainer:
 
         self.train_loader = BatchLoader(
             train_ds, config.data.batch_size, shuffle=config.data.shuffle,
-            seed=config.data.seed)
+            seed=config.data.seed, use_native=config.data.use_native,
+            num_workers=config.data.num_workers)
         self.eval_loader = BatchLoader(
             eval_ds, min(config.data.eval_batch_size, len(eval_ds)),
-            shuffle=False)
+            shuffle=False, use_native=config.data.use_native,
+            num_workers=config.data.num_workers)
 
         self.tx = make_optimizer(config.optimizer, len(self.train_loader),
                                  config.epochs)
@@ -180,6 +183,7 @@ class Trainer:
         else:
             raise KeyError(f"unknown strategy {config.strategy!r}")
 
+        self._max_inflight = max(1, config.max_inflight_steps)
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.best_acc = 0.0
@@ -209,40 +213,68 @@ class Trainer:
         return (jax.device_put(images, self._batch_sh),
                 jax.device_put(labels, self._batch_sh))
 
-    def train_epoch(self, epoch: int) -> EpochResult:
-        meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
-        timer = StepTimer()
-        for i, (images, labels) in enumerate(self.train_loader):
-            images, labels = self._shard_batch(images, labels)
-            timer.data_ready()
-            self._rng, sub = jax.random.split(self._rng)
-            self.state, metrics = self._train_step(self.state, sub, images, labels)
-            metrics = jax.device_get(metrics)
-            timer.step_done()
+    def _prefetched(self, loader):
+        return maybe_prefetch(loader, self.config.data.prefetch)
+
+    @staticmethod
+    def _drain(pending: list, meters: dict) -> None:
+        """Fetch queued device metrics and fold them into the meters.
+
+        Metrics are held as device arrays between sync points so the host
+        never blocks on a step it doesn't need yet — step k+1 dispatches
+        while step k still runs (async dispatch). The reference instead
+        syncs every batch via ``.item()`` on loss/accuracy (``utils.py:64-68``).
+        """
+        for metrics in jax.device_get(pending):
             b = float(metrics["batch"])
             meters["loss"].update(float(metrics["loss"]), int(b))
             meters["acc1"].update(float(metrics["correct@1"]) / b * 100, int(b))
             meters["acc5"].update(float(metrics["correct@5"]) / b * 100, int(b))
-            if i % self.config.log_every_n_steps == 0:
+        pending.clear()
+
+    def train_epoch(self, epoch: int) -> EpochResult:
+        meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
+        timer = StepTimer()
+        pending: list = []
+        for i, (images, labels) in enumerate(self._prefetched(self.train_loader)):
+            images, labels = self._shard_batch(images, labels)
+            timer.data_ready()
+            self._rng, sub = jax.random.split(self._rng)
+            self.state, metrics = self._train_step(self.state, sub, images, labels)
+            pending.append(metrics)
+            log_now = i % self.config.log_every_n_steps == 0
+            if log_now or len(pending) >= self._max_inflight:
+                n = len(pending)
+                self._drain(pending, meters)    # blocks: sync point
+                timer.window_done(n)
+            if log_now:
                 self.logger.log_step(epoch, i, loss=meters["loss"].avg,
                                      acc1=meters["acc1"].avg,
                                      step_time=timer.step.avg,
                                      data_time=timer.data.avg)
+        n = len(pending)
+        self._drain(pending, meters)
+        timer.window_done(n)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
                            meters["acc5"].avg, timer.step.avg, timer.data.avg)
 
     def evaluate(self) -> EpochResult:
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
         timer = StepTimer()
-        for images, labels in self.eval_loader:
+        pending: list = []
+        for images, labels in self._prefetched(self.eval_loader):
             images, labels = self._shard_batch(images, labels)
             timer.data_ready()
-            metrics = jax.device_get(self._eval_step(self.state, images, labels))
-            timer.step_done()
-            b = float(metrics["batch"])
-            meters["loss"].update(float(metrics["loss"]), int(b))
-            meters["acc1"].update(float(metrics["correct@1"]) / b * 100, int(b))
-            meters["acc5"].update(float(metrics["correct@5"]) / b * 100, int(b))
+            pending.append(self._eval_step(self.state, images, labels))
+            if len(pending) >= self._max_inflight:
+                # Bound host run-ahead so in-flight eval batches can't pile
+                # up in device memory on large eval sets.
+                n = len(pending)
+                self._drain(pending, meters)
+                timer.window_done(n)
+        n = len(pending)
+        self._drain(pending, meters)
+        timer.window_done(n)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
                            meters["acc5"].avg, timer.step.avg, timer.data.avg)
 

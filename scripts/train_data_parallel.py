@@ -20,6 +20,29 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def _force_cpu_devices_from_argv() -> None:
+    """When running on the CPU backend (``JAX_PLATFORMS=cpu``), honor
+    ``--num-devices N`` by creating N virtual devices. Must run before the
+    backend initializes, hence this pre-parse of argv."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        n = (a.split("=", 1)[1] if a.startswith("--num-devices=")
+             else argv[i + 1] if a == "--num-devices" and i + 1 < len(argv)
+             else None)
+        if n and n.isdigit() and int(n) > 1:
+            # jax may have been imported at interpreter startup with another
+            # platform baked in; override before the backend initializes.
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", int(n))
+            return
+
+
+_force_cpu_devices_from_argv()
+
 from distributed_model_parallel_tpu.config import (
     DataConfig,
     MeshConfig,
@@ -53,6 +76,10 @@ def parse_args():
     p.add_argument("--bucket-mb", type=int, default=0,
                    help="DDP gradient bucket size in MiB (0 = per-leaf psum)")
     p.add_argument("--no-augment", action="store_true")
+    p.add_argument("--prefetch", default=2, type=int,
+                   help="host prefetch depth (0 disables)")
+    p.add_argument("--native-loader", action="store_true",
+                   help="assemble batches with the C++ row-gather")
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     p.add_argument("--num-devices", default=0, type=int,
                    help="data-parallel width (0 = all visible devices)")
@@ -73,7 +100,8 @@ def main():
                           dtype="bfloat16" if args.bf16 else "float32"),
         data=DataConfig(name=args.dataset_type, root=args.data,
                         batch_size=args.batch_size, num_workers=args.workers,
-                        augment=not args.no_augment),
+                        augment=not args.no_augment, prefetch=args.prefetch,
+                        use_native=args.native_loader),
         optimizer=OptimizerConfig(
             learning_rate=args.lr, momentum=args.momentum,
             weight_decay=args.wd,

@@ -6,6 +6,7 @@ decreases, checkpoints restore exactly, and the DP-sharded step equals the
 single-device step.
 """
 
+import dataclasses
 import numpy as np
 import pytest
 
@@ -138,3 +139,19 @@ def test_dp_sharded_step_matches_single_device(tmp_path):
     for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
                     jax.tree.leaves(jax.device_get(s8.params))):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_prefetch_matches_synchronous(tmp_path):
+    """Prefetch + deferred metric fetch must not change training results:
+    same seeds -> bitwise-identical epoch history with prefetch on/off."""
+    def run(prefetch, sub):
+        cfg = tiny_config(tmp_path / sub)
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, prefetch=prefetch))
+        return Trainer(cfg).fit(epochs=2)
+
+    h_sync = run(0, "sync")
+    h_pre = run(2, "pre")
+    for a, b in zip(h_sync, h_pre):
+        assert a["loss_train"] == pytest.approx(b["loss_train"], rel=1e-6)
+        assert a["acc1_val"] == pytest.approx(b["acc1_val"])
