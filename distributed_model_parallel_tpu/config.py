@@ -127,6 +127,7 @@ class TrainConfig:
     # averaging and per-replica BatchNorm (parallel/ddp.py).
     strategy: str = "gspmd"
     ddp_bucket_bytes: int | None = None     # None = per-leaf psum
+    ddp_allreduce: str = "psum"             # "psum" | "bucketed" | "ring"
     log_dir: str = "./log"
     log_name: str = "train"
     checkpoint_dir: str = "./checkpoint"

@@ -2,6 +2,8 @@
 
 * ``collectives`` — psum/ppermute/all_gather/reduce_scatter wrappers,
   bucketed coalesced allreduce, unused-param reporting
+* ``ring_reduce`` — explicit bandwidth-optimal ring allreduce/reduce-scatter
+  (the DDP Reducer's wire algorithm) over neighbor ppermutes
 * ``ring_attention`` — ring + Ulysses sequence-parallel attention
 * ``pallas_attention`` — on-chip blockwise flash attention kernel
 * ``sparse`` — COO embedding gradients + DDP-style sparse allreduce
@@ -15,6 +17,11 @@ from distributed_model_parallel_tpu.ops.collectives import (  # noqa: F401
     psum_mean,
     reduce_scatter_mean,
     unused_param_mask,
+)
+from distributed_model_parallel_tpu.ops.ring_reduce import (  # noqa: F401
+    ring_all_reduce,
+    ring_psum_tree,
+    ring_reduce_scatter,
 )
 from distributed_model_parallel_tpu.ops.ring_attention import (  # noqa: F401
     full_attention,

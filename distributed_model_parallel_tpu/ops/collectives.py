@@ -84,21 +84,27 @@ def plan_buckets(tree: Any, bucket_bytes: int = 25 * 1024 * 1024
 
 def bucketed_psum(tree: Any, axis_name: str, *,
                   bucket_bytes: int = 25 * 1024 * 1024,
-                  mean: bool = True) -> Any:
+                  mean: bool = True, reduce_fn: Any = None) -> Any:
     """Allreduce a gradient pytree in flat coalesced buckets.
 
     Each bucket is flattened+concatenated into one vector, reduced with a
     single ``psum``, then split back — mirroring
     ``_broadcast_coalesced``/Reducer bucketing (``Readme.md:49-56,148-157``)
     with XLA free to overlap bucket collectives with compute.
+
+    ``reduce_fn(flat, axis_name) -> flat`` swaps the transport (default
+    ``lax.psum``; see ``ops/ring_reduce.ring_psum_tree`` for the explicit
+    ring).
     """
+    if reduce_fn is None:
+        reduce_fn = jax.lax.psum
     leaves, treedef = jax.tree.flatten(tree)
     n = jax.lax.psum(1, axis_name) if mean else 1
     out: list[Any] = [None] * len(leaves)
     for bucket in plan_buckets(tree, bucket_bytes):
         flat = jnp.concatenate(
             [leaves[i].astype(jnp.float32).reshape(-1) for i in bucket])
-        red = jax.lax.psum(flat, axis_name)
+        red = reduce_fn(flat, axis_name)
         if mean:
             red = red / n
         offset = 0

@@ -1,0 +1,125 @@
+"""Explicit ring allreduce: the DDP Reducer's wire algorithm, on ICI.
+
+The reference analyzes (but never implements) NCCL's bucketed ring-allreduce
+inside PyTorch's C++ ``Reducer`` (reference ``Readme.md:14,148-157``). On TPU
+the idiomatic move is a single ``lax.psum`` and letting XLA pick the
+algorithm — that is what the DDP path defaults to. This module implements the
+classic bandwidth-optimal ring explicitly — N-1 reduce-scatter steps + N-1
+all-gather steps over neighbor ``ppermute``s, each moving 1/N of the buffer,
+total traffic 2(N-1)/N of the buffer per device — for three reasons:
+
+* parity: it is the actual algorithm the reference's analysis documents;
+* benchmarking: comparing it against ``psum`` exposes what XLA's built-in
+  collective achieves on the same mesh;
+* control: neighbor-only ``ppermute`` traffic is guaranteed to ride ICI
+  ring links, never DCN, which matters on multi-slice meshes.
+
+Chunk convention matches ``lax.psum_scatter(..., tiled=True)``: device i ends
+the reduce-scatter phase owning reduced chunk i.
+
+All functions must be called inside ``shard_map`` over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.ops.collectives import bucketed_psum
+
+
+def _neighbor_perm(n: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _reduce_scatter_phase(chunks: jax.Array, axis_name: str) -> jax.Array:
+    """N-1 steps; afterwards device i's row i holds sum of all devices' row i.
+
+    At step s, device i sends chunk (i - s - 1) mod N to its right neighbor
+    and accumulates the incoming chunk (i - s - 2) mod N.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = _neighbor_perm(n)
+
+    def step(s, chunks):
+        send = chunks[(idx - s - 1) % n]
+        recv = jax.lax.ppermute(send, axis_name, perm)
+        return chunks.at[(idx - s - 2) % n].add(recv)
+
+    return jax.lax.fori_loop(0, n - 1, step, chunks)
+
+
+def _all_gather_phase(chunks: jax.Array, axis_name: str) -> jax.Array:
+    """N-1 steps; starting from device i owning reduced chunk i, afterwards
+    every device holds all reduced chunks.
+
+    At step s, device i sends chunk (i - s) mod N and stores the incoming
+    chunk (i - s - 1) mod N.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = _neighbor_perm(n)
+
+    def step(s, chunks):
+        send = chunks[(idx - s) % n]
+        recv = jax.lax.ppermute(send, axis_name, perm)
+        return chunks.at[(idx - s - 1) % n].set(recv)
+
+    return jax.lax.fori_loop(0, n - 1, step, chunks)
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str, *, mean: bool = False
+                    ) -> jax.Array:
+    """Allreduce ``x`` over ``axis_name`` via the explicit 2-phase ring.
+
+    Result equals ``lax.psum(x, axis_name)`` (divided by N when ``mean``),
+    for any shape — the buffer is flattened and zero-padded to N chunks.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    shape, size = x.shape, x.size
+    flat = x.reshape(-1)
+    pad = (-size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n, -1)
+    chunks = _reduce_scatter_phase(chunks, axis_name)
+    chunks = _all_gather_phase(chunks, axis_name)
+    out = chunks.reshape(-1)[:size].reshape(shape)
+    return out / n if mean else out
+
+
+def ring_reduce_scatter(x: jax.Array, axis_name: str, *, mean: bool = False
+                        ) -> jax.Array:
+    """Reduce-scatter over the ring: device i gets slice i of the reduced
+    buffer — same semantics as ``lax.psum_scatter(..., tiled=True)`` along
+    axis 0. Requires ``x.shape[0] % N == 0``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if x.shape[0] % n:
+        raise ValueError(f"leading dim {x.shape[0]} not divisible by {n}")
+    idx = jax.lax.axis_index(axis_name)
+    chunks = x.reshape(n, x.shape[0] // n, *x.shape[1:])
+    chunks = _reduce_scatter_phase(chunks, axis_name)
+    out = chunks[idx]
+    return out / n if mean else out
+
+
+def ring_psum_tree(tree: Any, axis_name: str, *,
+                   bucket_bytes: int = 25 * 1024 * 1024,
+                   mean: bool = True) -> Any:
+    """Bucketed ring allreduce of a gradient pytree.
+
+    Drop-in for ``collectives.bucketed_psum`` but with the explicit ring as
+    transport: leaves are coalesced into flat size-capped buckets (the DDP
+    Reducer's trick, reference ``Readme.md:148-157``), each bucket makes one
+    trip around the ring.
+    """
+    return bucketed_psum(tree, axis_name, bucket_bytes=bucket_bytes,
+                         mean=mean, reduce_fn=ring_all_reduce)

@@ -34,6 +34,7 @@ from distributed_model_parallel_tpu.data.loader import augment_batch, normalize
 from distributed_model_parallel_tpu.mesh import MeshSpec
 from distributed_model_parallel_tpu.models.staged import StagedModel
 from distributed_model_parallel_tpu.ops.collectives import bucketed_psum, psum_mean
+from distributed_model_parallel_tpu.ops.ring_reduce import ring_psum_tree
 from distributed_model_parallel_tpu.train.metrics import topk_correct
 from distributed_model_parallel_tpu.train.trainer import TrainState, cross_entropy
 
@@ -46,15 +47,22 @@ def replicate_model_state(state: Any, num_replicas: int) -> Any:
 
 def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
                         spec: MeshSpec, *, mean, std, augment: bool = True,
-                        dtype=jnp.float32, bucket_bytes: int | None = None
-                        ) -> Callable:
+                        dtype=jnp.float32, bucket_bytes: int | None = None,
+                        allreduce: str = "psum") -> Callable:
     """Returns jitted step(state, rng, images_u8, labels) -> (state, metrics).
 
     ``state.model_state`` must carry a leading per-replica axis
-    (``replicate_model_state``). ``bucket_bytes=None`` uses per-leaf psum;
-    otherwise the coalesced bucketed allreduce.
+    (``replicate_model_state``). ``allreduce`` picks the gradient transport:
+    "psum" (per-leaf, XLA chooses the algorithm), "bucketed" (flat coalesced
+    buckets of ``bucket_bytes``), or "ring" (explicit bandwidth-optimal
+    neighbor-ppermute ring, ``ops/ring_reduce.py``). ``bucket_bytes`` set
+    with allreduce="psum" implies "bucketed" for backward compatibility.
     """
     axis = spec.data_axis
+    if allreduce == "psum" and bucket_bytes is not None:
+        allreduce = "bucketed"
+    if allreduce not in ("psum", "bucketed", "ring"):
+        raise KeyError(f"unknown allreduce {allreduce!r}")
 
     def loss_fn(params, model_state, images, labels):
         logits, new_state = model.apply(params, model_state, images, train=True)
@@ -71,10 +79,16 @@ def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
             loss_fn, has_aux=True)(state.params, local_state, images, labels)
 
         # The Reducer equivalent: average gradients across replicas.
-        if bucket_bytes is None:
-            grads = psum_mean(grads, axis)
+        if allreduce == "ring":
+            grads = ring_psum_tree(
+                grads, axis, **({} if bucket_bytes is None
+                                else {"bucket_bytes": bucket_bytes}))
+        elif allreduce == "bucketed":
+            grads = bucketed_psum(
+                grads, axis, **({} if bucket_bytes is None
+                                else {"bucket_bytes": bucket_bytes}))
         else:
-            grads = bucketed_psum(grads, axis, bucket_bytes=bucket_bytes)
+            grads = psum_mean(grads, axis)
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -161,7 +161,8 @@ class Trainer:
             self._train_step = make_ddp_train_step(
                 self.model, self.tx, self.spec,
                 augment=config.data.augment,
-                bucket_bytes=config.ddp_bucket_bytes, **kw)
+                bucket_bytes=config.ddp_bucket_bytes,
+                allreduce=config.ddp_allreduce, **kw)
             self._eval_step = make_ddp_eval_step(self.model, self.spec, **kw)
         elif config.strategy == "gspmd":
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,

@@ -75,6 +75,9 @@ def parse_args():
                         "psum grad averaging) instead of GSPMD")
     p.add_argument("--bucket-mb", type=int, default=0,
                    help="DDP gradient bucket size in MiB (0 = per-leaf psum)")
+    p.add_argument("--allreduce", default="psum",
+                   choices=["psum", "bucketed", "ring"],
+                   help="DDP gradient allreduce implementation")
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--prefetch", default=2, type=int,
                    help="host prefetch depth (0 disables)")
@@ -92,6 +95,11 @@ def main():
     best_effort_distributed_init()
     import jax
 
+    if not args.ddp and (args.allreduce != "psum" or args.bucket_mb):
+        print("warning: --allreduce/--bucket-mb select the explicit DDP "
+              "gradient transport; without --ddp the GSPMD path lets XLA "
+              "insert the allreduce and these flags have no effect",
+              file=sys.stderr)
     n = args.num_devices or len(jax.devices())
     steps_per_epoch = max(1, 50000 // args.batch_size)
     config = TrainConfig(
@@ -111,6 +119,7 @@ def main():
         resume=args.resume,
         strategy="ddp" if args.ddp else "gspmd",
         ddp_bucket_bytes=args.bucket_mb * 1024 * 1024 or None,
+        ddp_allreduce=args.allreduce,
         log_name=args.log_name or f"data_para_{args.batch_size}",
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer
