@@ -62,5 +62,10 @@ def get_model(config: ModelConfig, *, axis_name: str | None = None) -> StagedMod
     if name == "embedding_bow":
         from distributed_model_parallel_tpu.models.embedding import build_embedding_bow
         return build_embedding_bow(config)
-    raise KeyError(f"unknown model {name!r}; known: mobilenetv2[_nobn], "
-                   f"resnet18/34/50, transformer, embedding_bow")
+    from distributed_model_parallel_tpu.models.zoo import ZOO_BUILDERS
+    if name in ZOO_BUILDERS:
+        return ZOO_BUILDERS[name](**_cnn_kwargs(config, axis_name))
+    raise KeyError(
+        f"unknown model {name!r}; known: mobilenetv2[_nobn], resnet18/34/50, "
+        f"tinycnn, transformer, embedding_bow, "
+        f"{', '.join(sorted(ZOO_BUILDERS))}")

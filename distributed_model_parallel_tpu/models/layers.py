@@ -43,9 +43,11 @@ class ConvUnit(nn.Module):
     """Conv → (BN) → (activation), one or more times.
 
     ``ops`` is a sequence of dicts with keys: features, kernel, stride,
-    groups, act (bool). A ``feature_group_count == features`` conv is a
-    depthwise conv (MXU-friendly form of the reference's ``groups=planes``
-    depthwise, ``model/mobilenetv2.py:19``).
+    groups, act (bool), norm (bool — set False for a bare conv, e.g. the
+    pre-activation stems where the first block's BN comes first). A
+    ``feature_group_count == features`` conv is a depthwise conv
+    (MXU-friendly form of the reference's ``groups=planes`` depthwise,
+    ``model/mobilenetv2.py:19``).
     """
 
     ops: Sequence[dict]
@@ -59,19 +61,21 @@ class ConvUnit(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool):
         for i, op in enumerate(self.ops):
+            normed = op.get("norm", True)
             x = nn.Conv(
                 features=op["features"],
                 kernel_size=(op.get("kernel", 3),) * 2,
                 strides=(op.get("stride", 1),) * 2,
                 padding=op.get("padding", "SAME"),
                 feature_group_count=op.get("groups", 1),
-                use_bias=self.bn_mode == "none",
+                use_bias=self.bn_mode == "none" or not normed,
                 dtype=self.dtype,
                 name=f"conv{i}",
             )(x)
-            x = _norm(self.bn_mode, momentum=self.bn_momentum,
-                      epsilon=self.bn_epsilon, dtype=self.dtype,
-                      axis_name=self.axis_name, name=f"bn{i}")(x, train)
+            if normed:
+                x = _norm(self.bn_mode, momentum=self.bn_momentum,
+                          epsilon=self.bn_epsilon, dtype=self.dtype,
+                          axis_name=self.axis_name, name=f"bn{i}")(x, train)
             if op.get("act", True):
                 x = self.activation(x)
         return x

@@ -1,0 +1,706 @@
+"""Extended CIFAR model zoo: the reference's full model menu.
+
+The reference's DP driver carries a commented-out menu of 15 architectures
+(``data_parallel.py:58-73``): VGG, ResNet, PreActResNet, GoogLeNet, DenseNet,
+ResNeXt, MobileNet(v1), MobileNetV2, DPN, ShuffleNet(G2), SENet, ShuffleNetV2,
+EfficientNet-B0, RegNetX-200MF, SimpleDLA. MobileNetV2 and ResNet live in
+their own modules; this module provides the rest, each expressed as a staged
+unit sequence (``models/staged.py``) so every zoo member works under every
+parallelism strategy (DP/DDP/pipeline) unchanged.
+
+All models are CIFAR-adapted (stride-1 3x3 stems, no stem max-pool) in the
+same convention the reference uses for MobileNetV2
+(``model/mobilenetv2.py:42,51,72``), NHWC layout, and share the three
+BatchNorm modes (local / sync / none) from ``models/layers.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models.layers import (
+    ClassifierHead,
+    ConvUnit,
+    _norm,
+)
+from distributed_model_parallel_tpu.models.staged import StagedModel
+
+
+class _ZooModule(nn.Module):
+    """Shared hyperparameter plumbing for zoo blocks."""
+
+    bn_mode: str = "local"
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    axis_name: str | None = None
+
+    @property
+    def use_bias(self) -> bool:
+        return self.bn_mode == "none"
+
+    def norm(self, name: str):
+        return _norm(self.bn_mode, momentum=self.bn_momentum,
+                     epsilon=self.bn_epsilon, dtype=self.dtype,
+                     axis_name=self.axis_name, name=name)
+
+    def conv(self, features: int, kernel: int = 3, stride: int = 1,
+             groups: int = 1, name: str = "conv"):
+        return nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                       padding="SAME", feature_group_count=groups,
+                       use_bias=self.use_bias, dtype=self.dtype, name=name)
+
+    def cbr(self, x, features: int, *, train: bool, kernel: int = 3,
+            stride: int = 1, groups: int = 1, act: bool = True,
+            name: str = "conv"):
+        """conv → norm → (relu)."""
+        x = self.conv(features, kernel, stride, groups, name=name)(x)
+        x = self.norm(f"{name}_bn")(x, train)
+        return nn.relu(x) if act else x
+
+
+_HPARAM_FIELDS = ("bn_mode", "bn_momentum", "bn_epsilon", "dtype", "axis_name")
+_HPARAM_DEFAULTS = {"bn_mode": "local", "bn_momentum": 0.9,
+                    "bn_epsilon": 1e-5, "dtype": jnp.float32,
+                    "axis_name": None}
+
+
+def _common(kw: dict) -> dict:
+    return {k: kw.get(k, _HPARAM_DEFAULTS[k]) for k in _HPARAM_FIELDS}
+
+
+def _channel_shuffle(x, groups: int):
+    """(N,H,W,C) channel shuffle across ``groups``."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(n, h, w, c)
+
+
+# ---------------------------------------------------------------------------
+# VGG
+# ---------------------------------------------------------------------------
+
+VGG_CFG = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+              512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGGUnit(_ZooModule):
+    """One 3x3 conv-BN-ReLU, optionally followed by a 2x2 max-pool."""
+
+    features: int = 64
+    pool: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        x = self.cbr(x, self.features, train=train)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+def build_vgg(arch: str = "vgg16", num_classes: int = 10, **kw) -> StagedModel:
+    cfg = VGG_CFG[arch]
+    units: list[nn.Module] = []
+    i = 0
+    while i < len(cfg):
+        feats = cfg[i]
+        pool = i + 1 < len(cfg) and cfg[i + 1] == "M"
+        units.append(VGGUnit(features=feats, pool=pool, **_common(kw)))
+        i += 2 if pool else 1
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name=arch)
+
+
+# ---------------------------------------------------------------------------
+# PreActResNet / SENet
+# ---------------------------------------------------------------------------
+
+
+class PreActBlock(_ZooModule):
+    """Pre-activation residual block (BN→ReLU→conv ×2), optional SE gate.
+
+    ``se_ratio > 0`` turns this into the SENet-18 block: a squeeze-excite
+    recalibration on the residual branch before the add.
+    """
+
+    features: int = 64
+    stride: int = 1
+    se_ratio: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        pre = nn.relu(self.norm("pre_bn")(x, train))
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = self.conv(self.features, 1, self.stride,
+                                 name="shortcut")(pre)
+        y = self.conv(self.features, 3, self.stride, name="conv0")(pre)
+        y = nn.relu(self.norm("bn0")(y, train))
+        y = self.conv(self.features, 3, 1, name="conv1")(y)
+        if self.se_ratio > 0:
+            squeezed = max(1, int(self.features * self.se_ratio))
+            w = jnp.mean(y, axis=(1, 2), keepdims=True)
+            w = nn.Conv(squeezed, (1, 1), dtype=self.dtype, name="se_fc0")(w)
+            w = nn.relu(w)
+            w = nn.Conv(self.features, (1, 1), dtype=self.dtype,
+                        name="se_fc1")(w)
+            y = y * nn.sigmoid(w)
+        return y + shortcut
+
+
+def _build_preact(name: str, num_classes: int, se_ratio: float,
+                  **kw) -> StagedModel:
+    # Bare conv stem: the first block's pre-activation BN normalizes it.
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 64, "kernel": 3, "stride": 1,
+                       "act": False, "norm": False},), **_common(kw))
+    ]
+    for g, (feats, blocks) in enumerate(
+            zip((64, 128, 256, 512), (2, 2, 2, 2))):
+        for b in range(blocks):
+            units.append(PreActBlock(
+                features=feats, stride=(2 if g > 0 and b == 0 else 1),
+                se_ratio=se_ratio, **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name=name)
+
+
+def build_preact_resnet18(num_classes: int = 10, **kw) -> StagedModel:
+    return _build_preact("preactresnet18", num_classes, 0.0, **kw)
+
+
+def build_senet18(num_classes: int = 10, **kw) -> StagedModel:
+    """SENet-18: PreAct blocks with squeeze-excite (ratio 1/16)."""
+    return _build_preact("senet18", num_classes, 1.0 / 16.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet
+# ---------------------------------------------------------------------------
+
+# (n1x1, n3x3red, n3x3, n5x5red, n5x5, pool_planes), pre-pool flag
+GOOGLE_CFG = (
+    ((64, 96, 128, 16, 32, 32), False),
+    ((128, 128, 192, 32, 96, 64), True),     # max-pool after b3
+    ((192, 96, 208, 16, 48, 64), False),
+    ((160, 112, 224, 24, 64, 64), False),
+    ((128, 128, 256, 24, 64, 64), False),
+    ((112, 144, 288, 32, 64, 64), False),
+    ((256, 160, 320, 32, 128, 128), True),   # max-pool after e4
+    ((256, 160, 320, 32, 128, 128), False),
+    ((384, 192, 384, 48, 128, 128), False),
+)
+
+
+class Inception(_ZooModule):
+    """Four-branch inception module; 5x5 realized as two 3x3 convs."""
+
+    spec: tuple = (64, 96, 128, 16, 32, 32)
+    pool_after: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        n1, n3r, n3, n5r, n5, npool = self.spec
+        b1 = self.cbr(x, n1, train=train, kernel=1, name="b1")
+        b2 = self.cbr(x, n3r, train=train, kernel=1, name="b2a")
+        b2 = self.cbr(b2, n3, train=train, kernel=3, name="b2b")
+        b3 = self.cbr(x, n5r, train=train, kernel=1, name="b3a")
+        b3 = self.cbr(b3, n5, train=train, kernel=3, name="b3b")
+        b3 = self.cbr(b3, n5, train=train, kernel=3, name="b3c")
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = self.cbr(b4, npool, train=train, kernel=1, name="b4")
+        y = jnp.concatenate([b1, b2, b3, b4], axis=-1)
+        if self.pool_after:
+            y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        return y
+
+
+def build_googlenet(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 192, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for spec, pool_after in GOOGLE_CFG:
+        units.append(Inception(spec=spec, pool_after=pool_after, **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="googlenet")
+
+
+# ---------------------------------------------------------------------------
+# DenseNet-121
+# ---------------------------------------------------------------------------
+
+
+class DenseBlock(_ZooModule):
+    """``num_layers`` bottleneck layers (BN→ReLU→1x1→BN→ReLU→3x3, concat),
+    optionally followed by a transition (BN→1x1 compress→avg-pool 2)."""
+
+    num_layers: int = 6
+    growth: int = 32
+    transition: bool = True
+    reduction: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        for i in range(self.num_layers):
+            y = nn.relu(self.norm(f"l{i}_bn0")(x, train))
+            y = self.conv(4 * self.growth, 1, name=f"l{i}_conv0")(y)
+            y = nn.relu(self.norm(f"l{i}_bn1")(y, train))
+            y = self.conv(self.growth, 3, name=f"l{i}_conv1")(y)
+            x = jnp.concatenate([x, y], axis=-1)
+        if self.transition:
+            x = nn.relu(self.norm("t_bn")(x, train))
+            x = self.conv(int(x.shape[-1] * self.reduction), 1, name="t_conv")(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class DenseHead(_ZooModule):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        x = nn.relu(self.norm("bn")(x, train))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="linear")(x)
+
+
+def build_densenet121(num_classes: int = 10, **kw) -> StagedModel:
+    growth = 32
+    # Bare conv stem: the first dense layer's BN normalizes it.
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 2 * growth, "kernel": 3, "stride": 1,
+                       "act": False, "norm": False},), **_common(kw))
+    ]
+    for i, num_layers in enumerate((6, 12, 24, 16)):
+        units.append(DenseBlock(num_layers=num_layers, growth=growth,
+                                transition=(i < 3), **_common(kw)))
+    units.append(DenseHead(num_classes=num_classes, **_common(kw)))
+    return StagedModel(units=tuple(units), name="densenet121")
+
+
+# ---------------------------------------------------------------------------
+# ResNeXt-29 (2x64d)
+# ---------------------------------------------------------------------------
+
+
+class ResNeXtBlock(_ZooModule):
+    """1x1 → grouped 3x3 → 1x1 (expansion 2) with projected shortcut."""
+
+    cardinality: int = 2
+    width: int = 64
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        group_width = self.cardinality * self.width
+        out_features = 2 * group_width
+        y = self.cbr(x, group_width, train=train, kernel=1, name="conv0")
+        y = self.cbr(y, group_width, train=train, kernel=3,
+                     stride=self.stride, groups=self.cardinality, name="conv1")
+        y = self.cbr(y, out_features, train=train, kernel=1, act=False,
+                     name="conv2")
+        if self.stride != 1 or x.shape[-1] != out_features:
+            x = self.conv(out_features, 1, self.stride, name="shortcut")(x)
+            x = self.norm("shortcut_bn")(x, train)
+        return nn.relu(y + x)
+
+
+def build_resnext29_2x64d(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 64, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    width = 64
+    for g in range(3):
+        for b in range(3):
+            units.append(ResNeXtBlock(
+                cardinality=2, width=width,
+                stride=(2 if g > 0 and b == 0 else 1), **_common(kw)))
+        width *= 2
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="resnext29_2x64d")
+
+
+# ---------------------------------------------------------------------------
+# MobileNet (v1)
+# ---------------------------------------------------------------------------
+
+MOBILENET_CFG = (64, (128, 2), 128, (256, 2), 256, (512, 2),
+                 512, 512, 512, 512, 512, (1024, 2), 1024)
+
+
+class DepthwiseSeparable(_ZooModule):
+    """Depthwise 3x3 → pointwise 1x1, BN+ReLU after each."""
+
+    features: int = 64
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        c = x.shape[-1]
+        x = self.cbr(x, c, train=train, kernel=3, stride=self.stride,
+                     groups=c, name="dw")
+        return self.cbr(x, self.features, train=train, kernel=1, name="pw")
+
+
+def build_mobilenetv1(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 32, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for entry in MOBILENET_CFG:
+        feats, stride = entry if isinstance(entry, tuple) else (entry, 1)
+        units.append(DepthwiseSeparable(features=feats, stride=stride,
+                                        **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="mobilenetv1")
+
+
+# ---------------------------------------------------------------------------
+# DPN-92
+# ---------------------------------------------------------------------------
+
+# per stage: (bottleneck_width, out_planes, num_blocks, dense_depth, stride)
+DPN92_CFG = ((96, 256, 3, 16, 1), (192, 512, 4, 32, 2),
+             (384, 1024, 20, 24, 2), (768, 2048, 3, 128, 2))
+
+
+class DPNBlock(_ZooModule):
+    """Dual-path block: residual add on the first ``out_planes`` channels,
+    dense concatenation of ``dense_depth`` new channels."""
+
+    width: int = 96
+    out_planes: int = 256
+    dense_depth: int = 16
+    stride: int = 1
+    first: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        d = self.out_planes
+        y = self.cbr(x, self.width, train=train, kernel=1, name="conv0")
+        y = self.cbr(y, self.width, train=train, kernel=3, stride=self.stride,
+                     groups=32, name="conv1")
+        y = self.cbr(y, d + self.dense_depth, train=train, kernel=1,
+                     act=False, name="conv2")
+        if self.first:
+            x = self.conv(d + self.dense_depth, 1, self.stride,
+                          name="shortcut")(x)
+            x = self.norm("shortcut_bn")(x, train)
+        res = x[..., :d] + y[..., :d]
+        dense = jnp.concatenate([x[..., d:], y[..., d:]], axis=-1)
+        return nn.relu(jnp.concatenate([res, dense], axis=-1))
+
+
+def build_dpn92(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 64, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for width, out_planes, blocks, dense_depth, stride in DPN92_CFG:
+        for b in range(blocks):
+            units.append(DPNBlock(
+                width=width, out_planes=out_planes, dense_depth=dense_depth,
+                stride=(stride if b == 0 else 1), first=(b == 0),
+                **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="dpn92")
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNet (G2) and ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+
+class ShuffleV1Block(_ZooModule):
+    """Grouped 1x1 → channel shuffle → depthwise 3x3 → grouped 1x1; stride-2
+    blocks concatenate an avg-pooled shortcut (ShuffleNet v1, groups=2)."""
+
+    features: int = 200
+    groups: int = 2
+    stride: int = 1
+    first_group: bool = False       # first block of stage 1: ungrouped 1x1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        out_features = (self.features - x.shape[-1] if self.stride == 2
+                        else self.features)
+        mid = max(self.groups, self.features // 4)
+        mid -= mid % self.groups
+        g_in = 1 if self.first_group else self.groups
+        y = self.cbr(x, mid, train=train, kernel=1, groups=g_in, name="conv0")
+        y = _channel_shuffle(y, self.groups)
+        y = self.cbr(y, mid, train=train, kernel=3, stride=self.stride,
+                     groups=mid, act=False, name="dw")
+        y = self.cbr(y, out_features, train=train, kernel=1,
+                     groups=self.groups, act=False, name="conv1")
+        if self.stride == 2:
+            short = nn.avg_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            return nn.relu(jnp.concatenate([short, y], axis=-1))
+        return nn.relu(y + x)
+
+
+def build_shufflenetg2(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 24, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for s, (feats, blocks) in enumerate(zip((200, 400, 800), (4, 8, 4))):
+        for b in range(blocks):
+            units.append(ShuffleV1Block(
+                features=feats, groups=2, stride=(2 if b == 0 else 1),
+                first_group=(s == 0 and b == 0), **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="shufflenetg2")
+
+
+class ShuffleV2Block(_ZooModule):
+    """ShuffleNetV2 basic (split/concat/shuffle) or down-sampling block."""
+
+    features: int = 116
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        if self.stride == 1:
+            half = x.shape[-1] // 2
+            left, right = x[..., :half], x[..., half:]
+            f = self.features - half
+            right = self.cbr(right, f, train=train, kernel=1, name="r0")
+            right = self.cbr(right, f, train=train, kernel=3, groups=f,
+                             act=False, name="r_dw")
+            right = self.cbr(right, f, train=train, kernel=1, name="r1")
+        else:
+            f = self.features // 2
+            left = self.cbr(x, x.shape[-1], train=train, kernel=3, stride=2,
+                            groups=x.shape[-1], act=False, name="l_dw")
+            left = self.cbr(left, f, train=train, kernel=1, name="l0")
+            right = self.cbr(x, f, train=train, kernel=1, name="r0")
+            right = self.cbr(right, f, train=train, kernel=3, stride=2,
+                             groups=f, act=False, name="r_dw")
+            right = self.cbr(right, self.features - f, train=train, kernel=1,
+                             name="r1")
+        return _channel_shuffle(jnp.concatenate([left, right], axis=-1), 2)
+
+
+def build_shufflenetv2(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 24, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for feats, blocks in zip((116, 232, 464), (4, 8, 4)):
+        for b in range(blocks):
+            units.append(ShuffleV2Block(
+                features=feats, stride=(2 if b == 0 else 1), **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=1024,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="shufflenetv2")
+
+
+# ---------------------------------------------------------------------------
+# EfficientNet-B0
+# ---------------------------------------------------------------------------
+
+# (expansion, out, num_blocks, kernel, stride)
+EFFNET_CFG = ((1, 16, 1, 3, 1), (6, 24, 2, 3, 2), (6, 40, 2, 5, 2),
+              (6, 80, 3, 3, 2), (6, 112, 3, 5, 1), (6, 192, 4, 5, 2),
+              (6, 320, 1, 3, 1))
+
+
+class MBConv(_ZooModule):
+    """Mobile inverted bottleneck with squeeze-excite and swish."""
+
+    expansion: int = 6
+    features: int = 16
+    kernel: int = 3
+    stride: int = 1
+    se_ratio: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        c = x.shape[-1]
+        hidden = c * self.expansion
+        y = x
+        if self.expansion != 1:
+            y = self.conv(hidden, 1, name="expand")(y)
+            y = self.norm("expand_bn")(y, train)
+            y = nn.swish(y)
+        y = self.conv(hidden, self.kernel, self.stride, groups=hidden,
+                      name="dw")(y)
+        y = nn.swish(self.norm("dw_bn")(y, train))
+        squeezed = max(1, int(c * self.se_ratio))
+        w = jnp.mean(y, axis=(1, 2), keepdims=True)
+        w = nn.swish(nn.Conv(squeezed, (1, 1), dtype=self.dtype,
+                             name="se_fc0")(w))
+        w = nn.sigmoid(nn.Conv(hidden, (1, 1), dtype=self.dtype,
+                               name="se_fc1")(w))
+        y = y * w
+        y = self.conv(self.features, 1, name="project")(y)
+        y = self.norm("project_bn")(y, train)
+        if self.stride == 1 and c == self.features:
+            y = y + x
+        return y
+
+
+def build_efficientnetb0(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 32, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for expansion, feats, blocks, kernel, stride in EFFNET_CFG:
+        for b in range(blocks):
+            units.append(MBConv(
+                expansion=expansion, features=feats, kernel=kernel,
+                stride=(stride if b == 0 else 1), **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="efficientnetb0")
+
+
+# ---------------------------------------------------------------------------
+# RegNetX-200MF
+# ---------------------------------------------------------------------------
+
+# (width, depth, stride), group width 8, bottleneck ratio 1
+REGNET_CFG = ((24, 1, 1), (56, 1, 1), (152, 4, 2), (368, 7, 2))
+
+
+class RegNetBlock(_ZooModule):
+    """1x1 → grouped 3x3 → 1x1 residual block (X variant: no SE)."""
+
+    features: int = 24
+    stride: int = 1
+    group_width: int = 8
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        groups = self.features // self.group_width
+        y = self.cbr(x, self.features, train=train, kernel=1, name="conv0")
+        y = self.cbr(y, self.features, train=train, kernel=3,
+                     stride=self.stride, groups=groups, name="conv1")
+        y = self.cbr(y, self.features, train=train, kernel=1, act=False,
+                     name="conv2")
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = self.conv(self.features, 1, self.stride, name="shortcut")(x)
+            x = self.norm("shortcut_bn")(x, train)
+        return nn.relu(y + x)
+
+
+def build_regnetx_200mf(num_classes: int = 10, **kw) -> StagedModel:
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 64, "kernel": 3, "stride": 1},),
+                 **_common(kw))
+    ]
+    for width, depth, stride in REGNET_CFG:
+        for b in range(depth):
+            units.append(RegNetBlock(
+                features=width, stride=(stride if b == 0 else 1),
+                group_width=8, **_common(kw)))
+    units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
+                                **_common(kw)))
+    return StagedModel(units=tuple(units), name="regnetx_200mf")
+
+
+# ---------------------------------------------------------------------------
+# SimpleDLA
+# ---------------------------------------------------------------------------
+
+
+class DLABasic(_ZooModule):
+    features: int = 64
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        y = self.cbr(x, self.features, train=train, kernel=3,
+                     stride=self.stride, name="conv0")
+        y = self.cbr(y, self.features, train=train, kernel=3, act=False,
+                     name="conv1")
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = self.conv(self.features, 1, self.stride, name="shortcut")(x)
+            x = self.norm("shortcut_bn")(x, train)
+        return nn.relu(y + x)
+
+
+class DLATree(_ZooModule):
+    """Deep-layer-aggregation tree: at level 1, two residual blocks whose
+    outputs meet at a root (1x1 conv on the concat); higher levels nest
+    trees. Self-contained (one input, one output) so it works as a staged
+    unit."""
+
+    features: int = 64
+    level: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        kw = {k: getattr(self, k) for k in _HPARAM_FIELDS}
+        if self.level == 1:
+            left = DLABasic(features=self.features, stride=self.stride,
+                            name="left", **kw)(x, train=train)
+            right = DLABasic(features=self.features, stride=1, name="right",
+                             **kw)(left, train=train)
+        else:
+            left = DLATree(features=self.features, level=self.level - 1,
+                           stride=self.stride, name="left", **kw)(
+                               x, train=train)
+            right = DLATree(features=self.features, level=self.level - 1,
+                            stride=1, name="right", **kw)(left, train=train)
+        root = jnp.concatenate([left, right], axis=-1)
+        root = self.conv(self.features, 1, name="root")(root)
+        root = self.norm("root_bn")(root, train)
+        return nn.relu(root)
+
+
+def build_simpledla(num_classes: int = 10, **kw) -> StagedModel:
+    c = _common(kw)
+    units: list[nn.Module] = [
+        ConvUnit(ops=({"features": 16, "kernel": 3, "stride": 1},), **c),
+        ConvUnit(ops=({"features": 16, "kernel": 3, "stride": 1},), **c),
+        ConvUnit(ops=({"features": 32, "kernel": 3, "stride": 1},), **c),
+        DLATree(features=64, level=1, stride=1, **c),
+        DLATree(features=128, level=2, stride=2, **c),
+        DLATree(features=256, level=2, stride=2, **c),
+        DLATree(features=512, level=1, stride=2, **c),
+        ClassifierHead(num_classes=num_classes, conv_features=None, **c),
+    ]
+    return StagedModel(units=tuple(units), name="simpledla")
+
+
+ZOO_BUILDERS = {
+    "vgg11": lambda **kw: build_vgg("vgg11", **kw),
+    "vgg13": lambda **kw: build_vgg("vgg13", **kw),
+    "vgg16": lambda **kw: build_vgg("vgg16", **kw),
+    "vgg19": lambda **kw: build_vgg("vgg19", **kw),
+    "preactresnet18": build_preact_resnet18,
+    "senet18": build_senet18,
+    "googlenet": build_googlenet,
+    "densenet121": build_densenet121,
+    "resnext29_2x64d": build_resnext29_2x64d,
+    "mobilenetv1": build_mobilenetv1,
+    "dpn92": build_dpn92,
+    "shufflenetg2": build_shufflenetg2,
+    "shufflenetv2": build_shufflenetv2,
+    "efficientnetb0": build_efficientnetb0,
+    "regnetx_200mf": build_regnetx_200mf,
+    "simpledla": build_simpledla,
+}

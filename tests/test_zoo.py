@@ -1,0 +1,59 @@
+"""Extended model zoo: the reference's commented-out model menu
+(``data_parallel.py:58-73``) as staged TPU-native models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.config import ModelConfig
+from distributed_model_parallel_tpu.models import get_model
+from distributed_model_parallel_tpu.models.zoo import ZOO_BUILDERS
+
+ALL_NAMES = sorted(ZOO_BUILDERS)
+
+
+@pytest.mark.parametrize("name", ALL_NAMES)
+def test_zoo_forward_shapes(name):
+    model = get_model(ModelConfig(name=name, num_classes=10))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    params, state = model.init(jax.random.key(0), x)
+    logits, new_state = model.apply(params, state, x, train=True)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(logits)))
+    assert len(new_state) == model.num_units
+
+
+def test_zoo_registry_rejects_unknown():
+    with pytest.raises(KeyError):
+        get_model(ModelConfig(name="not_a_model"))
+
+
+@pytest.mark.parametrize("name", ["vgg11", "googlenet", "shufflenetv2"])
+def test_zoo_unit_split_equivalence(name):
+    """apply == apply_range over an arbitrary split point (what the pipeline
+    partitioner relies on)."""
+    model = get_model(ModelConfig(name=name))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    params, state = model.init(jax.random.key(0), x)
+    full, _ = model.apply(params, state, x, train=False)
+    mid = model.num_units // 2
+    y, _ = model.apply_range(params, state, x, 0, mid, train=False)
+    part, _ = model.apply_range(params, state, y, mid, model.num_units,
+                                train=False)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(part),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zoo_bn_none_has_no_batch_stats():
+    model = get_model(ModelConfig(name="vgg11", batchnorm="none"))
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    _, state = model.init(jax.random.key(0), x)
+    assert all(not s for s in state)
+
+
+def test_zoo_sync_bn_builds():
+    model = get_model(ModelConfig(name="senet18", batchnorm="sync"),
+                      axis_name="data")
+    assert model.num_units == 10  # stem + 8 blocks + head
