@@ -16,6 +16,7 @@ BatchNorm modes (local / sync / none) from ``models/layers.py``.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import flax.linen as nn
@@ -63,9 +64,8 @@ class _ZooModule(nn.Module):
 
 
 _HPARAM_FIELDS = ("bn_mode", "bn_momentum", "bn_epsilon", "dtype", "axis_name")
-_HPARAM_DEFAULTS = {"bn_mode": "local", "bn_momentum": 0.9,
-                    "bn_epsilon": 1e-5, "dtype": jnp.float32,
-                    "axis_name": None}
+_HPARAM_DEFAULTS = {f.name: f.default for f in dataclasses.fields(_ZooModule)
+                    if f.name in _HPARAM_FIELDS}
 
 
 def _common(kw: dict) -> dict:
@@ -443,7 +443,7 @@ class ShuffleV1Block(_ZooModule):
     def __call__(self, x, *, train: bool):
         out_features = (self.features - x.shape[-1] if self.stride == 2
                         else self.features)
-        mid = max(self.groups, self.features // 4)
+        mid = max(self.groups, out_features // 4)
         mid -= mid % self.groups
         g_in = 1 if self.first_group else self.groups
         y = self.cbr(x, mid, train=train, kernel=1, groups=g_in, name="conv0")

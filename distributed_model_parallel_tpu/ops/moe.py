@@ -1,12 +1,13 @@
 """Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
 
 Beyond the reference (SURVEY.md §2.3 lists EP as absent) but part of this
-framework's first-class parallelism set: top-1 (switch-style) token routing
-with static capacity, experts sharded one-per-device-group over the
-``expert`` axis, and token exchange via ``all_to_all`` — the TPU-native form
-of expert dispatch (dense einsum dispatch/combine against one-hot capacity
-masks, so everything is static-shaped MXU work; dropped tokens pass through
-on the residual path).
+framework's first-class parallelism set: top-k token routing — top-1
+(switch-style, raw gate) or top-2+ (GShard-style, gates normalized over the
+selected experts) — with static capacity, experts sharded
+one-per-device-group over the ``expert`` axis, and token exchange via
+``all_to_all`` — the TPU-native form of expert dispatch (dense einsum
+dispatch/combine against one-hot capacity masks, so everything is
+static-shaped MXU work; dropped tokens pass through on the residual path).
 
 Shapes (inside shard_map over the expert axis):
   x_local:        [B_local, T, d]   tokens on this device group
@@ -27,6 +28,10 @@ class MoEConfig:
     d_model: int = 64
     d_ff: int = 128
     capacity_factor: float = 2.0
+    top_k: int = 1
+    # Only consulted for top_k > 1: renormalize the selected experts' gates to
+    # sum to 1 (GShard). top-1 always uses the raw softmax prob (Switch).
+    normalize_gates: bool = True
 
 
 def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
@@ -40,29 +45,44 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
 
 
 def _route(router, x, cfg: MoEConfig):
-    """Top-1 routing with per-expert capacity.
+    """Top-k routing with per-expert capacity.
 
     Returns (dispatch [N, E, C] one-hot, combine [N, E, C] weighted,
-    aux_loss) for N flattened tokens.
+    aux_loss) for N flattened tokens. Choice j's queue positions are offset
+    by all earlier choices' assignments (GShard ordering), so a token's
+    second choice never collides with first-choice traffic.
     """
     n = x.shape[0]
     E = cfg.num_experts
-    cap = max(1, int(cfg.capacity_factor * n / E))
+    k = cfg.top_k
+    # Capacity scales with k (GShard): each token makes k assignments, so
+    # holding capacity_factor fixed keeps the drop rate constant across k.
+    cap = max(1, int(cfg.capacity_factor * k * n / E))
     logits = x @ router                               # [N, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)               # [N]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    gates, experts = jax.lax.top_k(probs, k)          # [N, k] each
+    if k > 1 and cfg.normalize_gates:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
 
-    onehot = jax.nn.one_hot(expert, E)                # [N, E]
-    # Position of each token within its expert's queue.
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
-    keep = (pos < cap) * onehot                       # drop overflow
-    pos = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)   # [N]
-    dispatch = keep[:, :, None] * jax.nn.one_hot(pos, cap)[:, None, :]  # [N,E,C]
-    combine = dispatch * gate[:, None, None]
+    dispatch = jnp.zeros((n, E, cap), x.dtype)
+    combine = jnp.zeros((n, E, cap), x.dtype)
+    counts = jnp.zeros((E,), x.dtype)                 # queue heads per expert
+    for j in range(k):                                # k is static (config)
+        onehot = jax.nn.one_hot(experts[:, j], E)     # [N, E]
+        # Position of each token within its expert's queue, past all
+        # choice-<j traffic.
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + counts) * onehot
+        keep = (pos < cap) * onehot                   # drop overflow
+        posk = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)   # [N]
+        d_j = keep[:, :, None] * jax.nn.one_hot(posk, cap)[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gates[:, j][:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
 
-    # Switch-transformer load-balancing loss.
-    frac_tokens = jnp.mean(onehot, axis=0)
+    # Load-balancing loss over first-choice assignment fractions
+    # (Switch/GShard form).
+    first_choice = jax.nn.one_hot(experts[:, 0], E)
+    frac_tokens = jnp.mean(first_choice, axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux

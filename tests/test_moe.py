@@ -77,6 +77,73 @@ def test_capacity_overflow_drops_tokens(setup):
     assert (np.abs(flat).sum(axis=-1) == 0).any()
 
 
+CFG2 = MoEConfig(num_experts=4, d_model=16, d_ff=32, capacity_factor=8.0,
+                 top_k=2)
+
+
+def _naive_top2(params, x, cfg):
+    """Per-token reference: top-2 experts, normalized gates, no capacity."""
+    b, t, d = x.shape
+    xf = np.asarray(x.reshape(-1, d))
+    router = np.asarray(params["router"])
+    w_in, w_out = np.asarray(params["w_in"]), np.asarray(params["w_out"])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(xf @ router), axis=-1))
+    out = np.zeros_like(xf)
+    for n in range(xf.shape[0]):
+        top2 = np.argsort(probs[n])[::-1][:2]
+        gates = probs[n, top2] / probs[n, top2].sum()
+        for e, g in zip(top2, gates):
+            h = np.asarray(jax.nn.gelu(jnp.asarray(xf[n] @ w_in[e])))
+            out[n] += g * (h @ w_out[e])
+    return out.reshape(b, t, d)
+
+
+def test_top2_matches_naive(setup):
+    params, x = setup
+    y, aux = moe_ffn(params, x, CFG2)
+    np.testing.assert_allclose(np.asarray(y), _naive_top2(params, x, CFG2),
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_top2_expert_parallel_matches_naive(setup):
+    params, x = setup
+    spec = make_mesh(MeshConfig(data=1, expert=4))
+
+    def fn(p, x):
+        y, aux = moe_ffn(p, x, CFG2, ep_axis="expert")
+        return y, jax.lax.pmean(aux, "expert")
+
+    sharded = jax.shard_map(
+        fn, mesh=spec.mesh,
+        in_specs=({"router": P(), "w_in": P("expert"), "w_out": P("expert")},
+                  P("expert")),
+        out_specs=(P("expert"), P()),
+        check_vma=False)
+    y, aux = sharded(params, x)
+    np.testing.assert_allclose(np.asarray(y), _naive_top2(params, x, CFG2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_top1_explicit_equals_default(setup):
+    params, x = setup
+    y1, aux1 = moe_ffn(params, x, CFG)
+    cfg_k1 = MoEConfig(num_experts=4, d_model=16, d_ff=32,
+                       capacity_factor=8.0, top_k=1)
+    y2, aux2 = moe_ffn(params, x, cfg_k1)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    np.testing.assert_allclose(float(aux1), float(aux2))
+
+
+def test_top2_capacity_drops_second_choice(setup):
+    params, x = setup
+    tight = MoEConfig(num_experts=4, d_model=16, d_ff=32,
+                      capacity_factor=0.25, top_k=2)
+    y_tight, _ = moe_ffn(params, x, tight)
+    y_loose, _ = moe_ffn(params, x, CFG2)
+    assert not np.allclose(np.asarray(y_tight), np.asarray(y_loose))
+
+
 def test_moe_is_differentiable(setup):
     params, x = setup
 
