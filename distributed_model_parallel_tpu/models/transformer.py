@@ -50,10 +50,28 @@ class TransformerConfig:
     remat: bool = False            # jax.checkpoint each block: recompute
                                    # activations in backward (HBM for FLOPs —
                                    # the long-context memory lever)
+    # Mixture-of-experts FFN (0 = dense). When > 0 every block's MLP is a
+    # top-k routed MoE (ops/moe.py); ep_axis shards experts over the
+    # ``expert`` mesh axis inside a shard_map. MoE replaces the FFN, so
+    # tp_axis then only shards attention.
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01   # load-balance loss weight in lm_loss
+    ep_axis: str | None = None
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def moe(self) -> "MoEConfig | None":
+        if not self.moe_experts:
+            return None
+        from distributed_model_parallel_tpu.ops.moe import MoEConfig
+        return MoEConfig(num_experts=self.moe_experts, d_model=self.d_model,
+                         d_ff=self.d_ff, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor)
 
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
@@ -68,23 +86,34 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     def stack(key, shape, fan_in):
         return dense(key, (L,) + shape, fan_in)
 
-    return {
-        "embed": jax.random.normal(k[0], (cfg.vocab_size, d), dt) * 0.02,
-        "pos": jax.random.normal(k[1], (cfg.max_seq_len, d), dt) * 0.02,
-        "blocks": {
-            "ln1_scale": jnp.ones((L, d), dt),
-            "ln1_bias": jnp.zeros((L, d), dt),
-            # [d, H, 3*Dh]: head dim explicit so tensor parallelism shards
-            # whole heads (column-parallel over the H axis).
-            "wqkv": stack(k[2], (d, cfg.n_heads, 3 * cfg.head_dim), d),
-            "wo": stack(k[3], (d, d), d),
-            "ln2_scale": jnp.ones((L, d), dt),
-            "ln2_bias": jnp.zeros((L, d), dt),
+    blocks = {
+        "ln1_scale": jnp.ones((L, d), dt),
+        "ln1_bias": jnp.zeros((L, d), dt),
+        # [d, H, 3*Dh]: head dim explicit so tensor parallelism shards
+        # whole heads (column-parallel over the H axis).
+        "wqkv": stack(k[2], (d, cfg.n_heads, 3 * cfg.head_dim), d),
+        "wo": stack(k[3], (d, d), d),
+        "ln2_scale": jnp.ones((L, d), dt),
+        "ln2_bias": jnp.zeros((L, d), dt),
+    }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        blocks.update({
+            "router": stack(k[4], (d, E), d),
+            "w_in": stack(k[5], (E, d, f), d),
+            "w_out": stack(k[7], (E, f, d), f),
+        })
+    else:
+        blocks.update({
             "w1": stack(k[4], (d, f), d),
             "b1": jnp.zeros((L, f), dt),
             "w2": stack(k[5], (f, d), f),
             "b2": jnp.zeros((L, d), dt),
-        },
+        })
+    return {
+        "embed": jax.random.normal(k[0], (cfg.vocab_size, d), dt) * 0.02,
+        "pos": jax.random.normal(k[1], (cfg.max_seq_len, d), dt) * 0.02,
+        "blocks": blocks,
         "ln_f_scale": jnp.ones((d,), dt),
         "ln_f_bias": jnp.zeros((d,), dt),
         "head": dense(k[6], (d, cfg.vocab_size), d),
@@ -108,9 +137,11 @@ def _attention(q, k, v, cfg: TransformerConfig):
     return full_attention(q, k, v, causal=True)
 
 
-def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig
+                ) -> tuple[jax.Array, jax.Array]:
     """One transformer block on [B, T(_local), d]. ``bp`` holds *unstacked*
-    per-layer arrays (a leaf slice of params["blocks"]).
+    per-layer arrays (a leaf slice of params["blocks"]). Returns
+    ``(x, aux)`` where ``aux`` is the MoE load-balance loss (0 for dense).
 
     Tensor parallelism: when ``cfg.tp_axis`` is bound, wqkv/w1 arrive
     column-sharded and wo/w2 row-sharded (shard_map hands each device its
@@ -128,6 +159,13 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
     x = x + o
 
     h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    if cfg.moe_experts:
+        from distributed_model_parallel_tpu.ops.moe import moe_ffn
+        h, aux = moe_ffn(
+            {"router": bp["router"], "w_in": bp["w_in"],
+             "w_out": bp["w_out"]},
+            h, cfg.moe, ep_axis=cfg.ep_axis)
+        return x + h, aux.astype(jnp.float32)
     h = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
     h = h @ bp["w2"]
     if cfg.tp_axis is not None:
@@ -135,20 +173,23 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
         h = h + bp["b2"]                     # bias added once, post-psum
     else:
         h = h + bp["b2"]
-    return x + h
+    return x + h, jnp.zeros((), jnp.float32)
 
 
-def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
-    """Run all stacked blocks with lax.scan (single device / per-stage)."""
+def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig
+                ) -> tuple[jax.Array, jax.Array]:
+    """Run all stacked blocks with lax.scan (single device / per-stage).
+    Returns ``(x, aux)``; aux is the mean per-layer MoE load-balance loss."""
     apply = block_apply
     if cfg.remat:
         apply = jax.checkpoint(block_apply, static_argnums=(2,))
 
     def body(carry, bp):
-        return apply(bp, carry, cfg), None
+        carry, aux = apply(bp, carry, cfg)
+        return carry, aux
 
-    out, _ = jax.lax.scan(body, x, blocks)
-    return out
+    out, auxes = jax.lax.scan(body, x, blocks)
+    return out, jnp.mean(auxes)
 
 
 def embed(params: dict, tokens: jax.Array, cfg: TransformerConfig,
@@ -163,21 +204,27 @@ def unembed(params: dict, x: jax.Array) -> jax.Array:
     return x @ params["head"]
 
 
+def apply_with_aux(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+                   *, pos_offset: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Full forward: [B, T] int tokens -> ([B, T, V] logits, moe aux loss)."""
+    x = embed(params, tokens, cfg, pos_offset=pos_offset)
+    x, aux = blocks_scan(params["blocks"], x, cfg)
+    return unembed(params, x), aux
+
+
 def apply(params: dict, tokens: jax.Array, cfg: TransformerConfig,
           *, pos_offset: int = 0) -> jax.Array:
     """Full forward: [B, T] int tokens -> [B, T, V] logits."""
-    x = embed(params, tokens, cfg, pos_offset=pos_offset)
-    x = blocks_scan(params["blocks"], x, cfg)
-    return unembed(params, x)
+    return apply_with_aux(params, tokens, cfg, pos_offset=pos_offset)[0]
 
 
 def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,
             cfg: TransformerConfig) -> jax.Array:
-    """Mean next-token cross-entropy."""
-    logits = apply(params, tokens, cfg)
+    """Mean next-token cross-entropy (+ weighted MoE load-balance loss)."""
+    logits, aux = apply_with_aux(params, tokens, cfg)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
 def build_transformer(model_config) -> "TransformerConfig":

@@ -33,6 +33,12 @@ class MoEConfig:
     # sum to 1 (GShard). top-1 always uses the raw softmax prob (Switch).
     normalize_gates: bool = True
 
+    def __post_init__(self):
+        if not (1 <= self.top_k <= self.num_experts):
+            raise ValueError(
+                f"top_k={self.top_k} must be in [1, num_experts="
+                f"{self.num_experts}]")
+
 
 def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
     k1, k2, k3 = jax.random.split(rng, 3)

@@ -38,10 +38,12 @@ from distributed_model_parallel_tpu.parallel.tensor_parallel import (
 
 def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
                         num_microbatches: int) -> Callable:
-    """Returns pipeline_blocks(blocks, x) -> y, a shard_map'd function.
+    """Returns pipeline_blocks(blocks, x) -> (y, aux), a shard_map'd function.
 
     blocks leaves are [L, ...] sharded over ``stage`` on dim 0; x is
     [B, T, d] sharded over ``data`` (and ``seq`` if sequence parallel).
+    ``aux`` is the mean per-layer MoE load-balance loss over real
+    microbatches (0 for dense models).
     """
     S = spec.num_stages
     M = num_microbatches
@@ -57,12 +59,19 @@ def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
         mb = x_local.reshape(M, mbs, t, d)
         state = jnp.zeros((mbs, t, d), x_local.dtype)
         outputs = jnp.zeros((M, mbs, t, d), x_local.dtype)
+        aux_sum = jnp.zeros((), jnp.float32)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         for tick in range(M + S - 1):           # static unroll
             if tick < M:                        # stage 0 injects microbatch
                 state = jnp.where(s == 0, mb[tick], state)
-            state = tfm.blocks_scan(blocks_local, state, cfg)
+            state, aux = tfm.blocks_scan(blocks_local, state, cfg)
+            # At tick t, stage s holds microbatch t-s; bubble ticks
+            # (t-s outside [0, M)) run on garbage activations, so their
+            # aux is masked out. Logits are unaffected (aux never feeds
+            # the forward value).
+            real = jnp.logical_and(tick - s >= 0, tick - s < M)
+            aux_sum = aux_sum + jnp.where(real, aux, 0.0)
             out_idx = tick - (S - 1)
             if 0 <= out_idx < M:                # last stage emits
                 outputs = outputs.at[out_idx].set(
@@ -75,14 +84,19 @@ def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
         outputs = jax.lax.psum(
             jnp.where(s == S - 1, outputs, jnp.zeros_like(outputs)),
             stage_axis)
-        return outputs.reshape(b, t, d)
+        # Mean over stages x microbatches; pmean over every mesh axis so the
+        # result is replicated (aux differs per data/seq shard before this).
+        aux_mean = jax.lax.pmean(aux_sum / M, tuple(axes))
+        return outputs.reshape(b, t, d), aux_mean
 
     seq = spec.seq_axis if cfg.sp_axis else None
     x_spec = P(spec.data_axis, seq, None)
     return jax.shard_map(
         stage_fn, mesh=spec.mesh,
-        in_specs=(block_specs(stage_axis, cfg.tp_axis), x_spec),
-        out_specs=x_spec,
+        in_specs=(block_specs(stage_axis, cfg.tp_axis,
+                              moe=bool(cfg.moe_experts),
+                              ep_axis=cfg.ep_axis), x_spec),
+        out_specs=(x_spec, P()),
         check_vma=False)
 
 
@@ -99,11 +113,11 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
 
     def loss_fn(params, tokens, targets):
         x = tfm.embed(params, tokens, cfg)
-        x = pipeline_blocks(params["blocks"], x)
+        x, aux = pipeline_blocks(params["blocks"], x)
         logits = tfm.unembed(params, x)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
@@ -111,7 +125,8 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    pspecs = param_specs(spec.stage_axis, cfg.tp_axis)
+    pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
+                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis)
     p_sh = jax.tree.map(lambda ps: NamedSharding(spec.mesh, ps), pspecs,
                         is_leaf=lambda x: isinstance(x, P))
     seq = spec.seq_axis if cfg.sp_axis else None
@@ -130,7 +145,8 @@ def shard_params(params: dict, cfg: tfm.TransformerConfig,
     """Place a host-initialized parameter tree onto the mesh per the TP/PP
     specs (the framework's replacement for per-rank shard construction,
     reference model_parallel.py:99-157)."""
-    pspecs = param_specs(spec.stage_axis, cfg.tp_axis)
+    pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
+                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis)
     return jax.tree.map(
         lambda x, ps: jax.device_put(x, NamedSharding(spec.mesh, ps)),
         params, pspecs,

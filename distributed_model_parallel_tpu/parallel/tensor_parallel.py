@@ -14,14 +14,18 @@ from __future__ import annotations
 from jax.sharding import PartitionSpec as P
 
 
-def block_specs(stage_axis: str | None, model_axis: str | None) -> dict:
+def block_specs(stage_axis: str | None, model_axis: str | None, *,
+                moe: bool = False, ep_axis: str | None = None) -> dict:
     """PartitionSpecs for the stacked ``params["blocks"]`` pytree.
 
     Leading dim is the layer stack (sharded over ``stage`` for the SPMD
-    pipeline); head/ffn dims shard over ``model``.
+    pipeline); head/ffn dims shard over ``model``. With ``moe=True`` the
+    FFN leaves are router/w_in/w_out; the expert dim shards over
+    ``ep_axis`` (MoE replaces the FFN, so ``model`` then only shards
+    attention).
     """
     s, m = stage_axis, model_axis
-    return {
+    specs = {
         "ln1_scale": P(s, None),
         "ln1_bias": P(s, None),
         "wqkv": P(s, None, m, None),  # column-parallel over heads
@@ -29,20 +33,33 @@ def block_specs(stage_axis: str | None, model_axis: str | None) -> dict:
                                       # contiguous per head)
         "ln2_scale": P(s, None),
         "ln2_bias": P(s, None),
-        "w1": P(s, None, m),       # column-parallel
-        "b1": P(s, m),
-        "w2": P(s, m, None),       # row-parallel
-        "b2": P(s, None),
     }
+    if moe:
+        specs.update({
+            "router": P(s, None, None),          # replicated: every token
+                                                 # scores every expert
+            "w_in": P(s, ep_axis, None, None),   # experts sharded over ep
+            "w_out": P(s, ep_axis, None, None),
+        })
+    else:
+        specs.update({
+            "w1": P(s, None, m),       # column-parallel
+            "b1": P(s, m),
+            "w2": P(s, m, None),       # row-parallel
+            "b2": P(s, None),
+        })
+    return specs
 
 
-def param_specs(stage_axis: str | None, model_axis: str | None) -> dict:
+def param_specs(stage_axis: str | None, model_axis: str | None, *,
+                moe: bool = False, ep_axis: str | None = None) -> dict:
     """Specs for the full transformer parameter pytree. Embedding/head stay
     replicated (small at test scale; shard over ``model`` later if needed)."""
     return {
         "embed": P(),
         "pos": P(),
-        "blocks": block_specs(stage_axis, model_axis),
+        "blocks": block_specs(stage_axis, model_axis, moe=moe,
+                              ep_axis=ep_axis),
         "ln_f_scale": P(),
         "ln_f_bias": P(),
         "head": P(),

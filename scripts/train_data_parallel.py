@@ -21,27 +21,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _force_cpu_devices_from_argv() -> None:
-    """When running on the CPU backend (``JAX_PLATFORMS=cpu``), honor
-    ``--num-devices N`` by creating N virtual devices. Must run before the
-    backend initializes, hence this pre-parse of argv."""
-    if os.environ.get("JAX_PLATFORMS") != "cpu":
-        return
-    argv = sys.argv
-    for i, a in enumerate(argv):
-        n = (a.split("=", 1)[1] if a.startswith("--num-devices=")
-             else argv[i + 1] if a == "--num-devices" and i + 1 < len(argv)
-             else None)
-        if n and n.isdigit() and int(n) > 1:
-            # jax may have been imported at interpreter startup with another
-            # platform baked in; override before the backend initializes.
-            import jax
-            jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", int(n))
-            return
+from _cpu_devices import force_cpu_devices
 
-
-_force_cpu_devices_from_argv()
+force_cpu_devices(("--num-devices",))
 
 from distributed_model_parallel_tpu.config import (
     DataConfig,

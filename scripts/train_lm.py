@@ -15,12 +15,22 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+from _cpu_devices import force_cpu_devices
+
+force_cpu_devices(("--dp", "--pp", "--tp", "--sp", "--ep"))
+
+
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel ways (shards --moe-experts)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="experts per block (0 = dense FFN)")
+    p.add_argument("--moe-top-k", type=int, default=2)
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
@@ -47,15 +57,21 @@ def main():
 
     if args.layers % max(args.pp, 1):
         raise SystemExit("--layers must be divisible by --pp")
+    if args.ep > 1 and args.moe_experts % args.ep:
+        raise SystemExit("--moe-experts must be divisible by --ep")
+    if args.moe_experts and args.moe_top_k > args.moe_experts:
+        raise SystemExit("--moe-top-k must be <= --moe-experts")
     config = LMTrainConfig(
         model=TransformerConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
             n_layers=args.layers, d_ff=args.d_ff,
             max_seq_len=max(args.seq_len, 128),
             tp_axis="model" if args.tp > 1 else None,
-            sp_axis="seq" if args.sp > 1 else None),
+            sp_axis="seq" if args.sp > 1 else None,
+            moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
+            ep_axis="expert" if args.ep > 1 else None),
         mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
-                        seq=args.sp),
+                        seq=args.sp, expert=args.ep),
         optimizer=OptimizerConfig(learning_rate=args.lr, weight_decay=0.0,
                                   warmup_steps=10),
         batch_size=args.batch_size, seq_len=args.seq_len,

@@ -125,14 +125,33 @@ def test_top2_expert_parallel_matches_naive(setup):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_top1_explicit_equals_default(setup):
+def test_top2_raw_gates_matches_naive(setup):
+    """normalize_gates=False: each selected expert weighted by its raw
+    softmax prob (no renormalization over the selected pair)."""
     params, x = setup
-    y1, aux1 = moe_ffn(params, x, CFG)
-    cfg_k1 = MoEConfig(num_experts=4, d_model=16, d_ff=32,
-                       capacity_factor=8.0, top_k=1)
-    y2, aux2 = moe_ffn(params, x, cfg_k1)
-    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
-    np.testing.assert_allclose(float(aux1), float(aux2))
+    cfg = MoEConfig(num_experts=4, d_model=16, d_ff=32, capacity_factor=8.0,
+                    top_k=2, normalize_gates=False)
+    y, _ = moe_ffn(params, x, cfg)
+
+    b, t, d = x.shape
+    xf = np.asarray(x.reshape(-1, d))
+    probs = np.asarray(jax.nn.softmax(
+        jnp.asarray(xf @ np.asarray(params["router"])), axis=-1))
+    w_in, w_out = np.asarray(params["w_in"]), np.asarray(params["w_out"])
+    ref = np.zeros_like(xf)
+    for n in range(xf.shape[0]):
+        for e in np.argsort(probs[n])[::-1][:2]:
+            h = np.asarray(jax.nn.gelu(jnp.asarray(xf[n] @ w_in[e])))
+            ref[n] += probs[n, e] * (h @ w_out[e])
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, d), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_top_k_out_of_range_rejected():
+    with pytest.raises(ValueError):
+        MoEConfig(num_experts=2, top_k=3)
+    with pytest.raises(ValueError):
+        MoEConfig(num_experts=2, top_k=0)
 
 
 def test_top2_capacity_drops_second_choice(setup):

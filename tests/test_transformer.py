@@ -163,7 +163,7 @@ def test_tp_sharded_forward_matches(params, toks):
 
     def fwd(p, t):
         x = tfm.embed(p, t, cfg_tp)
-        x = pipeline(p["blocks"], x)
+        x, _ = pipeline(p["blocks"], x)
         return tfm.unembed(p, x)
 
     sp = shard_params(params, cfg_tp, spec)
@@ -179,7 +179,7 @@ def test_spmd_pipeline_forward_matches(params, toks, microbatches):
 
     def fwd(p, t):
         x = tfm.embed(p, t, CFG)
-        x = pipeline(p["blocks"], x)
+        x, _ = pipeline(p["blocks"], x)
         return tfm.unembed(p, x)
 
     sp = shard_params(params, CFG, spec)
@@ -213,10 +213,95 @@ def test_spmd_pipeline_with_ring_attention(params, toks):
 
     def fwd(p, t):
         x = tfm.embed(p, t, cfg)
-        x = pipeline(p["blocks"], x)
+        x, _ = pipeline(p["blocks"], x)
         return tfm.unembed(p, x)
 
     sp = shard_params(params, cfg, spec)
     out = jax.jit(fwd)(sp, toks)
     np.testing.assert_allclose(np.asarray(out), _ref_logits(params, toks),
                                rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# mixture-of-experts transformer
+# ---------------------------------------------------------------------------
+
+MOE_CFG = tfm.TransformerConfig(vocab_size=97, d_model=32, n_heads=4,
+                                n_layers=4, d_ff=64, max_seq_len=64,
+                                moe_experts=4, moe_top_k=2,
+                                moe_capacity_factor=4.0)
+
+
+@pytest.fixture()
+def moe_params():
+    return tfm.init_params(jax.random.key(0), MOE_CFG)
+
+
+def test_moe_transformer_forward_and_aux(moe_params, toks):
+    logits, aux = tfm.apply_with_aux(moe_params, toks, MOE_CFG)
+    assert logits.shape == (*toks.shape, MOE_CFG.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    # balanced routing gives aux ~1; any routing gives aux >= 1 in
+    # expectation — just require a sane positive value
+    assert 0.0 < float(aux) < 10.0
+
+
+def test_moe_transformer_trains(moe_params, toks):
+    import optax
+
+    tx = make_optimizer(OptimizerConfig(learning_rate=0.5, momentum=0.9,
+                                        weight_decay=0.0, warmup_steps=0),
+                        10, 10)
+    opt_state = tx.init(moe_params)
+    p = moe_params
+
+    @jax.jit
+    def step(p, o):
+        loss, grads = jax.value_and_grad(tfm.lm_loss)(
+            p, toks[:, :-1], toks[:, 1:], MOE_CFG)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss, grads
+
+    losses = []
+    for _ in range(8):
+        p, opt_state, loss, grads = step(p, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # router receives gradient (load-balance loss + gating both feed it)
+    assert float(jnp.abs(grads["blocks"]["router"]).sum()) > 0
+
+
+def test_moe_spmd_pipeline_forward_matches(moe_params, toks):
+    """MoE blocks under the SPMD pipeline: logits == single-device forward
+    (aux is dropped in the pipeline, logits must agree exactly)."""
+    spec = make_mesh(MeshConfig(data=2, stage=4))
+    pipeline = make_pipeline_apply(MOE_CFG, spec, num_microbatches=2)
+
+    def fwd(p, t):
+        x = tfm.embed(p, t, MOE_CFG)
+        x, _ = pipeline(p["blocks"], x)
+        return tfm.unembed(p, x)
+
+    sp = shard_params(moe_params, MOE_CFG, spec)
+    out = jax.jit(fwd)(sp, toks)
+    ref = tfm.apply(moe_params, toks, MOE_CFG)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_spmd_train_step_with_expert_axis(moe_params, toks):
+    """Full SPMD train step on a mesh with a real expert axis: experts
+    sharded over ``expert``, tokens exchanged via all_to_all."""
+    spec = make_mesh(MeshConfig(data=2, stage=1, expert=2))
+    cfg = tfm.TransformerConfig(**{**MOE_CFG.__dict__, "ep_axis": "expert"})
+    tx = make_optimizer(OptimizerConfig(learning_rate=0.5, momentum=0.9,
+                                        weight_decay=0.0, warmup_steps=0),
+                        10, 10)
+    step = make_spmd_train_step(cfg, spec, tx, num_microbatches=1)
+    p = shard_params(moe_params, cfg, spec)
+    o = jax.device_put(tx.init(moe_params), NamedSharding(spec.mesh, P()))
+    losses = []
+    for _ in range(6):
+        p, o, loss = step(p, o, toks[:, :-1], toks[:, 1:])
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
