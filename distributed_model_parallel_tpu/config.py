@@ -138,6 +138,7 @@ class TrainConfig:
     num_microbatches: int = 1               # 1 == reference's naive schedule
     stage_boundaries: Sequence[int] | None = None  # unit indices; None = balanced
     pipeline_schedule: str = "gpipe"        # "gpipe" | "1f1b"
+    virtual_stages: int = 1                 # >1 = Megatron interleaved chunks
 
     def replace(self, **kw) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -218,13 +218,21 @@ def apply(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     return apply_with_aux(params, tokens, cfg, pos_offset=pos_offset)[0]
 
 
+def token_loss(logits: jax.Array, targets: jax.Array, aux: jax.Array,
+               cfg: TransformerConfig) -> jax.Array:
+    """Mean next-token cross-entropy + weighted MoE load-balance loss.
+    The single shared loss for the single-device and SPMD-pipeline paths
+    (their parity is what tests compare)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+
+
 def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,
             cfg: TransformerConfig) -> jax.Array:
     """Mean next-token cross-entropy (+ weighted MoE load-balance loss)."""
     logits, aux = apply_with_aux(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    return token_loss(logits, targets, aux, cfg)
 
 
 def build_transformer(model_config) -> "TransformerConfig":

@@ -115,9 +115,7 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
         x = tfm.embed(params, tokens, cfg)
         x, aux = pipeline_blocks(params["blocks"], x)
         logits = tfm.unembed(params, x)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll) + cfg.moe_aux_weight * aux
+        return tfm.token_loss(logits, targets, aux, cfg)
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)

@@ -1,11 +1,14 @@
-"""Optimizer factory: SGD + momentum + weight decay, cosine annealing with
-linear warmup.
+"""Optimizer factory: SGD/AdamW/LAMB/LARS, cosine annealing with linear
+warmup.
 
-Mirrors the reference recipe — ``optim.SGD(lr, momentum=0.9, weight_decay=1e-4)``
-+ ``CosineAnnealingLR(T_max=90)`` + ``pytorch_warmup.UntunedLinearWarmup``
-(reference ``data_parallel.py:89-96``, ``model_parallel.py:105-108``) — as a
-single optax chain with a per-step schedule. Ordering matches torch SGD:
-weight decay is added to the raw gradient *before* the momentum buffer update.
+``sgd`` mirrors the reference recipe — ``optim.SGD(lr, momentum=0.9,
+weight_decay=1e-4)`` + ``CosineAnnealingLR(T_max=90)`` +
+``pytorch_warmup.UntunedLinearWarmup`` (reference ``data_parallel.py:89-96``,
+``model_parallel.py:105-108``) — as a single optax chain with a per-step
+schedule; ordering matches torch SGD (weight decay added to the raw gradient
+*before* the momentum buffer update). ``lars``/``lamb`` are the layerwise-
+adaptive large-batch optimizers the reference's large-batch study
+(``Readme.md:159-211``) motivates; ``adamw`` uses decoupled weight decay.
 """
 
 from __future__ import annotations
@@ -44,14 +47,24 @@ def make_optimizer(config: OptimizerConfig, steps_per_epoch: int,
     parts = []
     if config.grad_clip_norm is not None:
         parts.append(optax.clip_by_global_norm(config.grad_clip_norm))
-    if config.weight_decay:
-        parts.append(optax.add_decayed_weights(config.weight_decay))
     if config.name == "sgd":
+        if config.weight_decay:
+            parts.append(optax.add_decayed_weights(config.weight_decay))
         parts.append(optax.sgd(learning_rate=schedule,
                                momentum=config.momentum or None,
                                nesterov=config.nesterov))
     elif config.name == "adamw":
-        parts.append(optax.adam(learning_rate=schedule))
+        parts.append(optax.adamw(learning_rate=schedule,
+                                 weight_decay=config.weight_decay))
+    elif config.name == "lamb":
+        parts.append(optax.lamb(learning_rate=schedule,
+                                weight_decay=config.weight_decay))
+    elif config.name == "lars":
+        parts.append(optax.lars(learning_rate=schedule,
+                                weight_decay=config.weight_decay,
+                                momentum=config.momentum,
+                                nesterov=config.nesterov))
     else:
-        raise KeyError(f"unknown optimizer {config.name!r}")
+        raise KeyError(
+            f"unknown optimizer {config.name!r}; known: sgd, adamw, lamb, lars")
     return optax.chain(*parts)

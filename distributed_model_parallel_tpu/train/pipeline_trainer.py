@@ -56,7 +56,8 @@ class PipelineTrainer:
             boundaries=config.stage_boundaries,
             num_microbatches=config.num_microbatches,
             augment=config.data.augment,
-            schedule=config.pipeline_schedule)
+            schedule=config.pipeline_schedule,
+            virtual_stages=config.virtual_stages)
 
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)

@@ -21,7 +21,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from _cpu_devices import force_cpu_devices
+from scripts._cpu_devices import force_cpu_devices
 
 force_cpu_devices(("--num-devices",))
 
@@ -43,6 +43,9 @@ def parse_args():
                             "synthetic"])
     p.add_argument("--model", default="mobilenetv2")
     p.add_argument("--lr", default=0.4, type=float)
+    p.add_argument("--optimizer", default="sgd",
+                   choices=["sgd", "adamw", "lamb", "lars"],
+                   help="lars/lamb: layerwise-adaptive large-batch training")
     p.add_argument("--momentum", default=0.9, type=float)
     p.add_argument("--wd", default=1e-4, type=float)
     p.add_argument("--epochs", default=100, type=int)
@@ -93,6 +96,7 @@ def main():
                         augment=not args.no_augment, prefetch=args.prefetch,
                         use_native=args.native_loader),
         optimizer=OptimizerConfig(
+            name=args.optimizer,
             learning_rate=args.lr, momentum=args.momentum,
             weight_decay=args.wd,
             warmup_steps=args.warmup_epochs * steps_per_epoch),

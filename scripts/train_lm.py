@@ -15,7 +15,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from _cpu_devices import force_cpu_devices
+from scripts._cpu_devices import force_cpu_devices
 
 force_cpu_devices(("--dp", "--pp", "--tp", "--sp", "--ep"))
 

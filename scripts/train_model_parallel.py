@@ -41,6 +41,9 @@ def parse_args():
     p.add_argument("--microbatches", default=1, type=int,
                    help="1 = reference's naive schedule; >1 = GPipe/1F1B")
     p.add_argument("--schedule", default="gpipe", choices=["gpipe", "1f1b"])
+    p.add_argument("--virtual-stages", default=1, type=int,
+                   help=">1 = Megatron interleaved placement: each device "
+                        "owns that many non-contiguous layer chunks")
     p.add_argument("--boundaries", default=None,
                    help="comma-separated unit boundaries, e.g. 0,4,10,16,19")
     p.add_argument("--lr", default=0.4, type=float)
@@ -75,6 +78,7 @@ def main():
         num_microbatches=args.microbatches,
         stage_boundaries=boundaries,
         pipeline_schedule=args.schedule,
+        virtual_stages=args.virtual_stages,
         log_name=args.log_name or f"{args.batch_size}",
     )
     from distributed_model_parallel_tpu.train.pipeline_trainer import (

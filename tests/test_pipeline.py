@@ -24,7 +24,7 @@ from distributed_model_parallel_tpu.train.trainer import (
 
 
 def _setup(num_stages, *, model_name="tinycnn", bn="local", microbatches=1,
-           lr=0.1, schedule="gpipe"):
+           lr=0.1, schedule="gpipe", virtual_stages=1):
     devices = jax.devices()[:num_stages]
     model = get_model(ModelConfig(name=model_name, batchnorm=bn))
     tx = make_optimizer(OptimizerConfig(learning_rate=lr, warmup_steps=0,
@@ -32,7 +32,8 @@ def _setup(num_stages, *, model_name="tinycnn", bn="local", microbatches=1,
     runner = PipelineRunner(
         model, devices, tx=tx, rng=jax.random.key(0),
         sample_shape=(2, 32, 32, 3), mean=CIFAR10_MEAN, std=CIFAR10_STD,
-        num_microbatches=microbatches, augment=False, schedule=schedule)
+        num_microbatches=microbatches, augment=False, schedule=schedule,
+        virtual_stages=virtual_stages)
     return model, tx, runner
 
 
@@ -157,3 +158,36 @@ def test_mobilenet_pipeline_matches_reference_split(batch):
     assert runner.slices == [(0, 4), (4, 10), (10, 16), (16, 19)]
     metrics = runner.train_step(jax.random.key(9), images[:8], labels[:8])
     assert np.isfinite(metrics["loss"])
+
+
+def test_interleaved_virtual_stages_match_single_device(batch):
+    """V=2 on 2 devices (4 chunks, round-robin placement): numerics
+    identical to a single-device step."""
+    images, labels = batch
+    model, tx, runner = _setup(2, virtual_stages=2)
+    assert runner.num_chunks == 4
+    # round-robin placement: chunks 0,2 on device 0; chunks 1,3 on device 1
+    devs = [jax.tree.leaves(st.params)[0].devices() for st in runner.stages]
+    assert devs[0] == devs[2] and devs[1] == devs[3] and devs[0] != devs[1]
+    metrics = runner.train_step(jax.random.key(9), images, labels)
+    ts, single_metrics = _single_device_step(model, tx, images, labels)
+    assert metrics["loss"] == pytest.approx(float(single_metrics["loss"]),
+                                            rel=1e-5)
+    for a, b in zip(jax.tree.leaves(runner.merged_params()),
+                    jax.tree.leaves(jax.device_get(ts.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_matches_plain_pipeline(batch):
+    """V=2 x S=2 == V=1 x S=4 exactly (same 4-way chunking, different
+    placement), with 1F1B microbatching on top."""
+    images, labels = batch
+    _, _, r_virt = _setup(2, bn="none", microbatches=2, schedule="1f1b",
+                          virtual_stages=2)
+    _, _, r_flat = _setup(4, bn="none", microbatches=2, schedule="1f1b")
+    m1 = r_virt.train_step(jax.random.key(9), images, labels)
+    m2 = r_flat.train_step(jax.random.key(9), images, labels)
+    assert m1["loss"] == pytest.approx(m2["loss"], rel=1e-6)
+    for a, b in zip(jax.tree.leaves(r_virt.merged_params()),
+                    jax.tree.leaves(r_flat.merged_params())):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)

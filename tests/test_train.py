@@ -155,3 +155,32 @@ def test_prefetch_matches_synchronous(tmp_path):
     for a, b in zip(h_sync, h_pre):
         assert a["loss_train"] == pytest.approx(b["loss_train"], rel=1e-6)
         assert a["acc1_val"] == pytest.approx(b["acc1_val"])
+
+
+@pytest.mark.parametrize("name", ["sgd", "adamw", "lamb", "lars"])
+def test_optimizer_family_minimizes_quadratic(name):
+    """Every factory optimizer takes steps that reduce a simple loss."""
+    import optax
+
+    cfg = OptimizerConfig(name=name, learning_rate=0.1,
+                          momentum=0.9, weight_decay=1e-4, warmup_steps=0)
+    tx = make_optimizer(cfg, 100, 1)
+    params = {"w": jnp.array([3.0, -2.0]), "b": jnp.array([1.0])}
+    opt_state = tx.init(params)
+
+    def loss(p):
+        return jnp.sum(p["w"] ** 2) + jnp.sum(p["b"] ** 2)
+
+    l0 = float(loss(params))
+    for _ in range(20):
+        grads = jax.grad(loss)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    assert float(loss(params)) < l0
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.tree.leaves(params))
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(KeyError):
+        make_optimizer(OptimizerConfig(name="adagrad"), 10, 1)
