@@ -134,6 +134,12 @@ class TrainConfig:
     resume: bool = False                    # reference data_parallel.py:21-22,80-87
     log_every_n_steps: int = 30             # reference data_parallel.py:116
     max_inflight_steps: int = 8             # bound on host run-ahead (async dispatch)
+    # Device-resident fast path (gspmd strategy): upload the train set to the
+    # accelerators once and run steps_per_dispatch train steps per jitted
+    # program (lax.scan over on-device index gathers) — amortizes dispatch
+    # overhead and removes per-step host->device image traffic.
+    device_resident_data: bool = False
+    steps_per_dispatch: int = 1
     # Pipeline-specific knobs (used when mesh.stage > 1).
     num_microbatches: int = 1               # 1 == reference's naive schedule
     stage_boundaries: Sequence[int] | None = None  # unit indices; None = balanced

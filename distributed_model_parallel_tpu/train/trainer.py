@@ -23,6 +23,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 
@@ -84,6 +85,38 @@ def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
     return step
 
 
+def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
+                    *, image_shape, mean, std, augment: bool = True,
+                    dtype=jnp.float32) -> Callable:
+    """K train steps per dispatched program (lax.scan) over a
+    device-resident dataset.
+
+    multi(state, rng, images_flat, labels_all, idx[K, B]) -> (state,
+    stacked metrics). Each scan step gathers its batch from the on-device
+    dataset by index — no host→device image traffic and no per-step
+    dispatch, the two costs that dominate small-step training through a
+    remote device transport. The per-step math is exactly
+    ``make_train_step``'s.
+    """
+    step = make_train_step(model, tx, mean=mean, std=std, augment=augment,
+                           dtype=dtype)
+    h, w, c = image_shape
+
+    def multi(state: TrainState, rng: jax.Array, images_flat, labels_all, idx):
+        rngs = jax.random.split(rng, idx.shape[0])
+
+        def body(st, xs):
+            r, ib = xs
+            im = jnp.take(images_flat, ib, axis=0).reshape(
+                ib.shape[0], h, w, c)
+            lb = jnp.take(labels_all, ib, axis=0)
+            return step(st, r, im, lb)
+
+        return jax.lax.scan(body, state, (rngs, idx))
+
+    return multi
+
+
 def make_eval_step(model: StagedModel, *, mean, std, dtype=jnp.float32) -> Callable:
     def step(state: TrainState, images_u8, labels):
         images = normalize(images_u8, mean, std, dtype)
@@ -141,6 +174,11 @@ class Trainer:
         kw = dict(mean=train_ds.mean, std=train_ds.std)
 
         if config.strategy == "ddp":
+            if config.device_resident_data:
+                raise ValueError(
+                    "device_resident_data is only supported with "
+                    "strategy='gspmd' (the ddp path materializes per-replica "
+                    "batches on host)")
             # Explicit per-replica engine: BN state carries a leading
             # per-replica axis sharded over the data axis (parallel/ddp.py).
             from distributed_model_parallel_tpu.parallel.ddp import (
@@ -181,6 +219,25 @@ class Trainer:
                 make_eval_step(self.model, **kw),
                 in_shardings=(self._repl, self._batch_sh, self._batch_sh),
                 out_shardings=self._repl)
+            if config.device_resident_data:
+                # Fast path: dataset lives on device; K steps per dispatch.
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                n = len(train_ds)
+                self._dev_images = jax.device_put(
+                    train_ds.images.reshape(n, -1), self._repl)
+                self._dev_labels = jax.device_put(
+                    np.asarray(train_ds.labels), self._repl)
+                idx_sh = NamedSharding(self.spec.mesh,
+                                       P(None, self.spec.data_axis))
+                self._multi_step = jax.jit(
+                    make_multi_step(self.model, self.tx,
+                                    image_shape=train_ds.images.shape[1:],
+                                    augment=config.data.augment, **kw),
+                    in_shardings=(self._repl, self._repl, self._repl,
+                                  self._repl, idx_sh),
+                    out_shardings=(self._repl, self._repl),
+                    donate_argnums=(0,))
         else:
             raise KeyError(f"unknown strategy {config.strategy!r}")
 
@@ -225,15 +282,23 @@ class Trainer:
         never blocks on a step it doesn't need yet — step k+1 dispatches
         while step k still runs (async dispatch). The reference instead
         syncs every batch via ``.item()`` on loss/accuracy (``utils.py:64-68``).
+        Entries may be stacked over a leading K axis (multi-step dispatch).
         """
         for metrics in jax.device_get(pending):
-            b = float(metrics["batch"])
-            meters["loss"].update(float(metrics["loss"]), int(b))
-            meters["acc1"].update(float(metrics["correct@1"]) / b * 100, int(b))
-            meters["acc5"].update(float(metrics["correct@5"]) / b * 100, int(b))
+            loss = np.atleast_1d(metrics["loss"])
+            batch = np.atleast_1d(metrics["batch"])
+            c1 = np.atleast_1d(metrics["correct@1"])
+            c5 = np.atleast_1d(metrics["correct@5"])
+            for j in range(loss.shape[0]):
+                b = float(batch[j])
+                meters["loss"].update(float(loss[j]), int(b))
+                meters["acc1"].update(float(c1[j]) / b * 100, int(b))
+                meters["acc5"].update(float(c5[j]) / b * 100, int(b))
         pending.clear()
 
     def train_epoch(self, epoch: int) -> EpochResult:
+        if getattr(self, "_multi_step", None) is not None:
+            return self._train_epoch_device_resident(epoch)
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
         timer = StepTimer()
         pending: list = []
@@ -256,6 +321,49 @@ class Trainer:
         n = len(pending)
         self._drain(pending, meters)
         timer.window_done(n)
+        return EpochResult(meters["loss"].avg, meters["acc1"].avg,
+                           meters["acc5"].avg, timer.step.avg, timer.data.avg)
+
+    def _train_epoch_device_resident(self, epoch: int) -> EpochResult:
+        """Epoch over the on-device dataset: K steps per dispatched program.
+
+        Batch composition is identical to the materializing path — both use
+        ``BatchLoader.epoch_indices()`` — so switching the fast path on
+        changes performance, not math.
+        """
+        meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
+        timer = StepTimer()
+        pending: list = []
+        bs = self.train_loader.batch_size
+        K = max(1, self.config.steps_per_dispatch)
+        idx = self.train_loader.epoch_indices()
+        steps = len(idx) // bs
+        idx = idx[:steps * bs].reshape(steps, bs)
+        inflight = 0
+        for i in range(0, steps, K):
+            chunk = np.ascontiguousarray(idx[i:i + K])
+            timer.data_ready()
+            self._rng, sub = jax.random.split(self._rng)
+            self.state, metrics = self._multi_step(
+                self.state, sub, self._dev_images, self._dev_labels,
+                jnp.asarray(chunk))
+            pending.append(metrics)
+            inflight += chunk.shape[0]
+            # Log when a multiple of log_every_n_steps falls inside this
+            # dispatch's [i, i+K) step window — same cadence as the
+            # per-batch path.
+            log_now = i % self.config.log_every_n_steps < chunk.shape[0]
+            if log_now or len(pending) >= self._max_inflight:
+                self._drain(pending, meters)
+                timer.window_done(inflight)
+                inflight = 0
+            if log_now:
+                self.logger.log_step(epoch, i, loss=meters["loss"].avg,
+                                     acc1=meters["acc1"].avg,
+                                     step_time=timer.step.avg,
+                                     data_time=timer.data.avg)
+        self._drain(pending, meters)
+        timer.window_done(inflight)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
                            meters["acc5"].avg, timer.step.avg, timer.data.avg)
 

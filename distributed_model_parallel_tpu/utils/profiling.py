@@ -5,16 +5,25 @@ per epoch (``utils.py:41,48,64-74``; SURVEY.md §5). Equivalent meters live in
 ``train/metrics.py`` (StepTimer). This module adds the TPU-native upgrade:
 ``jax.profiler`` traces viewable in TensorBoard/Perfetto, plus a lightweight
 step-latency profiler for benchmarking jitted step functions.
+
+**Why timing forces a host fetch:** on some device transports (notably the
+remote-TPU tunnel this environment uses) ``jax.block_until_ready`` returns
+before the device actually finishes, so per-call wall-clock around it
+measures dispatch latency, not execution (observed: an 8192^3 matmul
+"finishing" in 30µs ≈ 30,000 TFLOPS). A device→host copy of the result
+cannot lie — the bytes only exist once the program ran. ``time_step``
+therefore times a whole loop of calls bracketed by one host fetch, and
+subtracts the separately-measured fetch round-trip cost.
 """
 
 from __future__ import annotations
 
 import contextlib
-import statistics
 import time
 from typing import Callable
 
 import jax
+import numpy as np
 
 
 @contextlib.contextmanager
@@ -27,27 +36,57 @@ def trace(log_dir: str = "/tmp/dmp_trace"):
         jax.profiler.stop_trace()
 
 
+def fetch(out) -> None:
+    """Force device→host transfer of one leaf of ``out`` (true sync point).
+
+    Devices execute enqueued programs in order, so fetching the last
+    program's output waits for everything before it too.
+    """
+    leaves = jax.tree.leaves(out)
+    if leaves:
+        np.asarray(leaves[-1])
+
+
+def fetch_overhead() -> float:
+    """Seconds for one device→host round trip of an already-computed value
+    (pure transport latency; ~0 locally, tens of ms over a tunnel)."""
+    a = jax.jit(lambda v: v + 1)(jax.numpy.zeros(()))
+    b = jax.jit(lambda v: v + 2)(jax.numpy.zeros(()))
+    fetch(a)   # waits for both trivial programs; warms the transport path
+    t0 = time.perf_counter()
+    fetch(b)   # executed but not host-cached: a pure round trip
+    return time.perf_counter() - t0
+
+
 def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10,
               **kwargs) -> dict:
-    """Steady-state latency of a jitted callable (seconds).
+    """Steady-state per-call latency of a jitted callable (seconds).
 
-    Blocks on the last output each iteration, so async dispatch does not
-    fake the numbers.
+    Times ``iters`` back-to-back calls bracketed by a single host fetch of
+    the final output (see module docstring for why), then subtracts the
+    measured fetch round-trip. Reported keys keep the historical names;
+    ``median_s`` == ``mean_s`` == the amortized per-call time.
     """
     out = None
     for _ in range(warmup):
         out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
-    samples = []
+    fetch(out)
+    t_fetch = fetch_overhead()
+
+    t0 = time.perf_counter()
     for _ in range(iters):
-        t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        jax.block_until_ready(out)
-        samples.append(time.perf_counter() - t0)
+    fetch(out)
+    total = time.perf_counter() - t0
+    # Floor: a noisy fetch-overhead sample larger than a fast timed loop
+    # must not produce 0 (callers divide by this).
+    per_call = max(1e-9, total - t_fetch) / iters
     return {
-        "mean_s": statistics.fmean(samples),
-        "median_s": statistics.median(samples),
-        "min_s": min(samples),
-        "max_s": max(samples),
+        "mean_s": per_call,
+        "median_s": per_call,
+        "min_s": per_call,
+        "max_s": per_call,
+        "total_s": total,
+        "fetch_overhead_s": t_fetch,
         "iters": iters,
     }

@@ -43,6 +43,12 @@ def parse_args():
                             "synthetic"])
     p.add_argument("--model", default="mobilenetv2")
     p.add_argument("--lr", default=0.4, type=float)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture an XLA profiler trace of the run into DIR")
+    p.add_argument("--device-data", action="store_true",
+                   help="device-resident dataset fast path (gspmd only)")
+    p.add_argument("--steps-per-dispatch", default=1, type=int,
+                   help="train steps per jitted program with --device-data")
     p.add_argument("--optimizer", default="sgd",
                    choices=["sgd", "adamw", "lamb", "lars"],
                    help="lars/lamb: layerwise-adaptive large-batch training")
@@ -103,13 +109,23 @@ def main():
         mesh=MeshConfig(data=n),
         epochs=args.epochs,
         resume=args.resume,
+        device_resident_data=args.device_data,
+        steps_per_dispatch=args.steps_per_dispatch,
         strategy="ddp" if args.ddp else "gspmd",
         ddp_bucket_bytes=args.bucket_mb * 1024 * 1024 or None,
         ddp_allreduce=args.allreduce,
         log_name=args.log_name or f"data_para_{args.batch_size}",
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer
-    Trainer(config).fit()
+    trainer = Trainer(config)
+    if args.profile:
+        # XLA profiler trace (TensorBoard/Perfetto); use a short --epochs run
+        # — the trace covers the whole fit.
+        from distributed_model_parallel_tpu.utils.profiling import trace
+        with trace(args.profile):
+            trainer.fit()
+    else:
+        trainer.fit()
 
 
 if __name__ == "__main__":

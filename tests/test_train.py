@@ -184,3 +184,35 @@ def test_optimizer_family_minimizes_quadratic(name):
 def test_unknown_optimizer_rejected():
     with pytest.raises(KeyError):
         make_optimizer(OptimizerConfig(name="adagrad"), 10, 1)
+
+
+def test_device_resident_multi_step_matches_regular_path(tmp_path):
+    """The device-resident K-steps-per-dispatch path must produce the same
+    parameters as the materializing per-step path: same seed -> same
+    permutations (shared BatchLoader.epoch_indices), augment off -> rng
+    stream differences don't matter."""
+    base = dict(
+        data=DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
+                        synthetic_train_size=128, synthetic_eval_size=32,
+                        augment=False),
+        epochs=1,
+    )
+    t_reg = Trainer(tiny_config(tmp_path / "a", **base))
+    t_dev = Trainer(tiny_config(
+        tmp_path / "b", **base,
+        device_resident_data=True, steps_per_dispatch=3))  # 4 steps: 3 + 1
+    h_reg = t_reg.fit(epochs=1)
+    h_dev = t_dev.fit(epochs=1)
+    assert h_reg[0]["loss_train"] == pytest.approx(h_dev[0]["loss_train"],
+                                                   rel=1e-5)
+    for a, b in zip(jax.tree.leaves(jax.device_get(t_reg.state.params)),
+                    jax.tree.leaves(jax.device_get(t_dev.state.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_device_resident_with_augment_trains(tmp_path):
+    cfg = tiny_config(tmp_path, device_resident_data=True,
+                      steps_per_dispatch=2)
+    t = Trainer(cfg)
+    history = t.fit(epochs=3)
+    assert history[-1]["loss_train"] < history[0]["loss_train"]
