@@ -107,9 +107,14 @@ def main() -> None:
         m = dispatch()
     fetch(m)
     n_steps = n_dispatch * steps_per_dispatch
+    total = time.perf_counter() - t0
+    if total <= t_fetch:
+        _log(f"WARNING: timed loop ({total * 1e3:.1f} ms) <= fetch round-trip "
+             f"({t_fetch * 1e3:.1f} ms); measurement invalid — raise "
+             f"DMP_BENCH_STEPS")
     # Floor guards against a noisy single-sample fetch_overhead exceeding a
     # short timed loop (division by zero downstream).
-    dt = max(1e-9, time.perf_counter() - t0 - t_fetch) / n_steps
+    dt = max(1e-9, total - t_fetch) / n_steps
 
     samples_per_sec_per_chip = batch / dt / n_chips
     print(json.dumps({

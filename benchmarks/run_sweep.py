@@ -77,8 +77,8 @@ def batch_sweep(args, results):
 
         stats = time_step(step, warmup=2, iters=args.steps)
         row = {"sweep": "batch_size", "model": args.model, "batch_size": bs,
-               "lr": lr, "time_per_batch_s": round(stats["median_s"], 4),
-               "samples_per_s": round(bs / stats["median_s"], 1)}
+               "lr": lr, "time_per_batch_s": round(stats["mean_s"], 4),
+               "samples_per_s": round(bs / stats["mean_s"], 1)}
         results.append(row)
         print(json.dumps(row), flush=True)
 
@@ -87,7 +87,7 @@ def attention_sweep(args, results):
     import jax
     import jax.numpy as jnp
     from distributed_model_parallel_tpu.ops.pallas_attention import flash_attention
-    from distributed_model_parallel_tpu.utils.profiling import time_step
+    from distributed_model_parallel_tpu.utils.profiling import time_fn_in_scan
 
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, heads, head_dim = 4, 8, 64
@@ -107,17 +107,29 @@ def attention_sweep(args, results):
             p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
             return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
-        impls = {"xla": jax.jit(xla_attn)}
+        impls = {"xla": xla_attn}
         if on_tpu:
-            impls["flash_pallas"] = jax.jit(
+            impls["flash_pallas"] = (
                 lambda q, k, v: flash_attention(q, k, v, causal=True))
         for impl_name, fn in impls.items():
-            stats = time_step(lambda: fn(q, k, v), warmup=2, iters=args.steps)
+            # In-scan timing: attention runs fused inside larger programs in
+            # real use, so kernel time (not per-program dispatch) is the
+            # comparable quantity.
+            try:
+                dt = time_fn_in_scan(fn, q, k, v, iters=args.steps)
+            except Exception as e:
+                # e.g. XLA fails to compile the materialized T^2 scores at
+                # long seq — record the failure, keep sweeping.
+                row = {"sweep": "attention", "impl": impl_name,
+                       "seq_len": seq, "failed": type(e).__name__}
+                results.append(row)
+                print(json.dumps(row), flush=True)
+                continue
             # causal: ~half the FLOPs of full attention
             flops = 2 * 2 * batch * heads * seq * seq * head_dim / 2
             row = {"sweep": "attention", "impl": impl_name, "seq_len": seq,
-                   "time_s": round(stats["median_s"], 5),
-                   "tflops": round(flops / stats["median_s"] / 1e12, 2)}
+                   "time_s": round(dt, 5),
+                   "tflops": round(flops / dt / 1e12, 2)}
             results.append(row)
             print(json.dumps(row), flush=True)
     if not on_tpu:

@@ -352,7 +352,7 @@ class Trainer:
             # Log when a multiple of log_every_n_steps falls inside this
             # dispatch's [i, i+K) step window — same cadence as the
             # per-batch path.
-            log_now = i % self.config.log_every_n_steps < chunk.shape[0]
+            log_now = (-i) % self.config.log_every_n_steps < chunk.shape[0]
             if log_now or len(pending) >= self._max_inflight:
                 self._drain(pending, meters)
                 timer.window_done(inflight)

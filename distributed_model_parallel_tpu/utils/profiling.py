@@ -23,6 +23,7 @@ import time
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -58,14 +59,70 @@ def fetch_overhead() -> float:
     return time.perf_counter() - t0
 
 
+def _warn_if_swamped(total: float, t_fetch: float, who: str) -> bool:
+    """A timed loop shorter than the (single-sample) fetch round-trip means
+    the measurement is noise — say so rather than report inflated numbers."""
+    if total <= t_fetch:
+        import sys
+        print(f"[{who}] WARNING: timed loop ({total * 1e3:.1f} ms) <= fetch "
+              f"round-trip ({t_fetch * 1e3:.1f} ms); measurement invalid — "
+              f"raise iters or use a bigger workload", file=sys.stderr)
+        return False
+    return True
+
+
+def time_fn_in_scan(fn: Callable, *args, iters: int = 20) -> float:
+    """True device seconds per call of a pure array function.
+
+    Runs ``iters`` calls inside ONE jitted ``lax.scan`` — no per-call
+    dispatch at all — bracketed by a single host fetch. Use for kernel
+    comparisons (e.g. attention implementations), where per-program
+    dispatch overhead is not part of what's being measured; ``time_step``
+    measures dispatched-call latency instead. The first argument must be a
+    float array; a data dependency through the scan carry defeats CSE.
+    Iteration count auto-scales (up to 16x) until the timed loop clearly
+    exceeds the fetch round-trip, so fast kernels still measure validly
+    over a high-latency transport.
+    """
+    first = args[0]
+
+    def measure(n: int) -> tuple[float, float]:
+        @jax.jit
+        def run(first):
+            def body(acc, _):
+                out = fn(first + acc.astype(first.dtype) * 0, *args[1:])
+                leaf = jax.tree.leaves(out)[0]
+                return acc + (jnp.sum(leaf) * 1e-20).astype(jnp.float32), ()
+
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None,
+                                  length=n)
+            return acc
+
+        fetch(run(first))          # compile + warm
+        t_fetch = fetch_overhead()
+        t0 = time.perf_counter()
+        fetch(run(first))
+        return time.perf_counter() - t0, t_fetch
+
+    n = iters
+    for attempt in range(3):
+        total, t_fetch = measure(n)
+        if total > 2 * t_fetch or attempt == 2:
+            break
+        n *= 4                     # too fast to resolve — lengthen the loop
+    _warn_if_swamped(total, t_fetch, "time_fn_in_scan")
+    return max(1e-9, total - t_fetch) / n
+
+
 def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10,
               **kwargs) -> dict:
     """Steady-state per-call latency of a jitted callable (seconds).
 
     Times ``iters`` back-to-back calls bracketed by a single host fetch of
     the final output (see module docstring for why), then subtracts the
-    measured fetch round-trip. Reported keys keep the historical names;
-    ``median_s`` == ``mean_s`` == the amortized per-call time.
+    measured fetch round-trip. Only aggregate keys are returned — per-call
+    percentiles are unknowable under single-fetch timing, so none are
+    fabricated.
     """
     out = None
     for _ in range(warmup):
@@ -80,13 +137,12 @@ def time_step(fn: Callable, *args, warmup: int = 2, iters: int = 10,
     total = time.perf_counter() - t0
     # Floor: a noisy fetch-overhead sample larger than a fast timed loop
     # must not produce 0 (callers divide by this).
+    valid = _warn_if_swamped(total, t_fetch, "time_step")
     per_call = max(1e-9, total - t_fetch) / iters
     return {
         "mean_s": per_call,
-        "median_s": per_call,
-        "min_s": per_call,
-        "max_s": per_call,
         "total_s": total,
         "fetch_overhead_s": t_fetch,
         "iters": iters,
+        "valid": valid,
     }
