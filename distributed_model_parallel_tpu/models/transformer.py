@@ -47,6 +47,16 @@ class TransformerConfig:
     tp_axis: str | None = None     # tensor parallel: heads/ffn sharded
     sp_axis: str | None = None     # sequence parallel: ring attention
     sp_impl: str = "ring"          # "ring" | "ulysses"
+    # Attention kernel for the non-sequence-parallel path: "auto" uses the
+    # pallas flash kernel on TPU for sequences >= 2048, where its forward is
+    # 3-10x faster than XLA (benchmarks/run_sweep.py). Under jax.grad the
+    # kernel's custom VJP recomputes attention with XLA, so training gets
+    # checkpoint-style residual memory (q/k/v saved instead of the T^2
+    # score matrix per layer) at the cost of one extra attention forward —
+    # a dedicated flash backward kernel is future work, and one layer's T^2
+    # scores still materialize inside the backward. "xla" / "flash" force
+    # one implementation.
+    attn_impl: str = "auto"
     remat: bool = False            # jax.checkpoint each block: recompute
                                    # activations in backward (HBM for FLOPs —
                                    # the long-context memory lever)
@@ -134,6 +144,18 @@ def _attention(q, k, v, cfg: TransformerConfig):
             ulysses_attention,
         )
         return ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+    if cfg.attn_impl not in ("auto", "xla", "flash"):
+        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}; "
+                         f"known: auto, xla, flash")
+    use_flash = cfg.attn_impl == "flash" or (
+        cfg.attn_impl == "auto"
+        and q.shape[1] >= 2048
+        and jax.devices()[0].platform == "tpu")
+    if use_flash:
+        from distributed_model_parallel_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+        return flash_attention(q, k, v, causal=True)
     return full_attention(q, k, v, causal=True)
 
 

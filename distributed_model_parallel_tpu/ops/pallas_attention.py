@@ -67,22 +67,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = True, block_q: int = 512,
-                    block_k: int = 1024,
-                    interpret: bool | None = None) -> jax.Array:
-    """[B, T, H, D] -> [B, T, H, D] causal attention, pallas-blocked.
-
-    ``interpret=None`` auto-selects interpret mode off-TPU. Default block
-    sizes come from a v5e sweep with forced-sync timing (block 512x1024 is
-    ~6x faster than 128x128 at seq 2-4k: 63 vs 9 TFLOPS at seq 2048;
-    blocks clamp to the sequence length for short inputs). Beats plain XLA
-    attention from seq ~2048 up, and still compiles at seq 8192 where the
-    materialized T^2 score tensor makes XLA fail.
-    """
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+
+    # Ragged sequence lengths: for causal attention, zero-padding the
+    # sequence END is exact — padded keys occupy future positions no real
+    # query attends to, and padded query rows are sliced off below. This
+    # keeps blocks >= the TPU tile (8x128) for any T. Non-causal padding
+    # would need a key mask the kernel doesn't carry, so reject ragged T
+    # there rather than hand Mosaic an illegal tile.
+    t_orig = t
+    if t % 128:
+        if not causal and not interpret:
+            raise ValueError(
+                f"non-causal flash attention needs seq len divisible by 128 "
+                f"on TPU (got {t}); pad inputs or use full_attention")
+        if causal:
+            t = -(-t // 128) * 128
+            pad_t = [(0, 0), (0, t - t_orig), (0, 0), (0, 0)]
+            q, k, v = (jnp.pad(x, pad_t) for x in (q, k, v))
 
     def clamp(block: int) -> int:
         # Largest block <= requested that divides t (halving preserves the
@@ -122,4 +128,44 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     )(qf, kf, vf)
 
     out = out.reshape(b, h, t, d_pad).transpose(0, 2, 1, 3)
-    return out[..., :d]
+    return out[:, :t_orig, :, :d]
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    """Backward: recompute attention with the XLA formulation and pull the
+    cotangent through its VJP. Forward keeps flash's O(T) memory and speed;
+    backward pays the materialized-scores cost (a dedicated flash backward
+    kernel is the future upgrade). Mathematically identical to the kernel —
+    parity pinned in tests/test_pallas_attention.py."""
+    from distributed_model_parallel_tpu.ops.ring_attention import (
+        full_attention,
+    )
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: full_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 1024,
+                    interpret: bool | None = None) -> jax.Array:
+    """[B, T, H, D] -> [B, T, H, D] causal attention, pallas-blocked.
+
+    ``interpret=None`` auto-selects interpret mode off-TPU. Default block
+    sizes come from a v5e sweep with forced-sync timing (block 512x1024 is
+    ~6x faster than 128x128 at seq 2-4k: 63 vs 9 TFLOPS at seq 2048;
+    blocks clamp to the sequence length for short inputs). Beats plain XLA
+    attention from seq ~2048 up, and still compiles at seq 8192 where the
+    materialized T^2 score tensor makes XLA fail. Differentiable via a
+    custom VJP (XLA-recompute backward, ``_flash_bwd``).
+    """
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
