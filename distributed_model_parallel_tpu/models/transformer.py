@@ -144,17 +144,11 @@ def _attention(q, k, v, cfg: TransformerConfig):
             ulysses_attention,
         )
         return ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
-    if cfg.attn_impl not in ("auto", "xla", "flash"):
-        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}; "
-                         f"known: auto, xla, flash")
-    use_flash = cfg.attn_impl == "flash" or (
-        cfg.attn_impl == "auto"
-        and q.shape[1] >= 2048
-        and jax.devices()[0].platform == "tpu")
-    if use_flash:
-        from distributed_model_parallel_tpu.ops.pallas_attention import (
-            flash_attention,
-        )
+    from distributed_model_parallel_tpu.ops.pallas_attention import (
+        flash_attention,
+        should_use_flash,
+    )
+    if should_use_flash(q.shape[1], causal=True, impl=cfg.attn_impl):
         return flash_attention(q, k, v, causal=True)
     return full_attention(q, k, v, causal=True)
 
@@ -181,21 +175,28 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig
     x = x + o
 
     h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h, aux = _ffn(bp, h, cfg, tp_axis=cfg.tp_axis, ep_axis=cfg.ep_axis)
+    return x + h, aux
+
+
+def _ffn(bp: dict, h: jax.Array, cfg: TransformerConfig, *,
+         tp_axis: str | None, ep_axis: str | None):
+    """Post-attention MLP tail, shared by the training path (``block_apply``)
+    and cached decoding (``_decode_block``) so they cannot diverge.
+    Returns (y, aux)."""
     if cfg.moe_experts:
         from distributed_model_parallel_tpu.ops.moe import moe_ffn
-        h, aux = moe_ffn(
+        y, aux = moe_ffn(
             {"router": bp["router"], "w_in": bp["w_in"],
              "w_out": bp["w_out"]},
-            h, cfg.moe, ep_axis=cfg.ep_axis)
-        return x + h, aux.astype(jnp.float32)
-    h = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
-    h = h @ bp["w2"]
-    if cfg.tp_axis is not None:
-        h = jax.lax.psum(h, cfg.tp_axis)
-        h = h + bp["b2"]                     # bias added once, post-psum
-    else:
-        h = h + bp["b2"]
-    return x + h, jnp.zeros((), jnp.float32)
+            h, cfg.moe, ep_axis=ep_axis)
+        return y, aux.astype(jnp.float32)
+    y = jax.nn.gelu(h @ bp["w1"] + bp["b1"])
+    y = y @ bp["w2"]
+    if tp_axis is not None:
+        y = jax.lax.psum(y, tp_axis)
+    y = y + bp["b2"]                         # bias added once, post-psum
+    return y, jnp.zeros((), jnp.float32)
 
 
 def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig
@@ -255,6 +256,115 @@ def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,
     """Mean next-token cross-entropy (+ weighted MoE load-balance loss)."""
     logits, aux = apply_with_aux(params, tokens, cfg)
     return token_loss(logits, targets, aux, cfg)
+
+
+def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
+                  pos: jax.Array, cfg: TransformerConfig):
+    """One block for ONE token position with a KV cache.
+
+    x: [B, 1, d]; kc/vc: [B, T_total, H, Dh] (this layer's cache). Returns
+    (x, kc, vc) with the caches updated at ``pos``. Masking is by position
+    index, so shapes stay static under scan (no data-dependent slicing).
+    """
+    b = x.shape[0]
+    total = kc.shape[1]
+
+    h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+    qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])   # [B,1,H,3*Dh]
+    q, k, v = jnp.split(qkv, 3, axis=-1)               # each [B,1,H,Dh]
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * (cfg.head_dim ** -0.5)
+    mask = jnp.arange(total)[None, None, None, :] <= pos
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)           # [B,1,H,Dh]
+    x = x + o.reshape(b, 1, -1) @ bp["wo"]
+
+    h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+    h, _ = _ffn(bp, h, cfg, tp_axis=None, ep_axis=None)
+    return x + h, kc, vc
+
+
+def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
+             steps: int, *, rng: jax.Array | None = None,
+             temperature: float = 0.0) -> jax.Array:
+    """Autoregressive decoding with a per-layer KV cache.
+
+    prompt: [B, T0] int32 -> [B, T0 + steps]. Greedy when temperature == 0,
+    else softmax sampling at the given temperature. The whole decode is one
+    jittable ``lax.scan`` over positions (static shapes; cache updated via
+    dynamic_update_slice), the TPU-native replacement for a Python
+    token-by-token loop. Single-program only — no mesh axes are consulted
+    (run it on replicated params).
+
+    The reference has no inference path at all; this rounds out the LM
+    tooling the flagship model needs.
+    """
+    b, t0 = prompt.shape
+    total = t0 + steps
+    if steps < 1:
+        raise ValueError("steps must be >= 1")
+    if total > cfg.max_seq_len:
+        raise ValueError(f"prompt + steps = {total} exceeds max_seq_len "
+                         f"{cfg.max_seq_len}")
+    L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def sample(logits, sub):
+        if temperature > 0:
+            return jax.random.categorical(sub, logits / temperature
+                                          ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # -- Prefill: one batched forward over the whole prompt fills every
+    # layer's KV cache at once (O(1) forwards, not O(t0) sequential steps).
+    x = embed(params, prompt, cfg)
+
+    def prefill_layer(x, bp):
+        h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+        qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        o = full_attention(q, k, v, causal=True)
+        x = x + o.reshape(b, t0, -1) @ bp["wo"]
+        h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+        h, _ = _ffn(bp, h, cfg, tp_axis=None, ep_axis=None)
+        return x + h, (k.astype(cfg.dtype), v.astype(cfg.dtype))
+
+    x, (ks, vs) = jax.lax.scan(prefill_layer, x, params["blocks"])
+    pad = [(0, 0), (0, 0), (0, total - t0), (0, 0), (0, 0)]
+    cache_k = jnp.pad(ks, pad)               # [L, B, total, H, Dh]
+    cache_v = jnp.pad(vs, pad)
+    rng, sub = jax.random.split(rng)
+    tok0 = sample(unembed(params, x)[:, -1], sub)   # token at position t0
+
+    # -- Decode: one cached step per new position.
+    def forward_one(cache_k, cache_v, tok, pos):
+        x = params["embed"][tok][:, None, :] + jax.lax.dynamic_slice_in_dim(
+            params["pos"], pos, 1)[None]
+
+        def layer(x, xs):
+            bp, kc, vc = xs
+            x, kc, vc = _decode_block(bp, kc, vc, x, pos, cfg)
+            return x, (kc, vc)
+
+        x, (cache_k, cache_v) = jax.lax.scan(
+            layer, x, (params["blocks"], cache_k, cache_v))
+        return unembed(params, x)[:, 0], cache_k, cache_v   # [B, V]
+
+    def body(carry, pos):
+        cache_k, cache_v, tok, rng = carry
+        logits, cache_k, cache_v = forward_one(cache_k, cache_v, tok, pos)
+        rng, sub = jax.random.split(rng)
+        tok_next = sample(logits, sub)
+        return (cache_k, cache_v, tok_next, rng), tok_next
+
+    # Positions t0 .. total-2 consume tokens t0 .. total-2 and emit
+    # tokens t0+1 .. total-1 (steps-1 of them; tok0 is already emitted).
+    _, toks = jax.lax.scan(
+        body, (cache_k, cache_v, tok0, rng), jnp.arange(t0, total - 1))
+    return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
 
 
 def build_transformer(model_config) -> "TransformerConfig":

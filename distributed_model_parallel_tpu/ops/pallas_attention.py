@@ -154,6 +154,23 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def should_use_flash(t: int, *, causal: bool = True,
+                     impl: str = "auto") -> bool:
+    """Single home for the flash-vs-XLA dispatch heuristic (used by
+    models/transformer and ops/ring_attention): "flash"/"xla" force an
+    implementation; "auto" picks flash on TPU for causal sequences >=
+    2048, where the kernel's forward is 3-10x faster than XLA
+    (benchmarks/run_sweep.py)."""
+    if impl == "flash":
+        return True
+    if impl == "xla":
+        return False
+    if impl != "auto":
+        raise ValueError(f"unknown attn impl {impl!r}; known: auto, xla, flash")
+    return (causal and t >= 2048
+            and jax.devices()[0].platform == "tpu")
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, block_q: int = 512,
                     block_k: int = 1024,

@@ -116,6 +116,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     t = qh.shape[1]
+    # The local compute is ordinary attention over the complete sequence, so
+    # the pallas flash kernel drops in where it wins (shared heuristic).
+    from distributed_model_parallel_tpu.ops.pallas_attention import (
+        flash_attention,
+        should_use_flash,
+    )
+    if should_use_flash(t, causal=causal):
+        return heads_to_seq(flash_attention(qh, kh, vh, causal=causal))
     scale = qh.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
     if causal:
