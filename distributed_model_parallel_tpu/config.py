@@ -67,6 +67,10 @@ class OptimizerConfig:
     cosine_decay_steps: int | None = None   # if None: derived from epochs
     warmup_steps: int = 0
     grad_clip_norm: float | None = None
+    # Gradient accumulation: average grads over k consecutive calls and apply
+    # one optimizer update per k (optax.MultiSteps). A size-b batch at
+    # accum_steps=k matches a size-k*b batch step exactly (mean-loss grads).
+    accum_steps: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +136,9 @@ class TrainConfig:
     log_name: str = "train"
     checkpoint_dir: str = "./checkpoint"
     resume: bool = False                    # reference data_parallel.py:21-22,80-87
+    # Asynchronous checkpointing: persist on a background thread so the next
+    # epoch doesn't stall behind filesystem writes; fit() drains at the end.
+    async_checkpoint: bool = False
     log_every_n_steps: int = 30             # reference data_parallel.py:116
     max_inflight_steps: int = 8             # bound on host run-ahead (async dispatch)
     # Device-resident fast path (gspmd strategy): upload the train set to the

@@ -11,6 +11,8 @@ params, BN state, optimizer state, step and best-acc in one tree.
 from __future__ import annotations
 
 import os
+import re
+import shutil
 from typing import Any
 
 import jax
@@ -18,30 +20,77 @@ import orbax.checkpoint as ocp
 
 
 class Checkpointer:
-    """Best-acc checkpoint + resume over an orbax StandardCheckpointer."""
+    """Best-acc checkpoint + resume over an orbax StandardCheckpointer.
+
+    Saves may be asynchronous (``wait=False``): orbax copies the arrays to
+    host, then persists on a background thread while training continues —
+    the step after a checkpoint no longer stalls behind filesystem writes.
+
+    Crash safety: each save writes a fresh ``{name}-{v}`` directory (orbax
+    commits it with an atomic rename); the previous version is pruned only at
+    the *next* save, after confirming the newer one committed. So there is
+    never a moment with zero committed checkpoints on disk, and a reader in
+    another process sees whichever version last committed. ``restore`` /
+    ``exists`` resolve to the newest committed version (falling back to a
+    bare legacy ``{name}`` directory).
+    """
 
     def __init__(self, directory: str):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._ckpt = ocp.StandardCheckpointer()
 
-    def _path(self, name: str) -> str:
-        return os.path.join(self.directory, name)
+    def _path(self, name: str, version: int | None = None) -> str:
+        leaf = name if version is None else f"{name}-{version}"
+        return os.path.join(self.directory, leaf)
 
-    def save(self, tree: Any, name: str = "ckpt", *, force: bool = True) -> str:
-        path = self._path(name)
-        self._ckpt.save(path, tree, force=force)
-        self._ckpt.wait_until_finished()
+    def _versions(self, name: str) -> list[int]:
+        """Committed version numbers for ``name``, ascending. Orbax tmp dirs
+        carry a ``.orbax-checkpoint-tmp`` suffix and never match."""
+        pat = re.compile(re.escape(name) + r"-(\d+)$")
+        out = []
+        for entry in os.listdir(self.directory):
+            m = pat.match(entry)
+            if m and os.path.isdir(os.path.join(self.directory, entry)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _latest_path(self, name: str) -> str | None:
+        versions = self._versions(name)
+        if versions:
+            return self._path(name, versions[-1])
+        legacy = self._path(name)
+        return legacy if os.path.exists(legacy) else None
+
+    def save(self, tree: Any, name: str = "ckpt", *, force: bool = True,
+             wait: bool = True) -> str:
+        del force  # kept for API compatibility; versioning never overwrites
+        self._ckpt.wait_until_finished()  # the previous save has committed...
+        versions = self._versions(name)
+        for v in versions[:-1]:           # ...so all but the newest can go
+            shutil.rmtree(self._path(name, v), ignore_errors=True)
+        next_v = versions[-1] + 1 if versions else 0
+        path = self._path(name, next_v)
+        self._ckpt.save(path, tree)
+        if wait:
+            self._ckpt.wait_until_finished()
         return path
 
+    def wait_until_finished(self) -> None:
+        """Block until any asynchronous save has fully committed."""
+        self._ckpt.wait_until_finished()
+
     def restore(self, target: Any, name: str = "ckpt") -> Any:
-        """Restore into the structure/shardings of ``target`` (an abstract or
-        concrete pytree). Raises FileNotFoundError if absent."""
-        path = self._path(name)
-        if not os.path.exists(path):
-            raise FileNotFoundError(path)
+        """Restore the newest committed version into the structure/shardings
+        of ``target`` (an abstract or concrete pytree). Raises
+        FileNotFoundError if absent."""
+        self.wait_until_finished()
+        path = self._latest_path(name)
+        if path is None:
+            raise FileNotFoundError(self._path(name))
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
         return self._ckpt.restore(path, abstract)
 
     def exists(self, name: str = "ckpt") -> bool:
-        return os.path.exists(self._path(name))
+        self.wait_until_finished()
+        return self._latest_path(name) is not None

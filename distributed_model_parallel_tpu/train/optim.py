@@ -13,6 +13,8 @@ adaptive large-batch optimizers the reference's large-batch study
 
 from __future__ import annotations
 
+import dataclasses
+
 import optax
 
 from distributed_model_parallel_tpu.config import OptimizerConfig
@@ -43,7 +45,22 @@ def make_schedule(config: OptimizerConfig, steps_per_epoch: int,
 
 def make_optimizer(config: OptimizerConfig, steps_per_epoch: int,
                    epochs: int) -> optax.GradientTransformation:
-    schedule = make_schedule(config, steps_per_epoch, epochs)
+    # steps_per_epoch, warmup_steps and cosine_decay_steps all count gradient
+    # computations (micro-steps); the inner schedule ticks once per applied
+    # update, i.e. per accum_steps of them — convert every length to update
+    # units so the lr curve matches the accum_steps=1 run. Totals are divided
+    # across the whole run (MultiSteps carries partial accumulations over
+    # epoch boundaries, so per-epoch flooring would undercount and leave the
+    # tail of training at lr=0).
+    accum = max(1, config.accum_steps)
+    if accum > 1:
+        config = dataclasses.replace(
+            config,
+            warmup_steps=config.warmup_steps // accum,
+            cosine_decay_steps=(None if config.cosine_decay_steps is None
+                                else max(1, config.cosine_decay_steps // accum)))
+    total_updates = max(1, (steps_per_epoch * epochs) // accum)
+    schedule = make_schedule(config, total_updates, 1)
     parts = []
     if config.grad_clip_norm is not None:
         parts.append(optax.clip_by_global_norm(config.grad_clip_norm))
@@ -67,4 +84,10 @@ def make_optimizer(config: OptimizerConfig, steps_per_epoch: int,
     else:
         raise KeyError(
             f"unknown optimizer {config.name!r}; known: sgd, adamw, lamb, lars")
-    return optax.chain(*parts)
+    tx = optax.chain(*parts)
+    if config.accum_steps > 1:
+        # Running-mean gradient accumulation: the inner transform (and so the
+        # lr schedule) advances once per accum_steps calls; between
+        # boundaries the update is all-zeros, so params hold still.
+        tx = optax.MultiSteps(tx, every_k_schedule=config.accum_steps)
+    return tx

@@ -264,7 +264,8 @@ class Trainer:
 
     def _save(self, epoch: int):
         self.start_epoch = epoch + 1
-        self.ckpt.save(self._ckpt_tree())
+        self.ckpt.save(self._ckpt_tree(),
+                       wait=not self.config.async_checkpoint)
 
     # -- epoch loops ---------------------------------------------------------
     def _shard_batch(self, images, labels):
@@ -404,4 +405,5 @@ class Trainer:
             if ev.acc1 > self.best_acc:
                 self.best_acc = ev.acc1
                 self._save(epoch)
+        self.ckpt.wait_until_finished()
         return history

@@ -58,7 +58,12 @@ def parse_args():
     p.add_argument("--batch-size", "-b", default=512, type=int)
     p.add_argument("--workers", "-j", default=2, type=int)
     p.add_argument("--warmup-epochs", default=10, type=int)
+    p.add_argument("--accum-steps", default=1, type=int,
+                   help="gradient accumulation: one optimizer update per k "
+                        "batches (size-b batch at k == size-k*b batch)")
     p.add_argument("--resume", "-r", action="store_true")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="persist checkpoints on a background thread")
     p.add_argument("--sync-bn", action="store_true",
                    help="SyncBatchNorm semantics (BASELINE config 3)")
     p.add_argument("--ddp", action="store_true",
@@ -105,10 +110,12 @@ def main():
             name=args.optimizer,
             learning_rate=args.lr, momentum=args.momentum,
             weight_decay=args.wd,
-            warmup_steps=args.warmup_epochs * steps_per_epoch),
+            warmup_steps=args.warmup_epochs * steps_per_epoch,
+            accum_steps=args.accum_steps),
         mesh=MeshConfig(data=n),
         epochs=args.epochs,
         resume=args.resume,
+        async_checkpoint=args.async_checkpoint,
         device_resident_data=args.device_data,
         steps_per_dispatch=args.steps_per_dispatch,
         strategy="ddp" if args.ddp else "gspmd",

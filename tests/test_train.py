@@ -216,3 +216,136 @@ def test_device_resident_with_augment_trains(tmp_path):
     t = Trainer(cfg)
     history = t.fit(epochs=3)
     assert history[-1]["loss_train"] < history[0]["loss_train"]
+
+
+def test_grad_accumulation_matches_big_batch():
+    """accum_steps=k over k size-b batches == one size-k*b batch update.
+
+    Mean-loss gradients + MultiSteps' running-mean accumulator make the two
+    mathematically identical; also checks params hold still between update
+    boundaries."""
+    import optax
+
+    def loss_fn(params, x, y):
+        pred = x @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(8,)), jnp.float32)
+    params = {"w": jnp.asarray(rng.normal(size=(4,)), jnp.float32),
+              "b": jnp.zeros(())}
+
+    opt_cfg = dict(name="sgd", learning_rate=0.1, momentum=0.9,
+                   weight_decay=1e-4, warmup_steps=0, cosine_decay_steps=100)
+    tx_big = make_optimizer(OptimizerConfig(**opt_cfg), 1, 1)
+    tx_acc = make_optimizer(OptimizerConfig(**opt_cfg, accum_steps=2), 2, 1)
+
+    # One big-batch step.
+    p_big, s_big = params, tx_big.init(params)
+    g = jax.grad(loss_fn)(p_big, x, y)
+    up, s_big = tx_big.update(g, s_big, p_big)
+    p_big = optax.apply_updates(p_big, up)
+
+    # Two half-batch micro-steps under accumulation.
+    p_acc, s_acc = params, tx_acc.init(params)
+    g0 = jax.grad(loss_fn)(p_acc, x[:4], y[:4])
+    up, s_acc = tx_acc.update(g0, s_acc, p_acc)
+    p_mid = optax.apply_updates(p_acc, up)
+    for a, b in zip(jax.tree.leaves(p_mid), jax.tree.leaves(params)):
+        np.testing.assert_allclose(a, b)  # no update at the half-way point
+    g1 = jax.grad(loss_fn)(p_mid, x[4:], y[4:])
+    up, s_acc = tx_acc.update(g1, s_acc, p_mid)
+    p_acc = optax.apply_updates(p_mid, up)
+
+    for a, b in zip(jax.tree.leaves(p_acc), jax.tree.leaves(p_big)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_grad_accumulation_trains_end_to_end(tmp_path):
+    cfg = tiny_config(
+        tmp_path,
+        optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=0,
+                                  accum_steps=3),
+    )
+    t = Trainer(cfg)
+    history = t.fit(epochs=3)
+    assert history[-1]["loss_train"] < history[0]["loss_train"]
+
+
+def test_async_checkpoint_resume_roundtrip(tmp_path):
+    cfg = tiny_config(tmp_path, async_checkpoint=True)
+    t = Trainer(cfg)
+    # The checkpoint is written only on best-acc epochs; capture the params
+    # as they were at the LAST actual save rather than assuming it was the
+    # final epoch.
+    at_save = {}
+    orig_save = t._save
+
+    def spy_save(epoch):
+        orig_save(epoch)
+        at_save["params"] = jax.device_get(t.state.params)
+
+    t._save = spy_save
+    t.fit(epochs=2)
+    assert at_save, "no checkpoint was written during fit"
+
+    t2 = Trainer(cfg.replace(resume=True))
+    assert t2.start_epoch == t.start_epoch
+    for a, b in zip(jax.tree.leaves(at_save["params"]),
+                    jax.tree.leaves(jax.device_get(t2.state.params))):
+        np.testing.assert_allclose(a, b)
+
+
+def test_checkpoint_versioning_never_deletes_last_committed(tmp_path):
+    """A new save must not remove the previous committed checkpoint until
+    the new one has itself committed (crash safety)."""
+    import os
+    from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(str(tmp_path / "c"))
+    tree = {"w": jnp.arange(4.0)}
+    p0 = ckpt.save(tree, "t")
+    assert os.path.exists(p0)
+    p1 = ckpt.save({"w": jnp.arange(4.0) + 1}, "t", wait=False)
+    # In-flight or not, at least one committed version must exist at all
+    # times; after draining, the newest wins and the old is pruned lazily.
+    ckpt.wait_until_finished()
+    assert os.path.exists(p1)
+    restored = ckpt.restore({"w": jnp.zeros(4)}, "t")
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(4.0) + 1)
+    p2 = ckpt.save({"w": jnp.arange(4.0) + 2}, "t")
+    assert not os.path.exists(p0)   # pruned once two newer commits exist
+    assert os.path.exists(p2)
+
+
+def test_accum_schedule_matches_unaccumulated_lr_curve():
+    """The lr at update u under accum_steps=k equals the lr at micro-step
+    k*u without accumulation — warmup and decay lengths are converted to
+    update units, not left k-times too long."""
+    import optax
+
+    base = dict(name="sgd", learning_rate=0.4, momentum=0.0, weight_decay=0.0,
+                warmup_steps=8)
+    steps_per_epoch, epochs, k = 16, 4, 4
+
+    def lr_trace(cfg, n_calls):
+        tx = make_optimizer(cfg, steps_per_epoch, epochs)
+        params = {"w": jnp.ones(())}
+        s = tx.init(params)
+        lrs = []
+        for _ in range(n_calls):
+            up, s = tx.update({"w": jnp.ones(())}, s, params)
+            lrs.append(-float(jax.tree.leaves(up)[0]))  # sgd: update = -lr*g
+        return lrs
+
+    plain = lr_trace(OptimizerConfig(**base), steps_per_epoch * epochs)
+    accum = lr_trace(OptimizerConfig(**base, accum_steps=k),
+                     steps_per_epoch * epochs)
+    # Updates fire on every k-th call; update u corresponds to micro-step
+    # k*u of the plain run, so compare against the plain trace at stride k.
+    applied = accum[k - 1::k]
+    expected = plain[::k][:len(applied)]
+    np.testing.assert_allclose(applied, expected, rtol=1e-6, atol=1e-8)
+    # Between boundaries the emitted update is exactly zero.
+    assert all(u == 0.0 for i, u in enumerate(accum) if (i + 1) % k)
