@@ -128,7 +128,8 @@ class TrainConfig:
     seed: int = 0
     # Data-parallel engine: "gspmd" = sharded jit (XLA infers the allreduce);
     # "ddp" = explicit shard_map per-replica programs with psum gradient
-    # averaging and per-replica BatchNorm (parallel/ddp.py).
+    # averaging and per-replica BatchNorm (parallel/ddp.py); "fsdp" = ZeRO-3
+    # parameter+optimizer sharding over the data axis (parallel/fsdp.py).
     strategy: str = "gspmd"
     ddp_bucket_bytes: int | None = None     # None = per-leaf psum
     ddp_allreduce: str = "psum"             # "psum" | "bucketed" | "ring"

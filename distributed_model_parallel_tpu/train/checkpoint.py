@@ -69,6 +69,10 @@ class Checkpointer:
         versions = self._versions(name)
         for v in versions[:-1]:           # ...so all but the newest can go
             shutil.rmtree(self._path(name, v), ignore_errors=True)
+        if versions and os.path.exists(self._path(name)):
+            # A versioned save has committed, so a bare legacy `{name}` dir
+            # (pre-versioning format) is stale — prune it too.
+            shutil.rmtree(self._path(name), ignore_errors=True)
         next_v = versions[-1] + 1 if versions else 0
         path = self._path(name, next_v)
         self._ckpt.save(path, tree)

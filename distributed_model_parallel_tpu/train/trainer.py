@@ -202,22 +202,42 @@ class Trainer:
                 bucket_bytes=config.ddp_bucket_bytes,
                 allreduce=config.ddp_allreduce, **kw)
             self._eval_step = make_ddp_eval_step(self.model, self.spec, **kw)
-        elif config.strategy == "gspmd":
+        elif config.strategy in ("gspmd", "fsdp"):
+            if config.strategy == "fsdp":
+                # ZeRO-3: params + optimizer state live sharded over `data`;
+                # XLA's partitioner inserts the just-in-time all-gathers and
+                # gradient reduce-scatters (parallel/fsdp.py). Shard params
+                # *before* building optimizer state, and init that state
+                # directly into its sharded layout (jit + out_shardings) so
+                # the full-size tree never materializes on one device.
+                from distributed_model_parallel_tpu.parallel.fsdp import (
+                    tree_shardings,
+                )
+
+                params_sh = tree_shardings(params, self.spec)
+                params = jax.device_put(params, params_sh)
+                opt_sh = tree_shardings(jax.eval_shape(self.tx.init, params),
+                                        self.spec)
+                opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
+                self._state_sh = TrainState(
+                    step=self._repl, params=params_sh,
+                    model_state=self._repl, opt_state=opt_sh)
+            else:
+                self._state_sh = self._repl
+                opt_state = self.tx.init(params)
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                               model_state=model_state,
-                               opt_state=self.tx.init(params))
-            self._state_sh = self._repl
-            self.state = jax.device_put(state, self._repl)
+                               model_state=model_state, opt_state=opt_state)
+            self.state = jax.device_put(state, self._state_sh)
             self._train_step = jax.jit(
                 make_train_step(self.model, self.tx,
                                 augment=config.data.augment, **kw),
-                in_shardings=(self._repl, self._repl, self._batch_sh,
+                in_shardings=(self._state_sh, self._repl, self._batch_sh,
                               self._batch_sh),
-                out_shardings=(self._repl, self._repl),
+                out_shardings=(self._state_sh, self._repl),
                 donate_argnums=(0,))
             self._eval_step = jax.jit(
                 make_eval_step(self.model, **kw),
-                in_shardings=(self._repl, self._batch_sh, self._batch_sh),
+                in_shardings=(self._state_sh, self._batch_sh, self._batch_sh),
                 out_shardings=self._repl)
             if config.device_resident_data:
                 # Fast path: dataset lives on device; K steps per dispatch.
@@ -234,9 +254,9 @@ class Trainer:
                     make_multi_step(self.model, self.tx,
                                     image_shape=train_ds.images.shape[1:],
                                     augment=config.data.augment, **kw),
-                    in_shardings=(self._repl, self._repl, self._repl,
+                    in_shardings=(self._state_sh, self._repl, self._repl,
                                   self._repl, idx_sh),
-                    out_shardings=(self._repl, self._repl),
+                    out_shardings=(self._state_sh, self._repl),
                     donate_argnums=(0,))
         else:
             raise KeyError(f"unknown strategy {config.strategy!r}")

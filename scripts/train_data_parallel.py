@@ -69,6 +69,10 @@ def parse_args():
     p.add_argument("--ddp", action="store_true",
                    help="explicit shard_map DDP engine (per-replica BN, "
                         "psum grad averaging) instead of GSPMD")
+    p.add_argument("--fsdp", action="store_true",
+                   help="FSDP/ZeRO-3: shard params + optimizer state over "
+                        "the data axis (XLA inserts JIT all-gather / grad "
+                        "reduce-scatter)")
     p.add_argument("--bucket-mb", type=int, default=0,
                    help="DDP gradient bucket size in MiB (0 = per-leaf psum)")
     p.add_argument("--allreduce", default="psum",
@@ -91,6 +95,8 @@ def main():
     best_effort_distributed_init()
     import jax
 
+    if args.ddp and args.fsdp:
+        sys.exit("--ddp and --fsdp are mutually exclusive engines")
     if not args.ddp and (args.allreduce != "psum" or args.bucket_mb):
         print("warning: --allreduce/--bucket-mb select the explicit DDP "
               "gradient transport; without --ddp the GSPMD path lets XLA "
@@ -118,7 +124,7 @@ def main():
         async_checkpoint=args.async_checkpoint,
         device_resident_data=args.device_data,
         steps_per_dispatch=args.steps_per_dispatch,
-        strategy="ddp" if args.ddp else "gspmd",
+        strategy="ddp" if args.ddp else ("fsdp" if args.fsdp else "gspmd"),
         ddp_bucket_bytes=args.bucket_mb * 1024 * 1024 or None,
         ddp_allreduce=args.allreduce,
         log_name=args.log_name or f"data_para_{args.batch_size}",

@@ -26,6 +26,31 @@ from distributed_model_parallel_tpu.config import MeshConfig  # noqa: E402
 from distributed_model_parallel_tpu.mesh import make_mesh  # noqa: E402
 
 
+def tiny_train_config(tmp_path, **kw):
+    """Shared tiny-run TrainConfig factory (tinycnn on synthetic data over an
+    8-way data mesh) used by the trainer-level test modules."""
+    from distributed_model_parallel_tpu.config import (
+        DataConfig,
+        ModelConfig,
+        OptimizerConfig,
+        TrainConfig,
+    )
+
+    defaults = dict(
+        model=ModelConfig(name="tinycnn"),
+        data=DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
+                        synthetic_train_size=96, synthetic_eval_size=32),
+        optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=2),
+        mesh=MeshConfig(data=8),
+        epochs=3,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        log_every_n_steps=1000,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

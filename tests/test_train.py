@@ -28,20 +28,7 @@ from distributed_model_parallel_tpu.train.optim import make_optimizer, make_sche
 from distributed_model_parallel_tpu.train.trainer import Trainer
 
 
-def tiny_config(tmp_path, **kw):
-    defaults = dict(
-        model=ModelConfig(name="tinycnn"),
-        data=DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
-                        synthetic_train_size=96, synthetic_eval_size=32),
-        optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=2),
-        mesh=MeshConfig(data=8),
-        epochs=3,
-        log_dir=str(tmp_path / "log"),
-        checkpoint_dir=str(tmp_path / "ckpt"),
-        log_every_n_steps=1000,
-    )
-    defaults.update(kw)
-    return TrainConfig(**defaults)
+from tests.conftest import tiny_train_config as tiny_config
 
 
 def test_schedule_warmup_and_decay():
@@ -317,6 +304,29 @@ def test_checkpoint_versioning_never_deletes_last_committed(tmp_path):
     p2 = ckpt.save({"w": jnp.arange(4.0) + 2}, "t")
     assert not os.path.exists(p0)   # pruned once two newer commits exist
     assert os.path.exists(p2)
+
+
+def test_checkpoint_legacy_dir_pruned_after_versioned_commit(tmp_path):
+    """A pre-versioning bare ``{name}`` checkpoint is readable, superseded by
+    the first versioned save, and pruned once a versioned save has
+    committed (no stale full snapshot left on disk forever)."""
+    import os
+    from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
+
+    d = tmp_path / "c"
+    legacy = Checkpointer(str(d))
+    legacy._ckpt.save(os.path.join(str(d), "t"), {"w": jnp.zeros(4)})
+    legacy.wait_until_finished()
+
+    ckpt = Checkpointer(str(d))
+    restored = ckpt.restore({"w": jnp.ones(4)}, "t")   # legacy readable
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.zeros(4))
+    ckpt.save({"w": jnp.arange(4.0)}, "t")             # first versioned save
+    assert os.path.exists(os.path.join(str(d), "t"))   # not yet provably safe
+    ckpt.save({"w": jnp.arange(4.0) + 1}, "t")         # a version committed
+    assert not os.path.exists(os.path.join(str(d), "t"))
+    restored = ckpt.restore({"w": jnp.zeros(4)}, "t")
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(4.0) + 1)
 
 
 def test_accum_schedule_matches_unaccumulated_lr_curve():
