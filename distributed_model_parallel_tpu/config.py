@@ -151,6 +151,9 @@ class TrainConfig:
     # Pipeline-specific knobs (used when mesh.stage > 1).
     num_microbatches: int = 1               # 1 == reference's naive schedule
     stage_boundaries: Sequence[int] | None = None  # unit indices; None = balanced
+    # Compute stage_boundaries from XLA per-unit FLOP costs (minimax
+    # partition, parallel/auto_partition.py) instead of equal unit counts.
+    auto_partition: bool = False
     pipeline_schedule: str = "gpipe"        # "gpipe" | "1f1b"
     virtual_stages: int = 1                 # >1 = Megatron interleaved chunks
 

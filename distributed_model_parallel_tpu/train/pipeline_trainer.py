@@ -32,6 +32,16 @@ class PipelineTrainer:
         self.config = config
         if devices is None:
             devices = jax.devices()[:max(config.mesh.stage, 1)]
+        if len(devices) < config.mesh.stage:
+            # Fail loudly rather than silently training a shallower pipeline
+            # than the config (and logs) claim.
+            raise ValueError(
+                f"pipeline depth {config.mesh.stage} needs that many devices, "
+                f"but only {len(devices)} are available; on CPU pass the "
+                f"stage count via the CLI flag (scripts/_cpu_devices.py needs "
+                f"it in argv before jax initializes) or set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{config.mesh.stage}")
         self.devices = devices
 
         train_ds, eval_ds = load_dataset(config.data)
@@ -49,11 +59,25 @@ class PipelineTrainer:
         model = get_model(config.model)
         tx = make_optimizer(config.optimizer, len(self.train_loader),
                             config.epochs)
+        boundaries = config.stage_boundaries
+        if boundaries is None and config.auto_partition:
+            # Cost-balanced split: minimax over XLA per-unit FLOPs, replacing
+            # both the reference's hard-coded ranges (model_parallel.py:99-157)
+            # and the equal-unit-count default.
+            from distributed_model_parallel_tpu.parallel.auto_partition import (
+                auto_boundaries,
+            )
+
+            n_chunks = len(devices) * max(1, config.virtual_stages)
+            micro = max(1, config.data.batch_size // max(
+                1, config.num_microbatches))
+            boundaries = auto_boundaries(
+                model, (micro,) + train_ds.images.shape[1:], n_chunks)
         self.runner = PipelineRunner(
             model, devices, tx=tx, rng=jax.random.key(config.seed),
             sample_shape=(2,) + train_ds.images.shape[1:],
             mean=train_ds.mean, std=train_ds.std,
-            boundaries=config.stage_boundaries,
+            boundaries=boundaries,
             num_microbatches=config.num_microbatches,
             augment=config.data.augment,
             schedule=config.pipeline_schedule,

@@ -23,16 +23,25 @@ def _argv_value(flag: str) -> str | None:
     return None
 
 
-def force_cpu_devices(flags: tuple[str, ...] = ("--num-devices",)) -> None:
+def force_cpu_devices(
+        flags: tuple[str | tuple[str, ...], ...] = ("--num-devices",)) -> None:
     """Create prod(<flag values>) virtual CPU devices (no-op off-CPU or
-    when the product is 1). Call at module import, before any jax use."""
+    when the product is 1). Call at module import, before any jax use.
+
+    Each element of ``flags`` is one factor: either a flag name or a tuple
+    of argparse aliases for the *same* option (first one present in argv
+    wins — aliases never multiply with each other).
+    """
     if os.environ.get("JAX_PLATFORMS") != "cpu":
         return
     n = 1
     for flag in flags:
-        v = _argv_value(flag)
-        if v and v.isdigit():
-            n *= int(v)
+        aliases = (flag,) if isinstance(flag, str) else flag
+        for a in aliases:
+            v = _argv_value(a)
+            if v and v.isdigit():
+                n *= int(v)
+                break
     if n > 1:
         import jax
         jax.config.update("jax_platforms", "cpu")

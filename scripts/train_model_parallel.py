@@ -21,6 +21,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from scripts._cpu_devices import force_cpu_devices
+
+force_cpu_devices((("--stages", "--world-size"),))
+
 from distributed_model_parallel_tpu.config import (
     DataConfig,
     MeshConfig,
@@ -46,6 +50,9 @@ def parse_args():
                         "owns that many non-contiguous layer chunks")
     p.add_argument("--boundaries", default=None,
                    help="comma-separated unit boundaries, e.g. 0,4,10,16,19")
+    p.add_argument("--auto-partition", action="store_true",
+                   help="choose boundaries by minimax over XLA per-unit "
+                        "FLOPs instead of equal unit counts")
     p.add_argument("--lr", default=0.4, type=float)
     p.add_argument("--momentum", default=0.9, type=float)
     p.add_argument("--wd", default=1e-4, type=float)
@@ -62,6 +69,9 @@ def main():
     args = parse_args()
     boundaries = (None if args.boundaries is None else
                   [int(x) for x in args.boundaries.split(",")])
+    if boundaries is not None and args.auto_partition:
+        print("warning: explicit --boundaries override --auto-partition",
+              file=sys.stderr)
     steps_per_epoch = max(1, 50000 // args.batch_size)
     config = TrainConfig(
         model=ModelConfig(name=args.model),
@@ -77,6 +87,7 @@ def main():
         resume=args.resume,
         num_microbatches=args.microbatches,
         stage_boundaries=boundaries,
+        auto_partition=args.auto_partition,
         pipeline_schedule=args.schedule,
         virtual_stages=args.virtual_stages,
         log_name=args.log_name or f"{args.batch_size}",

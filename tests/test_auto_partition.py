@@ -1,0 +1,111 @@
+"""Cost-balanced pipeline partitioning (parallel/auto_partition.py).
+
+Replaces the reference's hard-coded per-rank layer ranges
+(``model_parallel.py:99-157``) with a measured minimax split.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.config import ModelConfig
+from distributed_model_parallel_tpu.models import get_model
+from distributed_model_parallel_tpu.parallel.auto_partition import (
+    auto_boundaries,
+    cost_balanced_boundaries,
+    unit_costs,
+)
+from distributed_model_parallel_tpu.models.staged import balanced_boundaries
+
+
+def bottleneck(costs, bounds):
+    return max(sum(costs[lo:hi]) for lo, hi in zip(bounds, bounds[1:]))
+
+
+def brute_force_minimax(costs, s):
+    n = len(costs)
+    best = None
+    for cuts in itertools.combinations(range(1, n), s - 1):
+        b = [0, *cuts, n]
+        v = bottleneck(costs, b)
+        if best is None or v < best[0]:
+            best = (v, b)
+    return best
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("s", [2, 3, 4])
+def test_dp_matches_brute_force(seed, s):
+    rng = np.random.default_rng(seed)
+    costs = rng.uniform(0.5, 10.0, size=9).tolist()
+    bounds = cost_balanced_boundaries(costs, s)
+    assert bounds[0] == 0 and bounds[-1] == len(costs)
+    assert all(a < b for a, b in zip(bounds, bounds[1:]))
+    want, _ = brute_force_minimax(costs, s)
+    assert bottleneck(costs, bounds) == pytest.approx(want)
+
+
+def test_uniform_costs_reduce_to_equal_counts():
+    costs = [1.0] * 8
+    assert cost_balanced_boundaries(costs, 4) == balanced_boundaries(8, 4)
+    # Non-divisible counts front-load the remainder, same convention as
+    # balanced_boundaries (earliest stages get the extra unit).
+    assert cost_balanced_boundaries([1.0] * 5, 2) == balanced_boundaries(5, 2)
+    assert (cost_balanced_boundaries([1.0] * 19, 4)
+            == balanced_boundaries(19, 4))
+
+
+def test_skewed_costs_isolate_the_heavy_unit():
+    # One unit dominates: it must get its own stage.
+    costs = [1, 1, 100, 1, 1]
+    bounds = cost_balanced_boundaries(costs, 3)
+    slices = list(zip(bounds, bounds[1:]))
+    assert (2, 3) in slices
+
+
+def test_invalid_stage_counts_raise():
+    with pytest.raises(ValueError):
+        cost_balanced_boundaries([1.0, 2.0], 3)
+    with pytest.raises(ValueError):
+        cost_balanced_boundaries([1.0], 0)
+
+
+def test_unit_costs_mobilenet_track_flops():
+    """XLA-measured per-unit costs: every unit gets a positive cost, and the
+    stem (full-resolution conv) costs more than the tiny final linear."""
+    model = get_model(ModelConfig(name="mobilenetv2"))
+    costs = unit_costs(model, (4, 32, 32, 3))
+    assert len(costs) == model.num_units == 19
+    assert all(c > 0 for c in costs)
+    # The real cost profile is far from uniform (the 1x1->1280 head conv
+    # dominates the 3->32 stem by ~7x) — exactly why equal-unit-count
+    # splits misbalance and a measured minimax split pays off.
+    assert max(costs) > 2 * min(costs)
+
+
+def test_auto_boundaries_beat_equal_counts_on_mobilenet():
+    """The minimax split's bottleneck stage is never worse than the
+    equal-unit-count split's under the measured costs."""
+    model = get_model(ModelConfig(name="mobilenetv2"))
+    costs = unit_costs(model, (4, 32, 32, 3))
+    for s in (2, 4):
+        auto = cost_balanced_boundaries(costs, s)
+        naive = balanced_boundaries(model.num_units, s)
+        assert bottleneck(costs, auto) <= bottleneck(costs, naive)
+
+
+def test_pipeline_trainer_accepts_auto_partition(tmp_path):
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+    from tests.conftest import tiny_train_config
+
+    cfg = tiny_train_config(
+        tmp_path, epochs=1, auto_partition=True, num_microbatches=2)
+    cfg = cfg.replace(mesh=cfg.mesh.__class__(data=1, stage=4))
+    t = PipelineTrainer(cfg)
+    bounds = [lo for lo, _ in t.runner.slices] + [t.runner.slices[-1][1]]
+    assert bounds[0] == 0 and bounds[-1] == t.runner.model.num_units
+    history = t.fit()
+    assert np.isfinite(history[-1]["loss_train"])
