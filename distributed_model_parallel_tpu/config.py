@@ -29,6 +29,14 @@ class MeshConfig:
     seq: int = 1           # sequence/context-parallel axis ("sp")
     expert: int = 1        # expert-parallel axis ("ep"), reserved
 
+    # Multi-host layout: how many of the `data` ways cross the DCN (slow,
+    # host-to-host) boundary. Must divide `data`. With dcn_data > 1 the data
+    # axis is laid out host-major — the dcn_data host granules are the outer
+    # factor — so XLA decomposes the gradient allreduce hierarchically
+    # (ICI-local reduce-scatter, small DCN exchange, ICI all-gather). Other
+    # axes (stage/model/seq/expert) always stay within a host's ICI domain.
+    dcn_data: int = 1
+
     # Axis names as they appear in PartitionSpecs / collectives.
     data_axis: str = "data"
     stage_axis: str = "stage"

@@ -24,6 +24,10 @@ from distributed_model_parallel_tpu.config import MeshConfig
 
 logger = logging.getLogger(__name__)
 
+# Name of the cross-host (slow-network) sub-axis of data parallelism; it
+# exists in the mesh only when MeshConfig.dcn_data > 1.
+DCN_AXIS = "dcn"
+
 
 def best_effort_distributed_init() -> bool:
     """Initialize the multi-host JAX runtime if the environment asks for it.
@@ -62,7 +66,25 @@ class MeshSpec:
 
     # -- canonical axis names ------------------------------------------------
     @property
-    def data_axis(self) -> str:
+    def data_axis(self) -> str | tuple[str, str]:
+        """Axis (or axes) replicas span. With ``dcn_data > 1`` the mesh has a
+        real leading ``"dcn"`` axis and this returns ``("dcn", data_axis)`` —
+        PartitionSpecs and collectives accept the tuple everywhere a single
+        name is legal, so DP/DDP/FSDP code is hierarchy-agnostic, while
+        two-level code can address ``dcn_axis``/``ici_data_axis`` separately.
+        """
+        if self.config.dcn_data > 1:
+            return (DCN_AXIS, self.config.data_axis)
+        return self.config.data_axis
+
+    @property
+    def dcn_axis(self) -> str | None:
+        """The cross-host sub-axis of data parallelism (None on one host)."""
+        return DCN_AXIS if self.config.dcn_data > 1 else None
+
+    @property
+    def ici_data_axis(self) -> str:
+        """The within-host sub-axis of data parallelism."""
         return self.config.data_axis
 
     @property
@@ -133,7 +155,29 @@ def make_mesh(config: MeshConfig | None = None,
     shape = (config.data, config.stage, config.model, config.seq, config.expert)
     names = (config.data_axis, config.stage_axis, config.model_axis,
              config.seq_axis, config.expert_axis)
-    grid = np.asarray(devices[:n]).reshape(shape)
+    if config.dcn_data > 1:
+        # The data axis factors into a real leading "dcn" (cross-host) axis
+        # and a within-host remainder, so shardings can span both
+        # (MeshSpec.data_axis) and collectives can stage hierarchically.
+        if config.data % config.dcn_data:
+            raise ValueError(
+                f"dcn_data={config.dcn_data} must divide data={config.data}")
+        if DCN_AXIS in names:
+            raise ValueError(f"axis name {DCN_AXIS!r} is reserved for dcn_data")
+        shape = (config.dcn_data, config.data // config.dcn_data) + shape[1:]
+        names = (DCN_AXIS,) + names
+    if config.dcn_data > 1 and jax.process_count() > 1:
+        # Real multi-host: let mesh_utils place the DCN granules along
+        # process boundaries and optimize the ICI layout within each.
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            shape[1:], (config.dcn_data, 1, 1, 1, 1),
+            devices=devices[:n], process_is_granule=True).reshape(shape)
+    else:
+        # Single process: contiguous device-id blocks stand in for hosts —
+        # the leading (dcn, data) reshape is host-major by construction.
+        grid = np.asarray(devices[:n]).reshape(shape)
     return MeshSpec(mesh=Mesh(grid, names), config=config)
 
 

@@ -33,7 +33,11 @@ from jax.sharding import PartitionSpec as P
 from distributed_model_parallel_tpu.data.loader import augment_batch, normalize
 from distributed_model_parallel_tpu.mesh import MeshSpec
 from distributed_model_parallel_tpu.models.staged import StagedModel
-from distributed_model_parallel_tpu.ops.collectives import bucketed_psum, psum_mean
+from distributed_model_parallel_tpu.ops.collectives import (
+    bucketed_psum,
+    hierarchical_psum_tree,
+    psum_mean,
+)
 from distributed_model_parallel_tpu.ops.ring_reduce import ring_psum_tree
 from distributed_model_parallel_tpu.train.metrics import topk_correct
 from distributed_model_parallel_tpu.train.trainer import TrainState, cross_entropy
@@ -54,15 +58,25 @@ def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
     ``state.model_state`` must carry a leading per-replica axis
     (``replicate_model_state``). ``allreduce`` picks the gradient transport:
     "psum" (per-leaf, XLA chooses the algorithm), "bucketed" (flat coalesced
-    buckets of ``bucket_bytes``), or "ring" (explicit bandwidth-optimal
-    neighbor-ppermute ring, ``ops/ring_reduce.py``). ``bucket_bytes`` set
-    with allreduce="psum" implies "bucketed" for backward compatibility.
+    buckets of ``bucket_bytes``), "ring" (explicit bandwidth-optimal
+    neighbor-ppermute ring, ``ops/ring_reduce.py``), or "hierarchical"
+    (two-level ICI/DCN staging for multi-host meshes, requires
+    ``MeshConfig.dcn_data > 1``). ``bucket_bytes`` set with allreduce="psum"
+    implies "bucketed" for backward compatibility.
     """
     axis = spec.data_axis
     if allreduce == "psum" and bucket_bytes is not None:
         allreduce = "bucketed"
-    if allreduce not in ("psum", "bucketed", "ring"):
+    if allreduce not in ("psum", "bucketed", "ring", "hierarchical"):
         raise KeyError(f"unknown allreduce {allreduce!r}")
+    if allreduce == "hierarchical" and spec.dcn_axis is None:
+        raise ValueError(
+            "allreduce='hierarchical' needs a two-level data axis; set "
+            "MeshConfig.dcn_data > 1 (--dcn-data)")
+    if allreduce == "ring" and spec.dcn_axis is not None:
+        raise ValueError(
+            "allreduce='ring' permutes over a flat data axis; with "
+            "dcn_data > 1 use 'hierarchical' (or 'psum'/'bucketed')")
 
     def loss_fn(params, model_state, images, labels):
         logits, new_state = model.apply(params, model_state, images, train=True)
@@ -79,7 +93,12 @@ def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
             loss_fn, has_aux=True)(state.params, local_state, images, labels)
 
         # The Reducer equivalent: average gradients across replicas.
-        if allreduce == "ring":
+        if allreduce == "hierarchical":
+            # Multi-host staging: ICI reduce-scatter, small DCN exchange,
+            # ICI all-gather (NCCL's hierarchical-ring analog).
+            grads = hierarchical_psum_tree(
+                grads, spec.ici_data_axis, spec.dcn_axis, mean=True)
+        elif allreduce == "ring":
             grads = ring_psum_tree(
                 grads, axis, **({} if bucket_bytes is None
                                 else {"bucket_bytes": bucket_bytes}))

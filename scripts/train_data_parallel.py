@@ -76,8 +76,9 @@ def parse_args():
     p.add_argument("--bucket-mb", type=int, default=0,
                    help="DDP gradient bucket size in MiB (0 = per-leaf psum)")
     p.add_argument("--allreduce", default="psum",
-                   choices=["psum", "bucketed", "ring"],
-                   help="DDP gradient allreduce implementation")
+                   choices=["psum", "bucketed", "ring", "hierarchical"],
+                   help="DDP gradient allreduce implementation "
+                        "(hierarchical needs --dcn-data > 1)")
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--prefetch", default=2, type=int,
                    help="host prefetch depth (0 disables)")
@@ -86,6 +87,10 @@ def parse_args():
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     p.add_argument("--num-devices", default=0, type=int,
                    help="data-parallel width (0 = all visible devices)")
+    p.add_argument("--dcn-data", default=1, type=int,
+                   help="how many data-parallel ways cross the host (DCN) "
+                        "boundary; must divide the data width. Lays the mesh "
+                        "host-major so XLA reduces gradients hierarchically")
     p.add_argument("--log-name", default=None)
     return p.parse_args()
 
@@ -118,7 +123,7 @@ def main():
             weight_decay=args.wd,
             warmup_steps=args.warmup_epochs * steps_per_epoch,
             accum_steps=args.accum_steps),
-        mesh=MeshConfig(data=n),
+        mesh=MeshConfig(data=n, dcn_data=args.dcn_data),
         epochs=args.epochs,
         resume=args.resume,
         async_checkpoint=args.async_checkpoint,

@@ -1,0 +1,154 @@
+"""Hierarchical (ICI/DCN) allreduce + hybrid mesh layout.
+
+Multi-host gradient reduction staged as ICI reduce-scatter → DCN psum → ICI
+all-gather (ops/collectives.py), and DCN-aware mesh construction
+(mesh.py make_mesh with MeshConfig.dcn_data). The reference is single-node
+only (NCCL over one host, SURVEY.md §2.4); this is the part that scales the
+DDP capability to pods.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_model_parallel_tpu.config import MeshConfig
+from distributed_model_parallel_tpu.mesh import make_mesh
+from distributed_model_parallel_tpu.ops.collectives import (
+    hierarchical_psum,
+    hierarchical_psum_tree,
+    psum_mean,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh_ici_dcn(devices):
+    """4-way ICI x 2-way DCN stand-in mesh."""
+    grid = np.asarray(devices[:8]).reshape(2, 4)
+    return Mesh(grid, ("dcn", "ici"))
+
+
+def test_hierarchical_psum_equals_flat_psum(mesh_ici_dcn):
+    x = jax.random.normal(jax.random.key(0), (8, 16, 4))
+
+    def flat(xs):
+        return jax.lax.psum(xs, ("ici", "dcn"))
+
+    def hier(xs):
+        return hierarchical_psum(xs, "ici", "dcn")
+
+    specs = dict(mesh=mesh_ici_dcn, in_specs=P("dcn", "ici"),
+                 out_specs=P("dcn", "ici"), check_vma=False)
+    want = jax.jit(jax.shard_map(flat, **specs))(x)
+    got = jax.jit(jax.shard_map(hier, **specs))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hierarchical_psum_mean(mesh_ici_dcn):
+    x = jnp.ones((8, 8))
+
+    def hier(xs):
+        return hierarchical_psum(xs, "ici", "dcn", mean=True)
+
+    got = jax.jit(jax.shard_map(
+        hier, mesh=mesh_ici_dcn, in_specs=P("dcn", "ici"),
+        out_specs=P("dcn", "ici"), check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(got), np.ones((8, 8)), rtol=1e-6)
+
+
+def test_hierarchical_psum_tree_matches_psum_mean(mesh_ici_dcn):
+    """Ragged pytree (odd leaf sizes exercise the padding path): two-level
+    reduction == single-level psum_mean over both axes."""
+    key = jax.random.key(1)
+    tree = {"w": jax.random.normal(key, (8, 3, 5)),
+            "b": jax.random.normal(key, (8, 7)),
+            "s": jax.random.normal(key, (8,))}
+
+    def flat(t):
+        return psum_mean(t, ("ici", "dcn"))
+
+    def hier(t):
+        return hierarchical_psum_tree(t, "ici", "dcn", mean=True)
+
+    specs = dict(mesh=mesh_ici_dcn,
+                 in_specs=P(("dcn", "ici")), out_specs=P(("dcn", "ici")),
+                 check_vma=False)
+    want = jax.jit(jax.shard_map(flat, **specs))(tree)
+    got = jax.jit(jax.shard_map(hier, **specs))(tree)
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_mesh_layout_and_validation(devices):
+    spec = make_mesh(MeshConfig(data=8, dcn_data=2))
+    # A real leading "dcn" axis of size 2, with the data axis shrunk to the
+    # within-host remainder.
+    assert spec.mesh.devices.shape == (2, 4, 1, 1, 1, 1)
+    assert spec.mesh.axis_names[0] == "dcn"
+    assert spec.data_axis == ("dcn", "data")
+    assert spec.dcn_axis == "dcn" and spec.ici_data_axis == "data"
+    assert spec.num_data == 8
+    # Host-major: the first dcn granule is device ids 0..3.
+    ids = [d.id for d in spec.mesh.devices[0].ravel()]
+    assert sorted(ids) == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=8, dcn_data=3))
+    # Single-level meshes are unchanged.
+    flat = make_mesh(MeshConfig(data=8))
+    assert flat.mesh.devices.shape == (8, 1, 1, 1, 1)
+    assert flat.data_axis == "data" and flat.dcn_axis is None
+
+
+def test_hybrid_mesh_trains(tmp_path):
+    """A dcn_data=2 mesh runs the standard DP trainer unchanged and
+    reproduces the flat-mesh losses — the hierarchy is placement + staging,
+    not math."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from tests.conftest import tiny_train_config
+
+    flat = Trainer(tiny_train_config(
+        tmp_path, epochs=1, mesh=MeshConfig(data=8),
+        log_dir=str(tmp_path / "l1"), checkpoint_dir=str(tmp_path / "c1")))
+    hier = Trainer(tiny_train_config(
+        tmp_path, epochs=1, mesh=MeshConfig(data=8, dcn_data=2),
+        log_dir=str(tmp_path / "l2"), checkpoint_dir=str(tmp_path / "c2")))
+    r_flat, r_hier = flat.fit(), hier.fit()
+    assert r_hier[-1]["loss_train"] == pytest.approx(
+        r_flat[-1]["loss_train"], rel=2e-4)
+
+
+def test_ddp_hierarchical_allreduce_matches_psum(tmp_path):
+    """Explicit DDP with the two-level ICI/DCN gradient transport produces
+    the flat psum transport's losses."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from tests.conftest import tiny_train_config
+
+    base = dict(epochs=1, strategy="ddp",
+                mesh=MeshConfig(data=8, dcn_data=2))
+    ref = Trainer(tiny_train_config(
+        tmp_path, **base, ddp_allreduce="psum",
+        log_dir=str(tmp_path / "l1"), checkpoint_dir=str(tmp_path / "c1")))
+    hier = Trainer(tiny_train_config(
+        tmp_path, **base, ddp_allreduce="hierarchical",
+        log_dir=str(tmp_path / "l2"), checkpoint_dir=str(tmp_path / "c2")))
+    r_ref, r_hier = ref.fit(), hier.fit()
+    assert r_hier[-1]["loss_train"] == pytest.approx(
+        r_ref[-1]["loss_train"], rel=2e-4)
+
+
+def test_ddp_transport_mesh_mismatches_raise(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from tests.conftest import tiny_train_config
+
+    with pytest.raises(ValueError, match="hierarchical"):
+        Trainer(tiny_train_config(tmp_path, strategy="ddp",
+                                  ddp_allreduce="hierarchical",
+                                  mesh=MeshConfig(data=8)))
+    with pytest.raises(ValueError, match="ring"):
+        Trainer(tiny_train_config(tmp_path, strategy="ddp",
+                                  ddp_allreduce="ring",
+                                  mesh=MeshConfig(data=8, dcn_data=2)))
