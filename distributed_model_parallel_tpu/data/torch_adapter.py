@@ -1,0 +1,115 @@
+"""Torch-dataset compatibility adapter.
+
+The reference's data layer is torch/torchvision — ``ImageFolder``,
+``torchvision.datasets.CIFAR10``, a custom pandas-joined ``CUBDataset``
+(reference ``dataset/dataset_collection.py:28-69``) — so a user migrating from
+it typically owns working ``torch.utils.data.Dataset`` objects. This module
+lets those plug straight into the TPU framework: any map-style torch dataset
+yielding ``(image, label)`` becomes an ``ArrayDataset`` (NHWC uint8 + int32
+labels) usable by ``BatchLoader``, the device-resident fast path, and every
+parallelism strategy.
+
+Conversion happens once, up front (TPU training wants the host data path to
+be trivial — the per-step work is index-gather, ``data/loader.py``), using
+torch's own DataLoader workers for parallel decode. torch is imported lazily
+so the framework has no hard torch dependency.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_model_parallel_tpu.data.registry import (
+    ArrayDataset,
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+)
+
+
+def _to_uint8_hwc(img) -> np.ndarray:
+    """One sample -> (H, W, C) uint8, accepting the shapes torch datasets
+    commonly yield: PIL images, HWC/CHW arrays or tensors, float [0,1]
+    (the ToTensor convention) or uint8 [0,255], greyscale HW.
+
+    Floats outside [0,1] are rejected rather than guessed at: a pipeline
+    ending in ``transforms.Normalize`` would otherwise be clipped into
+    garbage silently. Drop the Normalize — this framework normalizes
+    on-device from the ``mean``/``std`` on the ArrayDataset.
+    """
+    arr = np.asarray(img)
+    if arr.dtype == object:
+        raise TypeError(f"cannot convert sample of type {type(img)!r}")
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    if arr.ndim != 3:
+        raise ValueError(f"expected HW/HWC/CHW image, got shape {arr.shape}")
+    # CHW (torchvision ToTensor) -> HWC. Channels-first is identified by a
+    # leading dim of 1/3/4 with a trailing dim that is not channel-like.
+    if arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4):
+        arr = np.moveaxis(arr, 0, -1)
+    if arr.dtype != np.uint8:
+        arr = arr.astype(np.float64)
+        if arr.min() < -1e-6 or arr.max() > 1.0 + 1e-6:
+            raise ValueError(
+                f"float image values span [{arr.min():.3g}, {arr.max():.3g}]; "
+                f"expected the ToTensor [0,1] convention. If the torch "
+                f"pipeline ends in transforms.Normalize, remove it — "
+                f"normalization happens on-device from ArrayDataset.mean/std")
+        arr = np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
+    if arr.shape[-1] == 1:
+        arr = np.repeat(arr, 3, axis=-1)
+    if arr.shape[-1] != 3:
+        raise ValueError(
+            f"expected 1 or 3 channels, got {arr.shape[-1]} (shape "
+            f"{arr.shape}); for RGBA sources add .convert('RGB') to the "
+            f"dataset's loader/transform")
+    return arr
+
+
+def from_torch_dataset(dataset, *, num_classes: int | None = None,
+                       mean=CIFAR10_MEAN, std=CIFAR10_STD,
+                       num_workers: int = 0) -> ArrayDataset:
+    """Materialize a map-style ``torch.utils.data.Dataset`` of
+    ``(image, label)`` pairs into an ``ArrayDataset``.
+
+    ``num_workers > 0`` decodes in parallel via ``torch.utils.data.DataLoader``
+    (useful for ImageFolder-style on-the-fly JPEG decode); 0 iterates inline.
+    ``num_classes`` defaults to ``max(label) + 1``.
+    """
+    import torch
+    from torch.utils.data import DataLoader
+
+    n = len(dataset)
+    if n == 0:
+        raise ValueError("torch dataset is empty")
+    if num_workers > 0:
+        loader = DataLoader(dataset, batch_size=None, num_workers=num_workers)
+    else:
+        # Index explicitly: bare iteration over a map-style Dataset only
+        # stops on IndexError, which datasets backed by dict/list lookups
+        # may never raise.
+        loader = (dataset[i] for i in range(n))
+    # The first sample fixes the shape; rows are written into one
+    # preallocated (N, H, W, C) buffer so peak host memory is the dataset
+    # itself, not dataset + per-sample list (matters at ImageNet scale).
+    images = None
+    labels = np.empty(n, np.int32)
+    for i, (img, label) in enumerate(loader):
+        row = _to_uint8_hwc(img)
+        if images is None:
+            images = np.empty((n,) + row.shape, np.uint8)
+        elif row.shape != images.shape[1:]:
+            raise ValueError(
+                f"all samples must share one shape: sample {i} is "
+                f"{row.shape}, expected {images.shape[1:]}; add a "
+                f"Resize/CenterCrop transform to the torch dataset")
+        images[i] = row
+        labels[i] = int(label.item() if isinstance(label, torch.Tensor)
+                        else label)
+    return ArrayDataset(
+        images=images,
+        labels=labels,
+        num_classes=(num_classes if num_classes is not None
+                     else int(labels.max()) + 1),
+        mean=np.asarray(mean, np.float32),
+        std=np.asarray(std, np.float32))

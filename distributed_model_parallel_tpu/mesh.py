@@ -155,6 +155,8 @@ def make_mesh(config: MeshConfig | None = None,
     shape = (config.data, config.stage, config.model, config.seq, config.expert)
     names = (config.data_axis, config.stage_axis, config.model_axis,
              config.seq_axis, config.expert_axis)
+    if config.dcn_data < 1:
+        raise ValueError(f"dcn_data must be >= 1, got {config.dcn_data}")
     if config.dcn_data > 1:
         # The data axis factors into a real leading "dcn" (cross-host) axis
         # and a within-host remainder, so shardings can span both
@@ -166,18 +168,19 @@ def make_mesh(config: MeshConfig | None = None,
             raise ValueError(f"axis name {DCN_AXIS!r} is reserved for dcn_data")
         shape = (config.dcn_data, config.data // config.dcn_data) + shape[1:]
         names = (DCN_AXIS,) + names
-    if config.dcn_data > 1 and jax.process_count() > 1:
-        # Real multi-host: let mesh_utils place the DCN granules along
-        # process boundaries and optimize the ICI layout within each.
-        from jax.experimental import mesh_utils
+        if jax.process_count() > 1:
+            # Real multi-host: let mesh_utils place the DCN granules along
+            # process boundaries and optimize the ICI layout within each.
+            from jax.experimental import mesh_utils
 
-        grid = mesh_utils.create_hybrid_device_mesh(
-            shape[1:], (config.dcn_data, 1, 1, 1, 1),
-            devices=devices[:n], process_is_granule=True).reshape(shape)
-    else:
-        # Single process: contiguous device-id blocks stand in for hosts —
-        # the leading (dcn, data) reshape is host-major by construction.
-        grid = np.asarray(devices[:n]).reshape(shape)
+            grid = mesh_utils.create_hybrid_device_mesh(
+                shape[1:], (config.dcn_data, 1, 1, 1, 1),
+                devices=devices[:n], process_is_granule=True).reshape(shape)
+            return MeshSpec(mesh=Mesh(grid, names), config=config)
+    # Single process (or flat mesh): contiguous device-id blocks stand in
+    # for hosts — the leading (dcn, data) reshape is host-major by
+    # construction.
+    grid = np.asarray(devices[:n]).reshape(shape)
     return MeshSpec(mesh=Mesh(grid, names), config=config)
 
 

@@ -28,6 +28,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def flatten_padded(tree: Any, n_shards: int) -> jax.Array:
+    """Concatenate all leaves (as f32) into one flat vector padded to a
+    multiple of ``n_shards`` — the canonical pre-shape for contiguous
+    scatter/gather collectives. Shared by the ZeRO optimizer sharding
+    (parallel/zero.py) and the hierarchical allreduce below."""
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).reshape(-1) for l in jax.tree.leaves(tree)])
+    pad = (-flat.size) % n_shards
+    return jnp.pad(flat, (0, pad))
+
+
+def unflatten_like(flat: jax.Array, tree: Any) -> Any:
+    """Inverse of ``flatten_padded`` (drops padding, restores dtypes)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out, off = [], 0
+    for l in leaves:
+        out.append(flat[off:off + l.size].reshape(l.shape).astype(l.dtype))
+        off += l.size
+    return jax.tree.unflatten(treedef, out)
+
+
 def psum_mean(tree: Any, axis_name: str) -> Any:
     """Gradient averaging over the data axis — DDP's allreduce-mean."""
     n = jax.lax.psum(1, axis_name)
@@ -145,17 +166,9 @@ def hierarchical_psum_tree(tree: Any, inner_axis: str, outer_axis: str, *,
     two-level reduce, split back. Like ``hierarchical_psum`` (and
     ``lax.psum``) this sums by default; pass ``mean=True`` for DDP-style
     gradient averaging."""
-    leaves, treedef = jax.tree.flatten(tree)
-    n_inner = jax.lax.psum(1, inner_axis)
-    flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
-    pad = (-flat.size) % n_inner
-    flat = jnp.pad(flat, (0, pad))
+    flat = flatten_padded(tree, jax.lax.axis_size(inner_axis))
     red = hierarchical_psum(flat, inner_axis, outer_axis, mean=mean)
-    out, offset = [], 0
-    for l in leaves:
-        out.append(red[offset:offset + l.size].reshape(l.shape).astype(l.dtype))
-        offset += l.size
-    return jax.tree.unflatten(treedef, out)
+    return unflatten_like(red, tree)
 
 
 def unused_param_mask(grads: Any) -> Any:

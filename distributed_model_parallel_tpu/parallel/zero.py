@@ -22,33 +22,18 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
-import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
 from distributed_model_parallel_tpu.mesh import MeshSpec
 
 
-def _flat_size(tree: Any) -> list[tuple[Any, int]]:
-    return [(l, l.size) for l in jax.tree.leaves(tree)]
-
-
-def flatten_padded(tree: Any, n_shards: int) -> jax.Array:
-    """Concatenate all leaves (f32) into one flat vector padded to n_shards."""
-    flat = jnp.concatenate(
-        [l.astype(jnp.float32).reshape(-1) for l in jax.tree.leaves(tree)])
-    pad = (-flat.size) % n_shards
-    return jnp.pad(flat, (0, pad))
-
-
-def unflatten_like(flat: jax.Array, tree: Any) -> Any:
-    """Inverse of flatten_padded (drops padding)."""
-    leaves, treedef = jax.tree.flatten(tree)
-    out, off = [], 0
-    for l in leaves:
-        out.append(flat[off:off + l.size].reshape(l.shape).astype(l.dtype))
-        off += l.size
-    return jax.tree.unflatten(treedef, out)
+# Shared flatten/pad vectorization lives with the collectives; re-exported
+# here because they are part of this module's public surface.
+from distributed_model_parallel_tpu.ops.collectives import (  # noqa: E402,F401
+    flatten_padded,
+    unflatten_like,
+)
 
 
 def make_zero_train_step(loss_fn: Callable, tx: optax.GradientTransformation,

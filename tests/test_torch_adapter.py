@@ -1,0 +1,116 @@
+"""Torch-dataset adapter: reference users' torch/torchvision datasets plug
+into the TPU data layer (data/torch_adapter.py)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from torch.utils.data import Dataset, TensorDataset
+
+from distributed_model_parallel_tpu.data.loader import BatchLoader
+from distributed_model_parallel_tpu.data.torch_adapter import (
+    _to_uint8_hwc,
+    from_torch_dataset,
+)
+
+
+class _PilLike(Dataset):
+    """HWC uint8 numpy samples (what torchvision gives without ToTensor)."""
+
+    def __init__(self, n=12):
+        rng = np.random.default_rng(0)
+        self.x = rng.integers(0, 256, (n, 8, 8, 3), dtype=np.uint8)
+        self.y = rng.integers(0, 4, n)
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], int(self.y[i])
+
+
+def test_hwc_uint8_roundtrip():
+    ds = _PilLike()
+    out = from_torch_dataset(ds)
+    np.testing.assert_array_equal(out.images, ds.x)
+    np.testing.assert_array_equal(out.labels, ds.y.astype(np.int32))
+    assert out.num_classes == int(ds.y.max()) + 1
+
+
+def test_chw_float_tensor_dataset():
+    """ToTensor-style CHW float [0,1] tensors convert back to HWC uint8."""
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 256, (6, 3, 5, 5), dtype=np.uint8)
+    x = torch.tensor(raw, dtype=torch.float32) / 255.0
+    y = torch.tensor([0, 1, 2, 0, 1, 2])
+    out = from_torch_dataset(TensorDataset(x, y), num_classes=3)
+    assert out.images.shape == (6, 5, 5, 3)
+    np.testing.assert_array_equal(out.images, np.moveaxis(raw, 1, -1))
+    assert out.num_classes == 3
+
+
+def test_greyscale_expands_to_three_channels():
+    x = torch.zeros((4, 1, 6, 6))
+    y = torch.zeros(4, dtype=torch.long)
+    out = from_torch_dataset(TensorDataset(x, y))
+    assert out.images.shape == (4, 6, 6, 3)
+
+
+def test_mixed_shapes_rejected():
+    class Ragged(Dataset):
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            return np.zeros((8 + i, 8, 3), np.uint8), 0
+
+    with pytest.raises(ValueError, match="share one shape"):
+        from_torch_dataset(Ragged())
+
+
+def test_worker_loader_path_matches_inline():
+    ds = _PilLike(8)
+    inline = from_torch_dataset(ds)
+    workers = from_torch_dataset(ds, num_workers=1)
+    np.testing.assert_array_equal(inline.images, workers.images)
+    np.testing.assert_array_equal(inline.labels, workers.labels)
+
+
+def test_adapter_feeds_batch_loader_and_trainer(tmp_path):
+    """End-to-end: a torch dataset drives the jitted DP trainer."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from tests.conftest import tiny_train_config
+
+    ds = _PilLike(64)
+    adapted = from_torch_dataset(ds)
+    loader = BatchLoader(adapted, 16, shuffle=False)
+    images, labels = next(iter(loader))
+    assert images.shape == (16, 8, 8, 3)
+
+    cfg = tiny_train_config(tmp_path, epochs=1)
+    t = Trainer(cfg, train_ds=adapted, eval_ds=adapted)
+    res = t.fit()
+    assert np.isfinite(res[-1]["loss_train"])
+
+
+def test_to_uint8_rejects_garbage():
+    with pytest.raises((TypeError, ValueError)):
+        _to_uint8_hwc(object())
+    with pytest.raises(ValueError):
+        _to_uint8_hwc(np.zeros((2, 2, 2, 2)))
+
+
+def test_normalized_floats_rejected_loudly():
+    """A pipeline ending in transforms.Normalize yields floats outside
+    [0,1]; the adapter must refuse rather than clip to garbage."""
+    x = torch.randn((4, 3, 6, 6)) * 2.0
+    y = torch.zeros(4, dtype=torch.long)
+    with pytest.raises(ValueError, match="Normalize"):
+        from_torch_dataset(TensorDataset(x, y))
+
+
+def test_rgba_rejected_loudly():
+    x = np.zeros((5, 5, 4), np.uint8)
+    with pytest.raises(ValueError, match="RGB"):
+        _to_uint8_hwc(x)
