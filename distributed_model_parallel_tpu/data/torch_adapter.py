@@ -48,14 +48,23 @@ def _to_uint8_hwc(img) -> np.ndarray:
     if arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 3, 4):
         arr = np.moveaxis(arr, 0, -1)
     if arr.dtype != np.uint8:
-        arr = arr.astype(np.float64)
-        if arr.min() < -1e-6 or arr.max() > 1.0 + 1e-6:
-            raise ValueError(
-                f"float image values span [{arr.min():.3g}, {arr.max():.3g}]; "
-                f"expected the ToTensor [0,1] convention. If the torch "
-                f"pipeline ends in transforms.Normalize, remove it — "
-                f"normalization happens on-device from ArrayDataset.mean/std")
-        arr = np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
+        if np.issubdtype(arr.dtype, np.integer):
+            # Wider integer types carrying ordinary [0,255] pixels.
+            if arr.min() < 0 or arr.max() > 255:
+                raise ValueError(
+                    f"integer image values span [{arr.min()}, {arr.max()}]; "
+                    f"expected [0, 255]")
+            arr = arr.astype(np.uint8)
+        else:
+            arr = arr.astype(np.float64)
+            if arr.min() < -1e-6 or arr.max() > 1.0 + 1e-6:
+                raise ValueError(
+                    f"float image values span [{arr.min():.3g}, "
+                    f"{arr.max():.3g}]; expected the ToTensor [0,1] "
+                    f"convention. If the torch pipeline ends in "
+                    f"transforms.Normalize, remove it — normalization "
+                    f"happens on-device from ArrayDataset.mean/std")
+            arr = np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
     if arr.shape[-1] == 1:
         arr = np.repeat(arr, 3, axis=-1)
     if arr.shape[-1] != 3:

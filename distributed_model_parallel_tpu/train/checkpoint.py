@@ -98,3 +98,18 @@ class Checkpointer:
     def exists(self, name: str = "ckpt") -> bool:
         self.wait_until_finished()
         return self._latest_path(name) is not None
+
+    def newest_name(self, names: tuple[str, ...]) -> str | None:
+        """The name whose latest committed version is most recent on disk
+        (by mtime) — used to resume from the newer of the best-accuracy and
+        preemption checkpoint slots. None if none exist."""
+        self.wait_until_finished()
+        best: tuple[float, str] | None = None
+        for name in names:
+            path = self._latest_path(name)
+            if path is None:
+                continue
+            mtime = os.path.getmtime(path)
+            if best is None or mtime > best[0]:
+                best = (mtime, name)
+        return best[1] if best else None

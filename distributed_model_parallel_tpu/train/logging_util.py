@@ -38,6 +38,15 @@ class RunLogger:
         if self.echo:
             print(line, flush=True)
 
+    def log_line(self, message: str) -> None:
+        """Free-form event line (preemption, guard trips) to both sinks."""
+        with open(self.txt_path, "a") as f:
+            f.write(message + "\n")
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps({"ts": time.time(), "event": message}) + "\n")
+        if self.echo:
+            print(message, flush=True)
+
     def log_step(self, epoch: int, step: int, **metrics: Any) -> None:
         if self.echo:
             parts = [f"[{epoch}:{step}]"] + [

@@ -1,0 +1,77 @@
+"""Preemption-safe training: signal-triggered checkpoint + clean stop.
+
+The reference has no failure story at all — a dead rank hangs the ring and a
+killed job loses everything since the last best-accuracy save (SURVEY.md §5
+"Failure detection"). TPU pods make this a first-class concern: maintenance
+events and spot reclaims deliver SIGTERM with a grace window. This module
+turns that signal into a cooperative stop flag; the epoch drivers poll it at
+step boundaries, checkpoint immediately, and exit cleanly so ``--resume``
+continues from the preempted epoch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionGuard:
+    """Converts SIGTERM/SIGINT into a thread-safe "stop requested" flag.
+
+    Handlers chain to the previously-installed handler for SIGINT *only on
+    the second delivery* — first Ctrl-C requests a graceful checkpointed
+    stop, a second one falls through to the default KeyboardInterrupt.
+    Installation is a no-op off the main thread (CPython restriction);
+    ``request()`` still works for cooperative/manual triggering.
+    """
+
+    def __init__(self, signals=DEFAULT_SIGNALS):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev: dict[int, object] = {}
+
+    # -- flag ---------------------------------------------------------------
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self) -> None:
+        """Manually request a graceful stop (tests, cluster-API callbacks)."""
+        self._event.set()
+
+    def reset(self) -> None:
+        self._event.clear()
+
+    # -- signal plumbing ----------------------------------------------------
+    def _handler(self, signum, frame):
+        if self._event.is_set() and signum == signal.SIGINT:
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+                return
+            raise KeyboardInterrupt
+        logger.warning("signal %s: requesting graceful checkpointed stop "
+                       "(repeat SIGINT to abort hard)", signum)
+        self._event.set()
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Install handlers for the scope of a fit() call, restoring the
+        previous handlers on exit."""
+        installed = []
+        for s in self._signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+                installed.append(s)
+            except ValueError:      # not the main thread
+                logger.debug("cannot install handler for %s off main thread", s)
+        try:
+            yield self
+        finally:
+            for s in installed:
+                signal.signal(s, self._prev.pop(s))

@@ -262,12 +262,17 @@ class Trainer:
             raise KeyError(f"unknown strategy {config.strategy!r}")
 
         self._max_inflight = max(1, config.max_inflight_steps)
+        from distributed_model_parallel_tpu.train.preemption import (
+            PreemptionGuard,
+        )
+
+        self.preemption = PreemptionGuard()
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.best_acc = 0.0
         self.start_epoch = 0
         self._rng = jax.random.key(config.seed + 1)
-        if config.resume and self.ckpt.exists():
+        if config.resume and (self.ckpt.exists() or self.ckpt.exists("preempt")):
             self._resume()
 
     # -- checkpointing (reference data_parallel.py:80-87,143-155) ------------
@@ -277,7 +282,11 @@ class Trainer:
                 "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
 
     def _resume(self):
-        restored = self.ckpt.restore(self._ckpt_tree())
+        # Prefer whichever slot is newer: the best-accuracy checkpoint or a
+        # preemption save (which lives under its own name so it never
+        # evicts the best-model weights).
+        name = self.ckpt.newest_name(("ckpt", "preempt")) or "ckpt"
+        restored = self.ckpt.restore(self._ckpt_tree(), name)
         self.state = jax.device_put(restored["state"], self._state_sh)
         self.best_acc = float(restored["best_acc"])
         self.start_epoch = int(restored["epoch"])
@@ -324,6 +333,8 @@ class Trainer:
         timer = StepTimer()
         pending: list = []
         for i, (images, labels) in enumerate(self._prefetched(self.train_loader)):
+            if self.preemption.requested():
+                break
             images, labels = self._shard_batch(images, labels)
             timer.data_ready()
             self._rng, sub = jax.random.split(self._rng)
@@ -362,6 +373,8 @@ class Trainer:
         idx = idx[:steps * bs].reshape(steps, bs)
         inflight = 0
         for i in range(0, steps, K):
+            if self.preemption.requested():
+                break
             chunk = np.ascontiguousarray(idx[i:i + K])
             timer.data_ready()
             self._rng, sub = jax.random.split(self._rng)
@@ -410,20 +423,40 @@ class Trainer:
 
     def fit(self, epochs: int | None = None) -> list[dict]:
         """Train with per-epoch eval + best-acc checkpointing
-        (reference epoch loop data_parallel.py:160-172)."""
+        (reference epoch loop data_parallel.py:160-172).
+
+        SIGTERM/SIGINT (TPU preemption, Ctrl-C) request a graceful stop:
+        the epoch loop breaks at the next step boundary, a checkpoint is
+        written pointing resume at the interrupted epoch, and fit returns
+        the completed history (train/preemption.py).
+        """
         epochs = epochs if epochs is not None else self.config.epochs
         history = []
-        for epoch in range(self.start_epoch, epochs):
-            tr = self.train_epoch(epoch)
-            ev = self.evaluate()
-            record = dict(epoch=epoch, loss_train=tr.loss, acc1_train=tr.acc1,
-                          loss_val=ev.loss, acc1_val=ev.acc1,
-                          time_per_batch=tr.step_time,
-                          time_load_per_batch=tr.data_time)
-            self.logger.log_epoch(**record)
-            history.append(record)
-            if ev.acc1 > self.best_acc:
-                self.best_acc = ev.acc1
-                self._save(epoch)
+        with self.preemption.installed():
+            for epoch in range(self.start_epoch, epochs):
+                tr = self.train_epoch(epoch)
+                if self.preemption.requested():
+                    # Partial epoch: save for resume *at* this epoch (the
+                    # standard redo-the-epoch convention) under the
+                    # dedicated preemption slot — the best-accuracy
+                    # checkpoint is never evicted — and stop. The request
+                    # is consumed so a later fit() trains normally.
+                    self.start_epoch = epoch
+                    self.ckpt.save(self._ckpt_tree(), "preempt", wait=True)
+                    self.logger.log_line(
+                        f"preempted: checkpoint saved at epoch {epoch}")
+                    self.preemption.reset()
+                    break
+                ev = self.evaluate()
+                record = dict(epoch=epoch, loss_train=tr.loss,
+                              acc1_train=tr.acc1,
+                              loss_val=ev.loss, acc1_val=ev.acc1,
+                              time_per_batch=tr.step_time,
+                              time_load_per_batch=tr.data_time)
+                self.logger.log_epoch(**record)
+                history.append(record)
+                if ev.acc1 > self.best_acc:
+                    self.best_acc = ev.acc1
+                    self._save(epoch)
         self.ckpt.wait_until_finished()
         return history

@@ -114,3 +114,13 @@ def test_rgba_rejected_loudly():
     x = np.zeros((5, 5, 4), np.uint8)
     with pytest.raises(ValueError, match="RGB"):
         _to_uint8_hwc(x)
+
+
+def test_wide_integer_pixels_convert():
+    """int64 arrays carrying ordinary [0,255] pixels (np.asarray(pil, int),
+    long tensors) convert exactly instead of tripping the float check."""
+    raw = np.arange(5 * 5 * 3, dtype=np.int64).reshape(5, 5, 3) % 256
+    out = _to_uint8_hwc(raw)
+    np.testing.assert_array_equal(out, raw.astype(np.uint8))
+    with pytest.raises(ValueError, match="integer image values"):
+        _to_uint8_hwc(np.full((4, 4, 3), 300, np.int32))
