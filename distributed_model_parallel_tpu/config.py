@@ -79,6 +79,9 @@ class OptimizerConfig:
     # one optimizer update per k (optax.MultiSteps). A size-b batch at
     # accum_steps=k matches a size-k*b batch step exactly (mean-loss grads).
     accum_steps: int = 1
+    # Exponential moving average of the weights (e.g. 0.999); evaluation and
+    # best-acc selection use the averaged weights. None disables.
+    ema_decay: float | None = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -49,6 +49,11 @@ class TrainState(struct.PyTreeNode):
     params: Any
     model_state: Any          # BN running stats (tuple over units)
     opt_state: Any
+    # Exponential moving average of params (None unless
+    # OptimizerConfig.ema_decay is set); evaluation/checkpoint-selection
+    # read these when present — the standard large-batch trick the
+    # reference lacks.
+    ema_params: Any = None
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -57,11 +62,14 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
                     *, mean, std, augment: bool = True,
-                    dtype=jnp.float32) -> Callable:
+                    dtype=jnp.float32, ema_decay: float | None = None
+                    ) -> Callable:
     """Returns step(state, rng, images_u8, labels) -> (state, metrics).
 
     Augmentation + normalization run on-device so XLA fuses them with the
     forward pass; metrics are computed on-device as sums (psum-friendly).
+    With ``ema_decay``, ``state.ema_params`` tracks
+    ``d*ema + (1-d)*params`` after each update.
     """
 
     def loss_fn(params, model_state, images, labels):
@@ -76,18 +84,32 @@ def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
             loss_fn, has_aux=True)(state.params, state.model_state, images, labels)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if ema_decay is not None:
+            step_size = 1.0 - ema_decay
+            if hasattr(new_opt_state, "mini_step"):
+                # Gradient accumulation (optax.MultiSteps): only count real
+                # optimizer updates — mini_step resets to 0 exactly when one
+                # fires — so the EMA horizon matches the equivalent
+                # big-batch run instead of shrinking by accum_steps.
+                step_size = jnp.where(new_opt_state.mini_step == 0,
+                                      step_size, 0.0)
+            new_ema = optax.incremental_update(new_params, state.ema_params,
+                                               step_size)
         metrics = {"loss": loss, "batch": jnp.asarray(labels.shape[0], jnp.float32),
                    **topk_correct(logits, labels)}
         return (TrainState(step=state.step + 1, params=new_params,
                            model_state=new_model_state,
-                           opt_state=new_opt_state), metrics)
+                           opt_state=new_opt_state,
+                           ema_params=new_ema), metrics)
 
     return step
 
 
 def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
                     *, image_shape, mean, std, augment: bool = True,
-                    dtype=jnp.float32) -> Callable:
+                    dtype=jnp.float32, ema_decay: float | None = None
+                    ) -> Callable:
     """K train steps per dispatched program (lax.scan) over a
     device-resident dataset.
 
@@ -99,7 +121,7 @@ def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
     ``make_train_step``'s.
     """
     step = make_train_step(model, tx, mean=mean, std=std, augment=augment,
-                           dtype=dtype)
+                           dtype=dtype, ema_decay=ema_decay)
     h, w, c = image_shape
 
     def multi(state: TrainState, rng: jax.Array, images_flat, labels_all, idx):
@@ -117,10 +139,12 @@ def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
     return multi
 
 
-def make_eval_step(model: StagedModel, *, mean, std, dtype=jnp.float32) -> Callable:
+def make_eval_step(model: StagedModel, *, mean, std, dtype=jnp.float32,
+                   use_ema: bool = False) -> Callable:
     def step(state: TrainState, images_u8, labels):
         images = normalize(images_u8, mean, std, dtype)
-        logits, _ = model.apply(state.params, state.model_state, images,
+        params = state.ema_params if use_ema else state.params
+        logits, _ = model.apply(params, state.model_state, images,
                                 train=False)
         return {"loss": cross_entropy(logits, labels),
                 "batch": jnp.asarray(labels.shape[0], jnp.float32),
@@ -173,12 +197,16 @@ class Trainer:
         self._batch_sh = self.spec.batch_sharded()
         kw = dict(mean=train_ds.mean, std=train_ds.std)
 
+        ema = config.optimizer.ema_decay
         if config.strategy == "ddp":
             if config.device_resident_data:
                 raise ValueError(
                     "device_resident_data is only supported with "
                     "strategy='gspmd' (the ddp path materializes per-replica "
                     "batches on host)")
+            if ema is not None:
+                raise ValueError(
+                    "ema_decay is supported on the gspmd/fsdp strategies")
             # Explicit per-replica engine: BN state carries a leading
             # per-replica axis sharded over the data axis (parallel/ddp.py).
             from distributed_model_parallel_tpu.parallel.ddp import (
@@ -221,22 +249,29 @@ class Trainer:
                 opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
                 self._state_sh = TrainState(
                     step=self._repl, params=params_sh,
-                    model_state=self._repl, opt_state=opt_sh)
+                    model_state=self._repl, opt_state=opt_sh,
+                    ema_params=params_sh if ema is not None else None)
             else:
                 self._state_sh = self._repl
                 opt_state = self.tx.init(params)
+            # EMA starts at the initial weights — as a real copy: params and
+            # ema_params live in one donated state, and donation rejects the
+            # same buffer appearing twice.
+            ema_params = (jax.tree.map(jnp.copy, params) if ema is not None
+                          else None)
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                               model_state=model_state, opt_state=opt_state)
+                               model_state=model_state, opt_state=opt_state,
+                               ema_params=ema_params)
             self.state = jax.device_put(state, self._state_sh)
             self._train_step = jax.jit(
-                make_train_step(self.model, self.tx,
+                make_train_step(self.model, self.tx, ema_decay=ema,
                                 augment=config.data.augment, **kw),
                 in_shardings=(self._state_sh, self._repl, self._batch_sh,
                               self._batch_sh),
                 out_shardings=(self._state_sh, self._repl),
                 donate_argnums=(0,))
             self._eval_step = jax.jit(
-                make_eval_step(self.model, **kw),
+                make_eval_step(self.model, use_ema=ema is not None, **kw),
                 in_shardings=(self._state_sh, self._batch_sh, self._batch_sh),
                 out_shardings=self._repl)
             if config.device_resident_data:
@@ -251,7 +286,7 @@ class Trainer:
                 idx_sh = NamedSharding(self.spec.mesh,
                                        P(None, self.spec.data_axis))
                 self._multi_step = jax.jit(
-                    make_multi_step(self.model, self.tx,
+                    make_multi_step(self.model, self.tx, ema_decay=ema,
                                     image_shape=train_ds.images.shape[1:],
                                     augment=config.data.augment, **kw),
                     in_shardings=(self._state_sh, self._repl, self._repl,
@@ -286,8 +321,26 @@ class Trainer:
         # preemption save (which lives under its own name so it never
         # evicts the best-model weights).
         name = self.ckpt.newest_name(("ckpt", "preempt")) or "ckpt"
-        restored = self.ckpt.restore(self._ckpt_tree(), name)
-        self.state = jax.device_put(restored["state"], self._state_sh)
+        tmpl = self._ckpt_tree()
+        try:
+            restored = self.ckpt.restore(tmpl, name)
+        except Exception:
+            # The checkpoint's TrainState may differ from the current config
+            # in the optional ema_params subtree (run resumed with
+            # ema_decay toggled). Retry with the opposite template, then
+            # reconcile below.
+            st = tmpl["state"]
+            alt = st.replace(ema_params=(
+                None if st.ema_params is not None else st.params))
+            restored = self.ckpt.restore({**tmpl, "state": alt}, name)
+        rs = restored["state"]
+        want_ema = self.config.optimizer.ema_decay is not None
+        if want_ema and rs.ema_params is None:
+            # EMA newly enabled: seed the average at the restored weights.
+            rs = rs.replace(ema_params=jax.tree.map(jnp.copy, rs.params))
+        elif not want_ema and rs.ema_params is not None:
+            rs = rs.replace(ema_params=None)
+        self.state = jax.device_put(rs, self._state_sh)
         self.best_acc = float(restored["best_acc"])
         self.start_epoch = int(restored["epoch"])
 

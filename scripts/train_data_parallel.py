@@ -58,6 +58,9 @@ def parse_args():
     p.add_argument("--batch-size", "-b", default=512, type=int)
     p.add_argument("--workers", "-j", default=2, type=int)
     p.add_argument("--warmup-epochs", default=10, type=int)
+    p.add_argument("--ema-decay", default=None, type=float,
+                   help="weight EMA decay (e.g. 0.999); eval and best-acc "
+                        "selection use the averaged weights")
     p.add_argument("--accum-steps", default=1, type=int,
                    help="gradient accumulation: one optimizer update per k "
                         "batches (size-b batch at k == size-k*b batch)")
@@ -122,7 +125,8 @@ def main():
             learning_rate=args.lr, momentum=args.momentum,
             weight_decay=args.wd,
             warmup_steps=args.warmup_epochs * steps_per_epoch,
-            accum_steps=args.accum_steps),
+            accum_steps=args.accum_steps,
+            ema_decay=args.ema_decay),
         mesh=MeshConfig(data=n, dcn_data=args.dcn_data),
         epochs=args.epochs,
         resume=args.resume,

@@ -1,0 +1,168 @@
+"""Weight EMA (OptimizerConfig.ema_decay): averaged weights tracked in the
+train step, used for evaluation and best-acc selection. Absent from the
+reference; the standard large-batch/vision trick."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.config import OptimizerConfig
+from distributed_model_parallel_tpu.train.trainer import Trainer
+
+from tests.conftest import tiny_train_config
+
+
+def ema_cfg(tmp_path, decay, **kw):
+    base = tiny_train_config(tmp_path, **kw)
+    return base.replace(
+        optimizer=dataclasses.replace(base.optimizer, ema_decay=decay))
+
+
+def test_ema_update_rule_exact(tmp_path):
+    """One step with decay d: ema1 == d*p0 + (1-d)*p1 exactly."""
+    d = 0.5
+    t = Trainer(ema_cfg(tmp_path, d, epochs=1))
+    p0 = jax.device_get(t.state.params)
+    images, labels = next(iter(t.train_loader))
+    images, labels = t._shard_batch(images, labels)
+    t.state, _ = t._train_step(t.state, jax.random.key(9), images, labels)
+    p1 = jax.device_get(t.state.params)
+    ema1 = jax.device_get(t.state.ema_params)
+    for a0, a1, e in zip(jax.tree.leaves(p0), jax.tree.leaves(p1),
+                         jax.tree.leaves(ema1)):
+        np.testing.assert_allclose(e, d * a0 + (1 - d) * a1,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_eval_uses_ema_weights(tmp_path):
+    """decay=1.0 freezes the EMA at init: the frozen average equals the
+    initial weights while the live weights move, and evaluation reads the
+    EMA slot (swapping it changes the metrics)."""
+    t = Trainer(ema_cfg(tmp_path, 1.0, epochs=2))
+    t.fit()
+    frozen = jax.device_get(t.state.ema_params)
+    init_like = Trainer(ema_cfg(tmp_path, 1.0, epochs=1,
+                                checkpoint_dir=str(tmp_path / "c2"),
+                                log_dir=str(tmp_path / "l2")))
+    for a, b in zip(jax.tree.leaves(frozen),
+                    jax.tree.leaves(jax.device_get(init_like.state.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    live = jax.device_get(t.state.params)
+    diffs = [float(np.abs(a - b).max())
+             for a, b in zip(jax.tree.leaves(frozen), jax.tree.leaves(live))]
+    assert max(diffs) > 0          # live weights actually moved
+    # Direct proof the eval step reads ema_params: replacing the slot with
+    # the live weights changes the evaluation result.
+    m_frozen = t.evaluate()
+    t.state = t.state.replace(
+        ema_params=jax.tree.map(jnp.copy, t.state.params))
+    m_live = t.evaluate()
+    assert m_frozen.loss != pytest.approx(m_live.loss, abs=1e-7)
+
+
+def test_ema_skips_accumulation_micro_steps(tmp_path):
+    """With accum_steps=k, the EMA advances once per optimizer update, not
+    once per micro-batch — the horizon matches the big-batch equivalent."""
+    base = ema_cfg(tmp_path, 0.5, epochs=1)
+    cfg = base.replace(optimizer=dataclasses.replace(
+        base.optimizer, accum_steps=3))
+    t = Trainer(cfg)
+    p0 = jax.device_get(t.state.params)
+    it = iter(t.train_loader)
+    for k in range(3):
+        images, labels = t._shard_batch(*next(it))
+        t.state, _ = t._train_step(t.state, jax.random.key(k), images, labels)
+        ema = jax.device_get(t.state.ema_params)
+        if k < 2:
+            # Micro-steps: params held, EMA must not decay toward anything.
+            for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(ema)):
+                np.testing.assert_array_equal(a, b)
+    # After the 3rd call one real update fired: ema == 0.5*p0 + 0.5*p1.
+    p1 = jax.device_get(t.state.params)
+    ema = jax.device_get(t.state.ema_params)
+    for a0, a1, e in zip(jax.tree.leaves(p0), jax.tree.leaves(p1),
+                         jax.tree.leaves(ema)):
+        np.testing.assert_allclose(e, 0.5 * a0 + 0.5 * a1,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_resume_across_ema_toggle(tmp_path):
+    """A checkpoint written without EMA resumes into an EMA-enabled run
+    (average seeded at the restored weights), and vice versa."""
+    plain = tiny_train_config(tmp_path, epochs=1)
+    t = Trainer(plain)
+    t.fit()
+    p = jax.device_get(t.state.params)
+
+    t_on = Trainer(ema_cfg(tmp_path, 0.9, epochs=2, resume=True))
+    assert t_on.start_epoch == 1
+    for a, b in zip(jax.tree.leaves(p),
+                    jax.tree.leaves(jax.device_get(t_on.state.ema_params))):
+        np.testing.assert_array_equal(a, b)
+
+    # Now write an EMA checkpoint and resume without EMA.
+    t_on.fit()
+    t_off = Trainer(plain.replace(resume=True, epochs=3))
+    assert t_off.state.ema_params is None
+    assert t_off.start_epoch >= 1
+
+
+def test_ema_improves_or_matches_noise(tmp_path):
+    """Sanity: a real decay trains and evaluates finitely end-to-end, and
+    the EMA tree differs from both init and live params."""
+    t = Trainer(ema_cfg(tmp_path, 0.9, epochs=2))
+    hist = t.fit()
+    assert np.isfinite(hist[-1]["loss_val"])
+    ema = jax.device_get(t.state.ema_params)
+    live = jax.device_get(t.state.params)
+    assert any(float(np.abs(a - b).max()) > 0
+               for a, b in zip(jax.tree.leaves(ema), jax.tree.leaves(live)))
+
+
+def test_ema_with_fsdp_sharded_and_resumes(tmp_path):
+    cfg = ema_cfg(tmp_path, 0.9, epochs=1, strategy="fsdp")
+    t = Trainer(cfg)
+    n = t.spec.num_data
+    sharded = [l for l in jax.tree.leaves(t.state.ema_params)
+               if l.addressable_shards[0].data.size * n == l.size]
+    assert sharded, "EMA leaves not sharded under fsdp"
+    t.fit()
+    want = jax.device_get(t.state.ema_params)
+    t2 = Trainer(cfg.replace(resume=True))
+    got = jax.device_get(t2.state.ema_params)
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_ema_device_resident_matches_per_batch(tmp_path):
+    """EMA math is identical through the multi-step scan path (augmentation
+    off so the per-path RNG stream split doesn't change the batches,
+    matching test_device_resident_multi_step_matches_regular_path)."""
+    from distributed_model_parallel_tpu.config import DataConfig
+
+    data = DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
+                      synthetic_train_size=96, synthetic_eval_size=32,
+                      augment=False)
+    cfg = ema_cfg(tmp_path, 0.8, epochs=1, data=data,
+                  checkpoint_dir=str(tmp_path / "c1"),
+                  log_dir=str(tmp_path / "l1"))
+    cfg_dev = ema_cfg(tmp_path, 0.8, epochs=1, data=data,
+                      device_resident_data=True, steps_per_dispatch=3,
+                      checkpoint_dir=str(tmp_path / "c2"),
+                      log_dir=str(tmp_path / "l2"))
+    a = Trainer(cfg)
+    b = Trainer(cfg_dev)
+    a.fit()
+    b.fit()
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.state.ema_params)),
+                    jax.tree.leaves(jax.device_get(b.state.ema_params))):
+        np.testing.assert_allclose(x, y, rtol=2e-4, atol=1e-5)
+
+
+def test_ema_rejected_on_ddp(tmp_path):
+    with pytest.raises(ValueError, match="ema"):
+        Trainer(ema_cfg(tmp_path, 0.9, strategy="ddp"))
