@@ -75,6 +75,10 @@ class LMTrainer:
         if cfg.max_seq_len < config.seq_len:
             raise ValueError("model max_seq_len < training seq_len")
         self.cfg = cfg
+        if config.optimizer.ema_decay is not None:
+            raise ValueError(
+                "ema_decay is implemented by the data-parallel Trainer "
+                "(gspmd/fsdp), not the LM trainer — no silent ignores")
         self.tx = make_optimizer(config.optimizer, config.steps_per_epoch,
                                  config.epochs)
         self._step = make_spmd_train_step(
@@ -89,6 +93,11 @@ class LMTrainer:
         self.tokens = make_token_stream(cfg.vocab_size, config.n_tokens,
                                         config.seed)
         self._rng = np.random.default_rng(config.seed + 1)
+        from distributed_model_parallel_tpu.train.preemption import (
+            PreemptionGuard,
+        )
+
+        self.preemption = PreemptionGuard()
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.start_epoch = 0
@@ -118,24 +127,36 @@ class LMTrainer:
     def fit(self, epochs: int | None = None) -> list[dict]:
         epochs = epochs if epochs is not None else self.config.epochs
         history = []
-        for epoch in range(self.start_epoch, epochs):
-            meter = AverageMeter("loss")
-            timer = StepTimer()
-            for _ in range(self.config.steps_per_epoch):
-                toks, tgts = self.sample_batch()
-                timer.data_ready()
-                self.params, self.opt_state, loss = self._step(
-                    self.params, self.opt_state, jnp.asarray(toks),
-                    jnp.asarray(tgts))
-                meter.update(float(loss))
-                timer.step_done()
-            record = dict(epoch=epoch, loss_train=meter.avg,
-                          time_per_batch=timer.step.avg,
-                          time_load_per_batch=timer.data.avg,
-                          tokens_per_s=self.config.batch_size
-                          * self.config.seq_len / max(timer.step.avg, 1e-9))
-            self.logger.log_epoch(**record)
-            history.append(record)
-            self.start_epoch = epoch + 1
-            self.ckpt.save(self._ckpt_tree(), "lm")
+        with self.preemption.installed():
+            for epoch in range(self.start_epoch, epochs):
+                meter = AverageMeter("loss")
+                timer = StepTimer()
+                for _ in range(self.config.steps_per_epoch):
+                    if self.preemption.requested():
+                        break
+                    toks, tgts = self.sample_batch()
+                    timer.data_ready()
+                    self.params, self.opt_state, loss = self._step(
+                        self.params, self.opt_state, jnp.asarray(toks),
+                        jnp.asarray(tgts))
+                    meter.update(float(loss))
+                    timer.step_done()
+                if self.preemption.requested():
+                    # Partial epoch: save for resume at this epoch and stop
+                    # cleanly (train/preemption.py).
+                    self.start_epoch = epoch
+                    self.ckpt.save(self._ckpt_tree(), "lm")
+                    self.logger.log_line(
+                        f"preempted: checkpoint saved at epoch {epoch}")
+                    self.preemption.reset()
+                    break
+                record = dict(epoch=epoch, loss_train=meter.avg,
+                              time_per_batch=timer.step.avg,
+                              time_load_per_batch=timer.data.avg,
+                              tokens_per_s=self.config.batch_size
+                              * self.config.seq_len / max(timer.step.avg, 1e-9))
+                self.logger.log_epoch(**record)
+                history.append(record)
+                self.start_epoch = epoch + 1
+                self.ckpt.save(self._ckpt_tree(), "lm")
         return history

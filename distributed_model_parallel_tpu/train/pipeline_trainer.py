@@ -57,6 +57,10 @@ class PipelineTrainer:
             num_workers=config.data.num_workers)
 
         model = get_model(config.model)
+        if config.optimizer.ema_decay is not None:
+            raise ValueError(
+                "ema_decay is implemented by the data-parallel Trainer "
+                "(gspmd/fsdp), not the pipeline trainer — no silent ignores")
         tx = make_optimizer(config.optimizer, len(self.train_loader),
                             config.epochs)
         boundaries = config.stage_boundaries

@@ -49,11 +49,13 @@ class TrainState(struct.PyTreeNode):
     params: Any
     model_state: Any          # BN running stats (tuple over units)
     opt_state: Any
-    # Exponential moving average of params (None unless
+    # Exponential moving average of params + model_state (None unless
     # OptimizerConfig.ema_decay is set); evaluation/checkpoint-selection
     # read these when present — the standard large-batch trick the
-    # reference lacks.
+    # reference lacks. BN running stats are averaged alongside the weights
+    # so evaluation never pairs averaged weights with live statistics.
     ema_params: Any = None
+    ema_model_state: Any = None
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -85,6 +87,7 @@ def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_ema = state.ema_params
+        new_ema_state = state.ema_model_state
         if ema_decay is not None:
             step_size = 1.0 - ema_decay
             if hasattr(new_opt_state, "mini_step"):
@@ -96,12 +99,17 @@ def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
                                       step_size, 0.0)
             new_ema = optax.incremental_update(new_params, state.ema_params,
                                                step_size)
+            # BN running stats averaged on the same horizon — evaluating
+            # averaged weights against live statistics skews the metrics.
+            new_ema_state = optax.incremental_update(
+                new_model_state, state.ema_model_state, step_size)
         metrics = {"loss": loss, "batch": jnp.asarray(labels.shape[0], jnp.float32),
                    **topk_correct(logits, labels)}
         return (TrainState(step=state.step + 1, params=new_params,
                            model_state=new_model_state,
                            opt_state=new_opt_state,
-                           ema_params=new_ema), metrics)
+                           ema_params=new_ema,
+                           ema_model_state=new_ema_state), metrics)
 
     return step
 
@@ -144,7 +152,8 @@ def make_eval_step(model: StagedModel, *, mean, std, dtype=jnp.float32,
     def step(state: TrainState, images_u8, labels):
         images = normalize(images_u8, mean, std, dtype)
         params = state.ema_params if use_ema else state.params
-        logits, _ = model.apply(params, state.model_state, images,
+        model_state = state.ema_model_state if use_ema else state.model_state
+        logits, _ = model.apply(params, model_state, images,
                                 train=False)
         return {"loss": cross_entropy(logits, labels),
                 "batch": jnp.asarray(labels.shape[0], jnp.float32),
@@ -198,6 +207,8 @@ class Trainer:
         kw = dict(mean=train_ds.mean, std=train_ds.std)
 
         ema = config.optimizer.ema_decay
+        if ema is not None and not (0.0 <= ema <= 1.0):
+            raise ValueError(f"ema_decay must be in [0, 1], got {ema}")
         if config.strategy == "ddp":
             if config.device_resident_data:
                 raise ValueError(
@@ -250,18 +261,22 @@ class Trainer:
                 self._state_sh = TrainState(
                     step=self._repl, params=params_sh,
                     model_state=self._repl, opt_state=opt_sh,
-                    ema_params=params_sh if ema is not None else None)
+                    ema_params=params_sh if ema is not None else None,
+                    ema_model_state=(self._repl if ema is not None else None))
             else:
                 self._state_sh = self._repl
                 opt_state = self.tx.init(params)
-            # EMA starts at the initial weights — as a real copy: params and
-            # ema_params live in one donated state, and donation rejects the
-            # same buffer appearing twice.
+            # EMA starts at the initial weights/stats — as real copies:
+            # params and ema_params live in one donated state, and donation
+            # rejects the same buffer appearing twice.
             ema_params = (jax.tree.map(jnp.copy, params) if ema is not None
                           else None)
+            ema_model_state = (jax.tree.map(jnp.copy, model_state)
+                               if ema is not None else None)
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                                model_state=model_state, opt_state=opt_state,
-                               ema_params=ema_params)
+                               ema_params=ema_params,
+                               ema_model_state=ema_model_state)
             self.state = jax.device_put(state, self._state_sh)
             self._train_step = jax.jit(
                 make_train_step(self.model, self.tx, ema_decay=ema,
@@ -324,22 +339,27 @@ class Trainer:
         tmpl = self._ckpt_tree()
         try:
             restored = self.ckpt.restore(tmpl, name)
-        except Exception:
-            # The checkpoint's TrainState may differ from the current config
-            # in the optional ema_params subtree (run resumed with
-            # ema_decay toggled). Retry with the opposite template, then
-            # reconcile below.
+        except (ValueError, KeyError, TypeError):
+            # Structure mismatch: the checkpoint's TrainState may differ
+            # from the current config in the optional EMA subtrees (run
+            # resumed with ema_decay toggled). Retry with the opposite
+            # template, then reconcile below; a genuinely broken checkpoint
+            # fails again here with the original error chained.
             st = tmpl["state"]
-            alt = st.replace(ema_params=(
-                None if st.ema_params is not None else st.params))
+            has_ema = st.ema_params is not None
+            alt = st.replace(
+                ema_params=None if has_ema else st.params,
+                ema_model_state=None if has_ema else st.model_state)
             restored = self.ckpt.restore({**tmpl, "state": alt}, name)
         rs = restored["state"]
         want_ema = self.config.optimizer.ema_decay is not None
         if want_ema and rs.ema_params is None:
-            # EMA newly enabled: seed the average at the restored weights.
-            rs = rs.replace(ema_params=jax.tree.map(jnp.copy, rs.params))
+            # EMA newly enabled: seed the averages at the restored state.
+            rs = rs.replace(
+                ema_params=jax.tree.map(jnp.copy, rs.params),
+                ema_model_state=jax.tree.map(jnp.copy, rs.model_state))
         elif not want_ema and rs.ema_params is not None:
-            rs = rs.replace(ema_params=None)
+            rs = rs.replace(ema_params=None, ema_model_state=None)
         self.state = jax.device_put(rs, self._state_sh)
         self.best_acc = float(restored["best_acc"])
         self.start_epoch = int(restored["epoch"])

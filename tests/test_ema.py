@@ -166,3 +166,47 @@ def test_ema_device_resident_matches_per_batch(tmp_path):
 def test_ema_rejected_on_ddp(tmp_path):
     with pytest.raises(ValueError, match="ema"):
         Trainer(ema_cfg(tmp_path, 0.9, strategy="ddp"))
+
+
+def test_ema_decay_range_validated(tmp_path):
+    with pytest.raises(ValueError, match="0, 1"):
+        Trainer(ema_cfg(tmp_path, 1.5))
+
+
+def test_ema_model_state_averaged(tmp_path):
+    """BN running stats are averaged on the same horizon as the weights —
+    evaluation never pairs averaged weights with live statistics."""
+    d = 0.5
+    t = Trainer(ema_cfg(tmp_path, d, epochs=1))
+    s0 = jax.device_get(t.state.model_state)
+    images, labels = next(iter(t.train_loader))
+    images, labels = t._shard_batch(images, labels)
+    t.state, _ = t._train_step(t.state, jax.random.key(3), images, labels)
+    s1 = jax.device_get(t.state.model_state)
+    ema_s = jax.device_get(t.state.ema_model_state)
+    moved = False
+    for a0, a1, e in zip(jax.tree.leaves(s0), jax.tree.leaves(s1),
+                         jax.tree.leaves(ema_s)):
+        np.testing.assert_allclose(e, d * a0 + (1 - d) * a1,
+                                   rtol=1e-5, atol=1e-6)
+        moved = moved or float(np.abs(a1 - a0).max()) > 0
+    assert moved, "BN stats never changed; test exercised nothing"
+
+
+def test_ema_rejected_on_lm_and_pipeline_trainers(tmp_path):
+    from distributed_model_parallel_tpu.config import MeshConfig
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    with pytest.raises(ValueError, match="silent"):
+        LMTrainer(LMTrainConfig(
+            optimizer=OptimizerConfig(ema_decay=0.9),
+            checkpoint_dir=str(tmp_path / "c"), log_dir=str(tmp_path / "l")))
+    with pytest.raises(ValueError, match="silent"):
+        PipelineTrainer(ema_cfg(tmp_path, 0.9,
+                                mesh=MeshConfig(data=1, stage=4)))

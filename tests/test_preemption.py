@@ -2,6 +2,7 @@
 immediate checkpoint → clean resume. The reference loses all progress since
 the last best-acc save on any kill (SURVEY.md §5 "Failure detection")."""
 
+import dataclasses
 import os
 import signal
 import threading
@@ -85,6 +86,36 @@ def test_sigterm_mid_fit_stops_and_checkpoints(tmp_path):
 
     t2 = Trainer(cfg.replace(resume=True))
     assert t2.start_epoch == t.start_epoch
+
+
+def test_lm_preemption_checkpoints(tmp_path):
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+
+    cfg = LMTrainConfig(
+        model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq_len=16),
+        mesh=MeshConfig(data=2), batch_size=4, seq_len=16,
+        steps_per_epoch=3, epochs=5, n_tokens=2000,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    t = LMTrainer(cfg)
+    hist = t.fit(epochs=1)
+    assert len(hist) == 1
+    t.preemption.request()
+    more = t.fit()
+    assert more == []
+    assert t.start_epoch == 1
+    t2 = LMTrainer(dataclasses.replace(cfg, resume=True))
+    assert t2.start_epoch == 1
+    # Consumed flag: training continues normally afterwards.
+    hist = t.fit(epochs=2)
+    assert len(hist) == 1
 
 
 def test_pipeline_preemption_checkpoints(tmp_path):
