@@ -144,11 +144,14 @@ class LMTrainer:
                 if self.preemption.requested():
                     # Partial epoch: save for resume at this epoch and stop
                     # cleanly (train/preemption.py).
+                    from distributed_model_parallel_tpu.train.preemption import (
+                        checkpoint_on_preempt,
+                    )
+
                     self.start_epoch = epoch
-                    self.ckpt.save(self._ckpt_tree(), "lm")
-                    self.logger.log_line(
-                        f"preempted: checkpoint saved at epoch {epoch}")
-                    self.preemption.reset()
+                    checkpoint_on_preempt(self.preemption, self.ckpt,
+                                          self._ckpt_tree(), "lm",
+                                          self.logger, epoch)
                     break
                 record = dict(epoch=epoch, loss_train=meter.avg,
                               time_per_batch=timer.step.avg,

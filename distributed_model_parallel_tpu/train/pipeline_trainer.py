@@ -155,16 +155,18 @@ class PipelineTrainer:
             for epoch in range(self.start_epoch, epochs):
                 tr = self._run_epoch(epoch, train=True)
                 if self.preemption.requested():
-                    # Partial epoch: checkpoint for resume at this epoch
-                    # under the dedicated preemption slot (the pipeline
+                    # Partial epoch: resume at this epoch (the pipeline
                     # path had NO checkpointing at all in the reference,
-                    # SURVEY.md §5); consume the request so a later fit()
-                    # trains normally.
+                    # SURVEY.md §5).
+                    from distributed_model_parallel_tpu.train.preemption import (
+                        checkpoint_on_preempt,
+                    )
+
                     self.start_epoch = epoch
-                    self.ckpt.save(self._ckpt_tree(), "pipeline-preempt")
-                    self.logger.log_line(
-                        f"preempted: checkpoint saved at epoch {epoch}")
-                    self.preemption.reset()
+                    checkpoint_on_preempt(self.preemption, self.ckpt,
+                                          self._ckpt_tree(),
+                                          "pipeline-preempt", self.logger,
+                                          epoch)
                     break
                 ev = self._run_epoch(epoch, train=False)
                 record = dict(epoch=epoch, loss_train=tr.loss,

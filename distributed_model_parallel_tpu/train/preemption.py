@@ -21,6 +21,17 @@ logger = logging.getLogger(__name__)
 DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 
+def checkpoint_on_preempt(guard: "PreemptionGuard", ckpt, tree, name: str,
+                          logger, epoch: int) -> None:
+    """The shared honor-a-preemption sequence used by every epoch driver:
+    durable save under the dedicated slot, event line, consume the request
+    (so a later fit() trains normally). Callers set their resume epoch
+    before building ``tree`` and ``break`` after."""
+    ckpt.save(tree, name, wait=True)
+    logger.log_line(f"preempted: checkpoint saved at epoch {epoch}")
+    guard.reset()
+
+
 class PreemptionGuard:
     """Converts SIGTERM/SIGINT into a thread-safe "stop requested" flag.
 

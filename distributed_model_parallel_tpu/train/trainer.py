@@ -337,28 +337,38 @@ class Trainer:
         # evicts the best-model weights).
         name = self.ckpt.newest_name(("ckpt", "preempt")) or "ckpt"
         tmpl = self._ckpt_tree()
-        try:
-            restored = self.ckpt.restore(tmpl, name)
-        except (ValueError, KeyError, TypeError):
-            # Structure mismatch: the checkpoint's TrainState may differ
-            # from the current config in the optional EMA subtrees (run
-            # resumed with ema_decay toggled). Retry with the opposite
-            # template, then reconcile below; a genuinely broken checkpoint
-            # fails again here with the original error chained.
-            st = tmpl["state"]
-            has_ema = st.ema_params is not None
-            alt = st.replace(
-                ema_params=None if has_ema else st.params,
-                ema_model_state=None if has_ema else st.model_state)
-            restored = self.ckpt.restore({**tmpl, "state": alt}, name)
+        # The checkpoint's TrainState may differ from the current config in
+        # the optional EMA subtrees: runs resumed with ema_decay toggled,
+        # and checkpoints from before ema_model_state existed (params-only
+        # EMA layout). Try the current template first, then each alternate
+        # layout; a genuinely broken checkpoint exhausts them and raises
+        # with the original error chained.
+        st = tmpl["state"]
+        layouts = [
+            st,
+            st.replace(ema_params=None, ema_model_state=None),
+            st.replace(ema_params=st.params, ema_model_state=st.model_state),
+            st.replace(ema_params=st.params, ema_model_state=None),
+        ]
+        restored = None
+        for i, layout in enumerate(layouts):
+            try:
+                restored = self.ckpt.restore({**tmpl, "state": layout}, name)
+                break
+            except (ValueError, KeyError, TypeError):
+                if i == len(layouts) - 1:
+                    raise
         rs = restored["state"]
         want_ema = self.config.optimizer.ema_decay is not None
-        if want_ema and rs.ema_params is None:
-            # EMA newly enabled: seed the averages at the restored state.
-            rs = rs.replace(
-                ema_params=jax.tree.map(jnp.copy, rs.params),
-                ema_model_state=jax.tree.map(jnp.copy, rs.model_state))
-        elif not want_ema and rs.ema_params is not None:
+        if want_ema:
+            if rs.ema_params is None:
+                # EMA newly enabled: seed the average at the restored state.
+                rs = rs.replace(ema_params=jax.tree.map(jnp.copy, rs.params))
+            if rs.ema_model_state is None:
+                # Also covers the legacy params-only EMA layout.
+                rs = rs.replace(
+                    ema_model_state=jax.tree.map(jnp.copy, rs.model_state))
+        elif rs.ema_params is not None or rs.ema_model_state is not None:
             rs = rs.replace(ema_params=None, ema_model_state=None)
         self.state = jax.device_put(rs, self._state_sh)
         self.best_acc = float(restored["best_acc"])
@@ -509,16 +519,17 @@ class Trainer:
             for epoch in range(self.start_epoch, epochs):
                 tr = self.train_epoch(epoch)
                 if self.preemption.requested():
-                    # Partial epoch: save for resume *at* this epoch (the
-                    # standard redo-the-epoch convention) under the
-                    # dedicated preemption slot — the best-accuracy
-                    # checkpoint is never evicted — and stop. The request
-                    # is consumed so a later fit() trains normally.
+                    # Partial epoch: resume *at* this epoch (the standard
+                    # redo-the-epoch convention); the dedicated slot never
+                    # evicts the best-accuracy checkpoint.
+                    from distributed_model_parallel_tpu.train.preemption import (
+                        checkpoint_on_preempt,
+                    )
+
                     self.start_epoch = epoch
-                    self.ckpt.save(self._ckpt_tree(), "preempt", wait=True)
-                    self.logger.log_line(
-                        f"preempted: checkpoint saved at epoch {epoch}")
-                    self.preemption.reset()
+                    checkpoint_on_preempt(self.preemption, self.ckpt,
+                                          self._ckpt_tree(), "preempt",
+                                          self.logger, epoch)
                     break
                 ev = self.evaluate()
                 record = dict(epoch=epoch, loss_train=tr.loss,

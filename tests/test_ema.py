@@ -193,6 +193,30 @@ def test_ema_model_state_averaged(tmp_path):
     assert moved, "BN stats never changed; test exercised nothing"
 
 
+def test_resume_from_legacy_params_only_ema_layout(tmp_path):
+    """Checkpoints written by the params-only EMA layout (before
+    ema_model_state existed) still resume: the average of the BN stats is
+    seeded from the restored live stats."""
+    cfg = ema_cfg(tmp_path, 0.9, epochs=1)
+    t = Trainer(cfg)
+    t.fit()
+    # Rewrite the checkpoint in the legacy layout: ema_params kept,
+    # ema_model_state dropped.
+    legacy_state = t.state.replace(ema_model_state=None)
+    t.ckpt.save({"state": legacy_state,
+                 "best_acc": jnp.asarray(t.best_acc, jnp.float32),
+                 "epoch": jnp.asarray(t.start_epoch, jnp.int32)}, "ckpt")
+
+    t2 = Trainer(cfg.replace(resume=True))
+    assert t2.state.ema_model_state is not None
+    for a, b in zip(jax.tree.leaves(jax.device_get(t.state.ema_params)),
+                    jax.tree.leaves(jax.device_get(t2.state.ema_params))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(t.state.model_state)),
+                    jax.tree.leaves(jax.device_get(t2.state.ema_model_state))):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_ema_rejected_on_lm_and_pipeline_trainers(tmp_path):
     from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.train.lm_trainer import (
