@@ -286,17 +286,40 @@ def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
     return x + h, kc, vc
 
 
+def _filter_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the k highest logits to -inf (k static; [B, V])."""
+    kth = jax.lax.top_k(logits, k)[0][:, -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability reaches p (always at least the top token). Static-shape:
+    sort, exclusive cumulative softmax mass, scatter the mask back."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # Exclusive cumsum: a token is kept if the mass *before* it is < p.
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = cum_before < p
+    kth_idx = jnp.sum(keep_sorted, axis=-1) - 1         # last kept rank
+    threshold = jnp.take_along_axis(sorted_logits, kth_idx[:, None], axis=-1)
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
 def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
              steps: int, *, rng: jax.Array | None = None,
-             temperature: float = 0.0) -> jax.Array:
+             temperature: float = 0.0, top_k: int | None = None,
+             top_p: float | None = None) -> jax.Array:
     """Autoregressive decoding with a per-layer KV cache.
 
     prompt: [B, T0] int32 -> [B, T0 + steps]. Greedy when temperature == 0,
-    else softmax sampling at the given temperature. The whole decode is one
-    jittable ``lax.scan`` over positions (static shapes; cache updated via
-    dynamic_update_slice), the TPU-native replacement for a Python
-    token-by-token loop. Single-program only — no mesh axes are consulted
-    (run it on replicated params).
+    else softmax sampling at the given temperature, optionally filtered by
+    ``top_k`` (keep the k best tokens) and/or ``top_p`` (nucleus: smallest
+    set reaching cumulative probability p) — both static-shape jittable.
+    The whole decode is one jittable ``lax.scan`` over positions (static
+    shapes; cache updated via dynamic_update_slice), the TPU-native
+    replacement for a Python token-by-token loop. Single-program only — no
+    mesh axes are consulted (run it on replicated params).
 
     The reference has no inference path at all; this rounds out the LM
     tooling the flagship model needs.
@@ -308,14 +331,25 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     if total > cfg.max_seq_len:
         raise ValueError(f"prompt + steps = {total} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
+    if (top_k is not None or top_p is not None) and temperature <= 0:
+        raise ValueError("top_k/top_p filter the sampling distribution; "
+                         "set temperature > 0 (greedy ignores them)")
+    if top_k is not None and not (1 <= top_k <= cfg.vocab_size):
+        raise ValueError(f"top_k must be in [1, {cfg.vocab_size}], got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
     if rng is None:
         rng = jax.random.key(0)
 
     def sample(logits, sub):
         if temperature > 0:
-            return jax.random.categorical(sub, logits / temperature
-                                          ).astype(jnp.int32)
+            logits = logits / temperature
+            if top_k is not None:
+                logits = _filter_top_k(logits, top_k)
+            if top_p is not None:
+                logits = _filter_top_p(logits, top_p)
+            return jax.random.categorical(sub, logits).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # -- Prefill: one batched forward over the whole prompt fills every

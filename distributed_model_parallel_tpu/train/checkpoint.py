@@ -95,6 +95,26 @@ class Checkpointer:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
         return self._ckpt.restore(path, abstract)
 
+    def restore_subtree(self, target: Any, name: str = "ckpt") -> Any:
+        """Restore only the top-level keys present in ``target`` (a dict),
+        e.g. just the params of a full train-state checkpoint for
+        inference. Uses orbax partial restore: only the requested subtrees
+        are read from storage — a params-only restore never materializes
+        the (larger) optimizer state."""
+        self.wait_until_finished()
+        path = self._latest_path(name)
+        if path is None:
+            raise FileNotFoundError(self._path(name))
+        tree = self._ckpt.metadata(path).item_metadata.tree
+        missing = [k for k in target if k not in tree]
+        if missing:
+            raise KeyError(f"checkpoint {path} has no keys {missing}; "
+                           f"available: {sorted(tree)}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        return ocp.PyTreeCheckpointer().restore(
+            path, args=ocp.args.PyTreeRestore(item=abstract,
+                                              partial_restore=True))
+
     def exists(self, name: str = "ckpt") -> bool:
         self.wait_until_finished()
         return self._latest_path(name) is not None

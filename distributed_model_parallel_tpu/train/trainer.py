@@ -344,12 +344,17 @@ class Trainer:
         # layout; a genuinely broken checkpoint exhausts them and raises
         # with the original error chained.
         st = tmpl["state"]
-        layouts = [
-            st,
-            st.replace(ema_params=None, ema_model_state=None),
-            st.replace(ema_params=st.params, ema_model_state=st.model_state),
-            st.replace(ema_params=st.params, ema_model_state=None),
-        ]
+        layouts, seen = [], set()
+        for layout in (
+                st,
+                st.replace(ema_params=None, ema_model_state=None),
+                st.replace(ema_params=st.params,
+                           ema_model_state=st.model_state),
+                st.replace(ema_params=st.params, ema_model_state=None)):
+            key = jax.tree.structure(layout)
+            if key not in seen:          # the candidates overlap with tmpl
+                seen.add(key)
+                layouts.append(layout)
         restored = None
         for i, layout in enumerate(layouts):
             try:

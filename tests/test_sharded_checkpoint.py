@@ -37,3 +37,22 @@ def test_missing_checkpoint_raises(tmp_path):
         raise AssertionError("should have raised")
     except FileNotFoundError:
         pass
+
+
+def test_restore_subtree_partial(tmp_path):
+    """restore_subtree pulls only the requested top-level keys (e.g. params
+    for inference) and errors clearly on unknown keys."""
+    ckpt = Checkpointer(str(tmp_path / "ck3"))
+    tree = {"params": {"w": jnp.arange(6.0)},
+            "opt_state": {"m": jnp.ones(6)},
+            "epoch": jnp.asarray(2, jnp.int32)}
+    ckpt.save(tree, "lm")
+    out = ckpt.restore_subtree({"params": {"w": jnp.zeros(6)}}, "lm")
+    assert set(out) == {"params"}
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(6.0))
+    import pytest
+    with pytest.raises(KeyError, match="available"):
+        ckpt.restore_subtree({"nope": jnp.zeros(2)}, "lm")
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_subtree({"params": jnp.zeros(2)}, "absent")

@@ -352,3 +352,50 @@ def test_generate_moe(moe_params):
 def test_generate_rejects_overflow(params):
     with pytest.raises(ValueError):
         tfm.generate(params, CFG, jnp.zeros((1, 60), jnp.int32), 10)
+
+
+def test_top_k_filter_masks_all_but_k():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = np.asarray(tfm._filter_top_k(logits, 2))
+    assert np.isfinite(out[0, [1, 4]]).all()       # top-2 kept
+    assert np.isneginf(out[0, [0, 2, 3]]).all()    # rest masked
+
+
+def test_top_p_filter_keeps_nucleus():
+    # probs ~ [0.643, 0.237, 0.087, 0.032] -> p=0.7 keeps {0, 1}.
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+    out = np.asarray(tfm._filter_top_p(logits, 0.7))
+    assert np.isfinite(out[0, [0, 1]]).all()
+    assert np.isneginf(out[0, [2, 3]]).all()
+    # p smaller than the top token's mass still keeps the top token.
+    out = np.asarray(tfm._filter_top_p(logits, 0.01))
+    assert np.isfinite(out[0, 0]) and np.isneginf(out[0, 1:]).all()
+
+
+def test_generate_top_k_restricts_tokens(params):
+    """With top_k=1, sampling at any temperature degenerates to greedy."""
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    greedy = tfm.generate(params, CFG, prompt, 5)
+    k1 = tfm.generate(params, CFG, prompt, 5, temperature=2.0, top_k=1,
+                      rng=jax.random.key(11))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_generate_top_p_runs_and_differs_by_seed(params):
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    a = tfm.generate(params, CFG, prompt, 5, temperature=1.0, top_p=0.9,
+                     rng=jax.random.key(1))
+    b = tfm.generate(params, CFG, prompt, 5, temperature=1.0, top_p=0.9,
+                     rng=jax.random.key(2))
+    assert a.shape == (1, 8)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_sampler_arg_validation(params):
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        tfm.generate(params, CFG, prompt, 2, top_k=5)
+    with pytest.raises(ValueError, match="top_k"):
+        tfm.generate(params, CFG, prompt, 2, temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        tfm.generate(params, CFG, prompt, 2, temperature=1.0, top_p=1.5)
