@@ -30,6 +30,9 @@ def parse_args():
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--max-seq-len", type=int, default=128)
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="experts per block; must match the training run")
+    p.add_argument("--moe-top-k", type=int, default=2)
     p.add_argument("--checkpoint-dir", default="./checkpoint")
     p.add_argument("--prompt", default="1,2,3",
                    help="comma-separated token ids (the LM trains on a "
@@ -55,7 +58,8 @@ def main():
     cfg = tfm.TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
         n_layers=args.layers, d_ff=args.d_ff,
-        max_seq_len=max(args.max_seq_len, 128))
+        max_seq_len=max(args.max_seq_len, 128),
+        moe_experts=args.moe_experts, moe_top_k=args.moe_top_k)
     params = tfm.init_params(jax.random.key(args.seed), cfg)
 
     ckpt = Checkpointer(args.checkpoint_dir)

@@ -69,6 +69,9 @@ def parse_args():
                    help="persist checkpoints on a background thread")
     p.add_argument("--sync-bn", action="store_true",
                    help="SyncBatchNorm semantics (BASELINE config 3)")
+    p.add_argument("--no-bn", action="store_true",
+                   help="train without BatchNorm (the reference's "
+                        "MobileNetV2_nobn large-batch study)")
     p.add_argument("--ddp", action="store_true",
                    help="explicit shard_map DDP engine (per-replica BN, "
                         "psum grad averaging) instead of GSPMD")
@@ -114,7 +117,8 @@ def main():
     steps_per_epoch = max(1, 50000 // args.batch_size)
     config = TrainConfig(
         model=ModelConfig(name=args.model,
-                          batchnorm="sync" if args.sync_bn else "local",
+                          batchnorm=("none" if args.no_bn
+                                     else "sync" if args.sync_bn else "local"),
                           dtype="bfloat16" if args.bf16 else "float32"),
         data=DataConfig(name=args.dataset_type, root=args.data,
                         batch_size=args.batch_size, num_workers=args.workers,
