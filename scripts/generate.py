@@ -32,7 +32,10 @@ def parse_args():
     p.add_argument("--max-seq-len", type=int, default=128)
     p.add_argument("--moe-experts", type=int, default=0,
                    help="experts per block; must match the training run")
-    p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="routing fan-out; must match the training run "
+                        "(shapes restore either way, but a mismatched k "
+                        "routes differently than the trained model)")
     p.add_argument("--checkpoint-dir", default="./checkpoint")
     p.add_argument("--prompt", default="1,2,3",
                    help="comma-separated token ids (the LM trains on a "
@@ -49,6 +52,9 @@ def parse_args():
 
 def main():
     args = parse_args()
+    if args.moe_experts and not (1 <= args.moe_top_k <= args.moe_experts):
+        raise SystemExit(
+            f"--moe-top-k must be in [1, --moe-experts={args.moe_experts}]")
     import jax
     import jax.numpy as jnp
 

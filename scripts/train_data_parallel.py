@@ -108,6 +108,10 @@ def main():
 
     if args.ddp and args.fsdp:
         sys.exit("--ddp and --fsdp are mutually exclusive engines")
+    if args.sync_bn and args.no_bn:
+        sys.exit("--sync-bn and --no-bn are mutually exclusive")
+    if args.sync_bn and args.model.endswith("_nobn"):
+        sys.exit(f"--sync-bn conflicts with the BN-free model {args.model!r}")
     if not args.ddp and (args.allreduce != "psum" or args.bucket_mb):
         print("warning: --allreduce/--bucket-mb select the explicit DDP "
               "gradient transport; without --ddp the GSPMD path lets XLA "

@@ -59,8 +59,9 @@ def main():
         raise SystemExit("--layers must be divisible by --pp")
     if args.ep > 1 and args.moe_experts % args.ep:
         raise SystemExit("--moe-experts must be divisible by --ep")
-    if args.moe_experts and args.moe_top_k > args.moe_experts:
-        raise SystemExit("--moe-top-k must be <= --moe-experts")
+    if args.moe_experts and not (1 <= args.moe_top_k <= args.moe_experts):
+        raise SystemExit(
+            f"--moe-top-k must be in [1, --moe-experts={args.moe_experts}]")
     config = LMTrainConfig(
         model=TransformerConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
