@@ -69,6 +69,12 @@ class TransformerConfig:
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01   # load-balance loss weight in lm_loss
     ep_axis: str | None = None
+    # Positional encoding: "learned" (additive table, the default) or
+    # "rope" (rotary: q/k rotated per position inside attention — relative
+    # positions, no learned table, extrapolates past the training length).
+    # Under sequence parallelism each shard rotates with its global offset.
+    pos_embedding: str = "learned"
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
@@ -120,20 +126,59 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
             "w2": stack(k[5], (f, d), f),
             "b2": jnp.zeros((L, d), dt),
         })
-    return {
+    out = {
         "embed": jax.random.normal(k[0], (cfg.vocab_size, d), dt) * 0.02,
-        "pos": jax.random.normal(k[1], (cfg.max_seq_len, d), dt) * 0.02,
         "blocks": blocks,
         "ln_f_scale": jnp.ones((d,), dt),
         "ln_f_bias": jnp.zeros((d,), dt),
         "head": dense(k[6], (d, cfg.vocab_size), d),
     }
+    if cfg.pos_embedding == "learned":
+        out["pos"] = jax.random.normal(k[1], (cfg.max_seq_len, d), dt) * 0.02
+    elif cfg.pos_embedding != "rope":
+        raise ValueError(f"unknown pos_embedding {cfg.pos_embedding!r}")
+    return out
 
 
 def layer_norm(x, scale, bias, eps=1e-5):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (GPT-NeoX half-split convention).
+
+    x: [B, T, H, Dh] (Dh even), positions: [T] absolute token positions.
+    Rotates each (x[..., i], x[..., i + Dh/2]) pair by position * theta^(-2i/Dh);
+    q·k then depends only on relative position, which is what makes the
+    per-shard global offsets under sequence parallelism (and the per-step
+    offsets in cached decoding) compose exactly with full attention.
+    """
+    dh = x.shape[-1]
+    if dh % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {dh}")
+    inv_freq = theta ** (-jnp.arange(0, dh, 2, dtype=jnp.float32) / dh)
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None]  # [T, Dh/2]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :dh // 2], x[..., dh // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _rope_qk(q: jax.Array, k: jax.Array, cfg: TransformerConfig
+             ) -> tuple[jax.Array, jax.Array]:
+    """Rotate q/k for the training path. Inside a sequence-parallel
+    shard_map each shard covers [i*T_local, (i+1)*T_local); outside, the
+    (global) sequence starts at 0."""
+    t = q.shape[1]
+    start = (jax.lax.axis_index(cfg.sp_axis) * t
+             if cfg.sp_axis is not None else 0)
+    positions = start + jnp.arange(t)
+    return (apply_rope(q, positions, cfg.rope_theta),
+            apply_rope(k, positions, cfg.rope_theta))
 
 
 def _attention(q, k, v, cfg: TransformerConfig):
@@ -168,6 +213,8 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig
     h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
     qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])  # [B,T,H_local,3*Dh]
     q, k, v = jnp.split(qkv, 3, axis=-1)              # each [B,T,H_local,Dh]
+    if cfg.pos_embedding == "rope":
+        q, k = _rope_qk(q, k, cfg)
     o = _attention(q, k, v, cfg)             # [B,T,H_local,Dh]
     o = o.reshape(b, t, -1) @ bp["wo"]       # row-parallel: partial sums
     if cfg.tp_axis is not None:
@@ -217,6 +264,17 @@ def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig
 
 def embed(params: dict, tokens: jax.Array, cfg: TransformerConfig,
           *, pos_offset: int = 0) -> jax.Array:
+    if cfg.pos_embedding == "rope":
+        # Positions enter through q/k rotation in attention, not the embed.
+        # The rotation path (_rope_qk) counts from 0 (or the shard's global
+        # offset), so an embed-level offset cannot be honored — reject it
+        # loudly rather than return silently mis-rotated logits. Cached
+        # decoding handles its own offsets (generate/forward_one).
+        if pos_offset:
+            raise ValueError(
+                "pos_offset is not supported with pos_embedding='rope'; "
+                "use generate() for offset (cached) decoding")
+        return params["embed"][tokens]
     t = tokens.shape[1]
     pos = jax.lax.dynamic_slice_in_dim(params["pos"], pos_offset, t)
     return params["embed"][tokens] + pos[None]
@@ -272,6 +330,12 @@ def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
     h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
     qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])   # [B,1,H,3*Dh]
     q, k, v = jnp.split(qkv, 3, axis=-1)               # each [B,1,H,Dh]
+    if cfg.pos_embedding == "rope":
+        # The cache holds *rotated* keys (prefill rotates too), so one
+        # rotation at insert time makes scores relative-position correct.
+        positions = jnp.reshape(pos, (1,))
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
     kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
     vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * (cfg.head_dim ** -0.5)
@@ -360,6 +424,10 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
         h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
         qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        if cfg.pos_embedding == "rope":
+            positions = jnp.arange(t0)
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
         o = full_attention(q, k, v, causal=True)
         x = x + o.reshape(b, t0, -1) @ bp["wo"]
         h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
@@ -375,8 +443,9 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 
     # -- Decode: one cached step per new position.
     def forward_one(cache_k, cache_v, tok, pos):
-        x = params["embed"][tok][:, None, :] + jax.lax.dynamic_slice_in_dim(
-            params["pos"], pos, 1)[None]
+        x = params["embed"][tok][:, None, :]
+        if cfg.pos_embedding == "learned":
+            x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1)[None]
 
         def layer(x, xs):
             bp, kc, vc = xs

@@ -124,7 +124,8 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
         return params, opt_state, loss
 
     pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
-                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis)
+                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,
+                         learned_pos=cfg.pos_embedding == "learned")
     p_sh = jax.tree.map(lambda ps: NamedSharding(spec.mesh, ps), pspecs,
                         is_leaf=lambda x: isinstance(x, P))
     seq = spec.seq_axis if cfg.sp_axis else None
@@ -144,7 +145,8 @@ def shard_params(params: dict, cfg: tfm.TransformerConfig,
     specs (the framework's replacement for per-rank shard construction,
     reference model_parallel.py:99-157)."""
     pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
-                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis)
+                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,
+                         learned_pos=cfg.pos_embedding == "learned")
     return jax.tree.map(
         lambda x, ps: jax.device_put(x, NamedSharding(spec.mesh, ps)),
         params, pspecs,

@@ -52,15 +52,20 @@ def block_specs(stage_axis: str | None, model_axis: str | None, *,
 
 
 def param_specs(stage_axis: str | None, model_axis: str | None, *,
-                moe: bool = False, ep_axis: str | None = None) -> dict:
+                moe: bool = False, ep_axis: str | None = None,
+                learned_pos: bool = True) -> dict:
     """Specs for the full transformer parameter pytree. Embedding/head stay
-    replicated (small at test scale; shard over ``model`` later if needed)."""
-    return {
+    replicated (small at test scale; shard over ``model`` later if needed).
+    ``learned_pos=False`` (RoPE) omits the positional table to match
+    ``init_params``' structure."""
+    out = {
         "embed": P(),
-        "pos": P(),
         "blocks": block_specs(stage_axis, model_axis, moe=moe,
                               ep_axis=ep_axis),
         "ln_f_scale": P(),
         "ln_f_bias": P(),
         "head": P(),
     }
+    if learned_pos:
+        out["pos"] = P()
+    return out

@@ -30,6 +30,8 @@ def parse_args():
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--max-seq-len", type=int, default=128)
+    p.add_argument("--rope", action="store_true",
+                   help="rotary positions; must match the training run")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="experts per block; must match the training run")
     p.add_argument("--moe-top-k", type=int, default=2,
@@ -65,7 +67,8 @@ def main():
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
         n_layers=args.layers, d_ff=args.d_ff,
         max_seq_len=max(args.max_seq_len, 128),
-        moe_experts=args.moe_experts, moe_top_k=args.moe_top_k)
+        moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
+        pos_embedding="rope" if args.rope else "learned")
     params = tfm.init_params(jax.random.key(args.seed), cfg)
 
     ckpt = Checkpointer(args.checkpoint_dir)

@@ -37,6 +37,9 @@ def parse_args():
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of a learned "
+                        "table (relative positions; extrapolates)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--microbatches", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.1)
@@ -70,7 +73,8 @@ def main():
             tp_axis="model" if args.tp > 1 else None,
             sp_axis="seq" if args.sp > 1 else None,
             moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
-            ep_axis="expert" if args.ep > 1 else None),
+            ep_axis="expert" if args.ep > 1 else None,
+            pos_embedding="rope" if args.rope else "learned"),
         mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
                         seq=args.sp, expert=args.ep),
         optimizer=OptimizerConfig(learning_rate=args.lr, weight_decay=0.0,
