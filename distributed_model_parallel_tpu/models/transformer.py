@@ -75,10 +75,23 @@ class TransformerConfig:
     # Under sequence parallelism each shard rotates with its global offset.
     pos_embedding: str = "learned"
     rope_theta: float = 10000.0
+    # Grouped-query attention: k/v get n_kv_heads heads (must divide
+    # n_heads); queries keep n_heads. None = multi-head (k/v fused in
+    # wqkv); 1 = multi-query. The KV cache shrinks by n_heads/n_kv_heads —
+    # the long-context decode memory lever.
+    n_kv_heads: int | None = None
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def gqa(self) -> bool:
+        return self.n_kv_heads is not None
 
     @property
     def moe(self) -> "MoEConfig | None":
@@ -105,13 +118,21 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     blocks = {
         "ln1_scale": jnp.ones((L, d), dt),
         "ln1_bias": jnp.zeros((L, d), dt),
-        # [d, H, 3*Dh]: head dim explicit so tensor parallelism shards
-        # whole heads (column-parallel over the H axis).
-        "wqkv": stack(k[2], (d, cfg.n_heads, 3 * cfg.head_dim), d),
         "wo": stack(k[3], (d, d), d),
         "ln2_scale": jnp.ones((L, d), dt),
         "ln2_bias": jnp.zeros((L, d), dt),
     }
+    if cfg.gqa:
+        if cfg.n_heads % cfg.kv_heads:
+            raise ValueError(f"n_kv_heads={cfg.kv_heads} must divide "
+                             f"n_heads={cfg.n_heads}")
+        blocks["wq"] = stack(k[2], (d, cfg.n_heads, cfg.head_dim), d)
+        blocks["wkv"] = stack(jax.random.fold_in(k[2], 1),
+                              (d, cfg.kv_heads, 2 * cfg.head_dim), d)
+    else:
+        # [d, H, 3*Dh]: head dim explicit so tensor parallelism shards
+        # whole heads (column-parallel over the H axis).
+        blocks["wqkv"] = stack(k[2], (d, cfg.n_heads, 3 * cfg.head_dim), d)
     if cfg.moe_experts:
         E = cfg.moe_experts
         blocks.update({
@@ -181,6 +202,29 @@ def _rope_qk(q: jax.Array, k: jax.Array, cfg: TransformerConfig
             apply_rope(k, positions, cfg.rope_theta))
 
 
+def _qkv_proj(bp: dict, h: jax.Array, cfg: TransformerConfig):
+    """Project to q [B,T,H(_local),Dh] and k/v [B,T,Hkv(_local),Dh] —
+    fused wqkv for multi-head, separate wq/wkv for grouped-query. One
+    helper for training, prefill, and cached decode so they never
+    diverge."""
+    if cfg.gqa:
+        q = jnp.einsum("btd,dhx->bthx", h, bp["wq"])
+        kv = jnp.einsum("btd,dhx->bthx", h, bp["wkv"])
+        k, v = jnp.split(kv, 2, axis=-1)
+    else:
+        qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+    return q, k, v
+
+
+def _repeat_kv(x: jax.Array, q: jax.Array) -> jax.Array:
+    """Broadcast kv heads up to the query head count ([..., Hkv, Dh] ->
+    [..., H, Dh]). The group factor comes from *local* shapes so it is
+    correct under tensor-parallel head sharding."""
+    groups = q.shape[2] // x.shape[2]
+    return x if groups == 1 else jnp.repeat(x, groups, axis=2)
+
+
 def _attention(q, k, v, cfg: TransformerConfig):
     if cfg.sp_axis is not None:
         if cfg.sp_impl == "ring":
@@ -211,10 +255,10 @@ def block_apply(bp: dict, x: jax.Array, cfg: TransformerConfig
     b, t, d = x.shape
 
     h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-    qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])  # [B,T,H_local,3*Dh]
-    q, k, v = jnp.split(qkv, 3, axis=-1)              # each [B,T,H_local,Dh]
+    q, k, v = _qkv_proj(bp, h, cfg)          # q:[B,T,H,Dh] kv:[B,T,Hkv,Dh]
     if cfg.pos_embedding == "rope":
         q, k = _rope_qk(q, k, cfg)
+    k, v = _repeat_kv(k, q), _repeat_kv(v, q)
     o = _attention(q, k, v, cfg)             # [B,T,H_local,Dh]
     o = o.reshape(b, t, -1) @ bp["wo"]       # row-parallel: partial sums
     if cfg.tp_axis is not None:
@@ -320,16 +364,16 @@ def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
                   pos: jax.Array, cfg: TransformerConfig):
     """One block for ONE token position with a KV cache.
 
-    x: [B, 1, d]; kc/vc: [B, T_total, H, Dh] (this layer's cache). Returns
-    (x, kc, vc) with the caches updated at ``pos``. Masking is by position
-    index, so shapes stay static under scan (no data-dependent slicing).
+    x: [B, 1, d]; kc/vc: [B, T_total, Hkv, Dh] (this layer's cache — kv
+    heads only, the GQA memory win). Returns (x, kc, vc) with the caches
+    updated at ``pos``. Masking is by position index, so shapes stay
+    static under scan (no data-dependent slicing).
     """
     b = x.shape[0]
     total = kc.shape[1]
 
     h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-    qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])   # [B,1,H,3*Dh]
-    q, k, v = jnp.split(qkv, 3, axis=-1)               # each [B,1,H,Dh]
+    q, k, v = _qkv_proj(bp, h, cfg)      # q:[B,1,H,Dh] kv:[B,1,Hkv,Dh]
     if cfg.pos_embedding == "rope":
         # The cache holds *rotated* keys (prefill rotates too), so one
         # rotation at insert time makes scores relative-position correct.
@@ -338,11 +382,15 @@ def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
         k = apply_rope(k, positions, cfg.rope_theta)
     kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
     vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * (cfg.head_dim ** -0.5)
-    mask = jnp.arange(total)[None, None, None, :] <= pos
+    # Grouped scores: query head h attends kv head h // G (G=1 for MHA),
+    # matching _repeat_kv's head mapping in the training path.
+    hkv = kc.shape[2]
+    qg = q.reshape(b, 1, hkv, q.shape[2] // hkv, cfg.head_dim)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc) * (cfg.head_dim ** -0.5)
+    mask = jnp.arange(total)[None, None, None, None, :] <= pos
     s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, vc)           # [B,1,H,Dh]
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vc)         # [B,1,Hkv,G,Dh]
     x = x + o.reshape(b, 1, -1) @ bp["wo"]
 
     h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
@@ -402,7 +450,6 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
         raise ValueError(f"top_k must be in [1, {cfg.vocab_size}], got {top_k}")
     if top_p is not None and not (0.0 < top_p <= 1.0):
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    L, H, Dh = cfg.n_layers, cfg.n_heads, cfg.head_dim
     if rng is None:
         rng = jax.random.key(0)
 
@@ -422,13 +469,13 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 
     def prefill_layer(x, bp):
         h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-        qkv = jnp.einsum("btd,dhx->bthx", h, bp["wqkv"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = _qkv_proj(bp, h, cfg)    # kv carry Hkv heads
         if cfg.pos_embedding == "rope":
             positions = jnp.arange(t0)
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
-        o = full_attention(q, k, v, causal=True)
+        # Cache the Hkv-head k/v; attention itself runs on broadcast heads.
+        o = full_attention(q, _repeat_kv(k, q), _repeat_kv(v, q), causal=True)
         x = x + o.reshape(b, t0, -1) @ bp["wo"]
         h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         h, _ = _ffn(bp, h, cfg, tp_axis=None, ep_axis=None)
@@ -436,7 +483,7 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 
     x, (ks, vs) = jax.lax.scan(prefill_layer, x, params["blocks"])
     pad = [(0, 0), (0, 0), (0, total - t0), (0, 0), (0, 0)]
-    cache_k = jnp.pad(ks, pad)               # [L, B, total, H, Dh]
+    cache_k = jnp.pad(ks, pad)               # [L, B, total, Hkv, Dh]
     cache_v = jnp.pad(vs, pad)
     rng, sub = jax.random.split(rng)
     tok0 = sample(unembed(params, x)[:, -1], sub)   # token at position t0

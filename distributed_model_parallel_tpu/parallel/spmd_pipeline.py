@@ -32,6 +32,7 @@ from distributed_model_parallel_tpu.mesh import MeshSpec
 from distributed_model_parallel_tpu.models import transformer as tfm
 from distributed_model_parallel_tpu.parallel.tensor_parallel import (
     block_specs,
+    kv_heads_shardable,
     param_specs,
 )
 
@@ -95,7 +96,9 @@ def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
         stage_fn, mesh=spec.mesh,
         in_specs=(block_specs(stage_axis, cfg.tp_axis,
                               moe=bool(cfg.moe_experts),
-                              ep_axis=cfg.ep_axis), x_spec),
+                              ep_axis=cfg.ep_axis, gqa=cfg.gqa,
+                              shard_kv=kv_heads_shardable(cfg, spec)),
+                  x_spec),
         out_specs=(x_spec, P()),
         check_vma=False)
 
@@ -125,7 +128,9 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
 
     pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
                          moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,
-                         learned_pos=cfg.pos_embedding == "learned")
+                         learned_pos=cfg.pos_embedding == "learned",
+                         gqa=cfg.gqa,
+                         shard_kv=kv_heads_shardable(cfg, spec))
     p_sh = jax.tree.map(lambda ps: NamedSharding(spec.mesh, ps), pspecs,
                         is_leaf=lambda x: isinstance(x, P))
     seq = spec.seq_axis if cfg.sp_axis else None
@@ -146,7 +151,9 @@ def shard_params(params: dict, cfg: tfm.TransformerConfig,
     reference model_parallel.py:99-157)."""
     pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
                          moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,
-                         learned_pos=cfg.pos_embedding == "learned")
+                         learned_pos=cfg.pos_embedding == "learned",
+                         gqa=cfg.gqa,
+                         shard_kv=kv_heads_shardable(cfg, spec))
     return jax.tree.map(
         lambda x, ps: jax.device_put(x, NamedSharding(spec.mesh, ps)),
         params, pspecs,

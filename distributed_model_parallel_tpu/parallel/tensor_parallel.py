@@ -14,26 +14,54 @@ from __future__ import annotations
 from jax.sharding import PartitionSpec as P
 
 
+def kv_heads_shardable(cfg, spec) -> bool:
+    """Whether wkv's head dim can shard over the tensor-parallel axis.
+
+    True when kv heads divide the tp ways (shard), False for multi-query
+    (replicate — each query shard pairs every local q head with the single
+    kv head, which is the only replicated layout where the local
+    ``_repeat_kv`` head mapping equals the global one). Anything else has
+    no correct local mapping and is rejected loudly.
+    """
+    tp = spec.config.model if cfg.tp_axis else 1
+    if tp == 1 or not cfg.gqa or cfg.kv_heads % tp == 0:
+        return True
+    if cfg.kv_heads == 1:
+        return False
+    raise ValueError(
+        f"n_kv_heads={cfg.kv_heads} is neither divisible by the "
+        f"tensor-parallel ways ({tp}) nor 1 (multi-query); no correct "
+        f"sharded or replicated kv layout exists for this combination")
+
+
 def block_specs(stage_axis: str | None, model_axis: str | None, *,
-                moe: bool = False, ep_axis: str | None = None) -> dict:
+                moe: bool = False, ep_axis: str | None = None,
+                gqa: bool = False, shard_kv: bool = True) -> dict:
     """PartitionSpecs for the stacked ``params["blocks"]`` pytree.
 
     Leading dim is the layer stack (sharded over ``stage`` for the SPMD
     pipeline); head/ffn dims shard over ``model``. With ``moe=True`` the
     FFN leaves are router/w_in/w_out; the expert dim shards over
     ``ep_axis`` (MoE replaces the FFN, so ``model`` then only shards
-    attention).
+    attention). With ``gqa=True`` attention carries separate wq/wkv leaves
+    (grouped-query), both column-parallel over their own head counts.
     """
     s, m = stage_axis, model_axis
     specs = {
         "ln1_scale": P(s, None),
         "ln1_bias": P(s, None),
-        "wqkv": P(s, None, m, None),  # column-parallel over heads
         "wo": P(s, m, None),          # row-parallel (rows = heads x Dh,
                                       # contiguous per head)
         "ln2_scale": P(s, None),
         "ln2_bias": P(s, None),
     }
+    if gqa:
+        specs["wq"] = P(s, None, m, None)
+        # shard_kv=False replicates k/v heads over the model axis — the
+        # multi-query case, where every query shard reads the one kv head.
+        specs["wkv"] = P(s, None, m if shard_kv else None, None)
+    else:
+        specs["wqkv"] = P(s, None, m, None)  # column-parallel over heads
     if moe:
         specs.update({
             "router": P(s, None, None),          # replicated: every token
@@ -53,7 +81,8 @@ def block_specs(stage_axis: str | None, model_axis: str | None, *,
 
 def param_specs(stage_axis: str | None, model_axis: str | None, *,
                 moe: bool = False, ep_axis: str | None = None,
-                learned_pos: bool = True) -> dict:
+                learned_pos: bool = True, gqa: bool = False,
+                shard_kv: bool = True) -> dict:
     """Specs for the full transformer parameter pytree. Embedding/head stay
     replicated (small at test scale; shard over ``model`` later if needed).
     ``learned_pos=False`` (RoPE) omits the positional table to match
@@ -61,7 +90,7 @@ def param_specs(stage_axis: str | None, model_axis: str | None, *,
     out = {
         "embed": P(),
         "blocks": block_specs(stage_axis, model_axis, moe=moe,
-                              ep_axis=ep_axis),
+                              ep_axis=ep_axis, gqa=gqa, shard_kv=shard_kv),
         "ln_f_scale": P(),
         "ln_f_bias": P(),
         "head": P(),

@@ -32,6 +32,9 @@ def parse_args():
     p.add_argument("--max-seq-len", type=int, default=128)
     p.add_argument("--rope", action="store_true",
                    help="rotary positions; must match the training run")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query k/v heads; must match the training "
+                        "run")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="experts per block; must match the training run")
     p.add_argument("--moe-top-k", type=int, default=2,
@@ -68,7 +71,8 @@ def main():
         n_layers=args.layers, d_ff=args.d_ff,
         max_seq_len=max(args.max_seq_len, 128),
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
-        pos_embedding="rope" if args.rope else "learned")
+        pos_embedding="rope" if args.rope else "learned",
+        n_kv_heads=args.kv_heads)
     params = tfm.init_params(jax.random.key(args.seed), cfg)
 
     ckpt = Checkpointer(args.checkpoint_dir)

@@ -40,6 +40,10 @@ def parse_args():
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings instead of a learned "
                         "table (relative positions; extrapolates)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query attention: k/v head count (must "
+                        "divide --heads; 1 = multi-query). Shrinks the "
+                        "decode KV cache by heads/kv-heads")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--microbatches", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.1)
@@ -74,7 +78,8 @@ def main():
             sp_axis="seq" if args.sp > 1 else None,
             moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
             ep_axis="expert" if args.ep > 1 else None,
-            pos_embedding="rope" if args.rope else "learned"),
+            pos_embedding="rope" if args.rope else "learned",
+            n_kv_heads=args.kv_heads),
         mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
                         seq=args.sp, expert=args.ep),
         optimizer=OptimizerConfig(learning_rate=args.lr, weight_decay=0.0,

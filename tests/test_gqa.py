@@ -1,0 +1,159 @@
+"""Grouped-query / multi-query attention (TransformerConfig.n_kv_heads).
+
+The KV cache shrinks by n_heads/n_kv_heads — the decode-memory lever for
+long context. Correctness hinges on the query->kv head mapping being
+identical in the training path (_repeat_kv) and the cached decode path
+(grouped einsum), which the teacher-forcing parity test pins.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.config import MeshConfig, OptimizerConfig
+from distributed_model_parallel_tpu.mesh import make_mesh
+from distributed_model_parallel_tpu.models import transformer as tfm
+
+CFG = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq_len=64, n_kv_heads=2)
+MQA_CFG = dataclasses.replace(CFG, n_kv_heads=1, pos_embedding="rope")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.key(0), CFG)
+
+
+def test_param_shapes_and_validation(params):
+    blocks = params["blocks"]
+    assert "wqkv" not in blocks
+    assert blocks["wq"].shape == (2, 32, 4, 8)
+    assert blocks["wkv"].shape == (2, 32, 2, 16)
+    with pytest.raises(ValueError, match="divide"):
+        tfm.init_params(jax.random.key(0),
+                        dataclasses.replace(CFG, n_kv_heads=3))
+
+
+def test_gqa_forward_and_grads(params):
+    toks = jax.random.randint(jax.random.key(1), (2, 9), 0, CFG.vocab_size)
+    logits = tfm.apply(params, toks, CFG)
+    assert logits.shape == (2, 9, CFG.vocab_size)
+    g = jax.grad(tfm.lm_loss)(params, toks[:, :-1], toks[:, 1:], CFG)
+    assert all(np.isfinite(l).all() for l in jax.tree.leaves(jax.device_get(g)))
+
+
+def test_kv_heads_equal_n_heads_matches_mha_math(params):
+    """n_kv_heads == n_heads through the GQA code path must equal the MHA
+    path when given the same effective weights (wq + wkv == fused wqkv)."""
+    cfg_full = dataclasses.replace(CFG, n_kv_heads=4)
+    p = tfm.init_params(jax.random.key(2), cfg_full)
+    fused = jnp.concatenate([p["blocks"]["wq"], p["blocks"]["wkv"]], axis=-1)
+    mha_blocks = {k: v for k, v in p["blocks"].items()
+                  if k not in ("wq", "wkv")}
+    mha_blocks["wqkv"] = fused
+    p_mha = {**p, "blocks": mha_blocks}
+    cfg_mha = dataclasses.replace(CFG, n_kv_heads=None)
+    toks = jax.random.randint(jax.random.key(3), (2, 7), 0, CFG.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(tfm.apply(p, toks, cfg_full)),
+        np.asarray(tfm.apply(p_mha, toks, cfg_mha)), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("cfg", [CFG, MQA_CFG], ids=["gqa2", "mqa_rope"])
+def test_generate_matches_teacher_forcing(cfg):
+    """Cached grouped decode == full forward argmax (the test that pins the
+    query->kv head mapping across both paths)."""
+    p = tfm.init_params(jax.random.key(4), cfg)
+    prompt = jnp.asarray(np.random.default_rng(5).integers(
+        0, cfg.vocab_size, (2, 5)), jnp.int32)
+    steps = 6
+    out = tfm.generate(p, cfg, prompt, steps)
+    logits = tfm.apply(p, out, cfg)
+    pred = np.argmax(np.asarray(logits[:, :-1], np.float32), axis=-1)
+    np.testing.assert_array_equal(np.asarray(out[:, 5:]),
+                                  pred[:, 4:4 + steps])
+
+
+def test_gqa_spmd_pipeline_and_tp_match_single_device(devices):
+    """dp x pp x tp with GQA: wq/wkv shard over their own head counts and
+    the sharded loss equals the single-device loss."""
+    from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+        make_spmd_train_step,
+        shard_params,
+    )
+    from distributed_model_parallel_tpu.train.optim import make_optimizer
+
+    cfg = dataclasses.replace(CFG, tp_axis="model")
+    spec = make_mesh(MeshConfig(data=2, stage=2, model=2))
+    tx = make_optimizer(OptimizerConfig(learning_rate=0.1, warmup_steps=0,
+                                        weight_decay=0.0, momentum=0.0), 1, 1)
+    step = make_spmd_train_step(cfg, spec, tx, num_microbatches=2)
+    host_params = tfm.init_params(jax.random.key(6), cfg)
+    toks = jax.random.randint(jax.random.key(7), (4, 17), 0, cfg.vocab_size)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+
+    single_cfg = dataclasses.replace(cfg, tp_axis=None)
+    want = float(tfm.lm_loss(host_params, tokens, targets, single_cfg))
+    opt_state = jax.device_put(tx.init(host_params),
+                               NamedSharding(spec.mesh, P()))
+    p = shard_params(host_params, cfg, spec)
+    _, _, loss = step(p, opt_state, tokens, targets)
+    assert float(loss) == pytest.approx(want, rel=2e-5)
+
+
+def test_mqa_with_tensor_parallelism_matches_single_device(devices):
+    """MQA (1 kv head) under TP: wkv replicates over the model axis and the
+    sharded loss still equals the single-device loss."""
+    from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+        make_spmd_train_step,
+        shard_params,
+    )
+    from distributed_model_parallel_tpu.train.optim import make_optimizer
+
+    cfg = dataclasses.replace(CFG, n_kv_heads=1, tp_axis="model")
+    spec = make_mesh(MeshConfig(data=2, model=2))
+    tx = make_optimizer(OptimizerConfig(learning_rate=0.1, warmup_steps=0,
+                                        weight_decay=0.0, momentum=0.0), 1, 1)
+    step = make_spmd_train_step(cfg, spec, tx, num_microbatches=1)
+    host_params = tfm.init_params(jax.random.key(9), cfg)
+    toks = jax.random.randint(jax.random.key(10), (4, 13), 0, cfg.vocab_size)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+    want = float(tfm.lm_loss(host_params, tokens, targets,
+                             dataclasses.replace(cfg, tp_axis=None)))
+    opt_state = jax.device_put(tx.init(host_params),
+                               NamedSharding(spec.mesh, P()))
+    p = shard_params(host_params, cfg, spec)
+    _, _, loss = step(p, opt_state, tokens, targets)
+    assert float(loss) == pytest.approx(want, rel=2e-5)
+
+
+def test_unmappable_kv_tp_combo_rejected(devices):
+    """kv heads neither divisible by tp nor 1 has no correct layout."""
+    from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+        make_spmd_train_step,
+    )
+    from distributed_model_parallel_tpu.train.optim import make_optimizer
+
+    cfg = dataclasses.replace(CFG, n_heads=8, n_kv_heads=2, d_model=64,
+                              tp_axis="model")
+    spec = make_mesh(MeshConfig(model=4))
+    tx = make_optimizer(OptimizerConfig(learning_rate=0.1), 1, 1)
+    with pytest.raises(ValueError, match="multi-query"):
+        make_spmd_train_step(cfg, spec, tx, num_microbatches=1)
+
+
+def test_cache_is_kv_heads_sized():
+    """The decode cache carries n_kv_heads (not n_heads) — the memory win."""
+    p = tfm.init_params(jax.random.key(8), MQA_CFG)
+    # Trace generate and grab the cache shape via the prefill pad shapes:
+    # cheaper to just check the projection shapes feeding the cache.
+    h = jnp.zeros((2, 3, MQA_CFG.d_model))
+    bp = jax.tree.map(lambda x: x[0], p["blocks"])
+    q, k, v = tfm._qkv_proj(bp, h, MQA_CFG)
+    assert q.shape == (2, 3, 4, 8)
+    assert k.shape == v.shape == (2, 3, 1, 8)
