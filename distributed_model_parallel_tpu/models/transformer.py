@@ -123,6 +123,9 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         "ln2_bias": jnp.zeros((L, d), dt),
     }
     if cfg.gqa:
+        if not (1 <= cfg.kv_heads <= cfg.n_heads):
+            raise ValueError(f"n_kv_heads={cfg.kv_heads} must be in "
+                             f"[1, n_heads={cfg.n_heads}]")
         if cfg.n_heads % cfg.kv_heads:
             raise ValueError(f"n_kv_heads={cfg.kv_heads} must divide "
                              f"n_heads={cfg.n_heads}")

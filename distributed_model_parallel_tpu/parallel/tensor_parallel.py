@@ -17,7 +17,7 @@ from jax.sharding import PartitionSpec as P
 def kv_heads_shardable(cfg, spec) -> bool:
     """Whether wkv's head dim can shard over the tensor-parallel axis.
 
-    True when kv heads divide the tp ways (shard), False for multi-query
+    True when the tp ways divide the kv head count (shard), False for multi-query
     (replicate — each query shard pairs every local q head with the single
     kv head, which is the only replicated layout where the local
     ``_repeat_kv`` head mapping equals the global one). Anything else has

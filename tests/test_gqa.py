@@ -37,6 +37,10 @@ def test_param_shapes_and_validation(params):
     with pytest.raises(ValueError, match="divide"):
         tfm.init_params(jax.random.key(0),
                         dataclasses.replace(CFG, n_kv_heads=3))
+    for bad in (0, -2, 8):
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            tfm.init_params(jax.random.key(0),
+                            dataclasses.replace(CFG, n_kv_heads=bad))
 
 
 def test_gqa_forward_and_grads(params):
