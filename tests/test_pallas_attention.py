@@ -89,3 +89,70 @@ def test_flash_ragged_seq_pads_causally():
     out = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("bwd_impl", ["flash", "xla"])
+def test_flash_bwd_impls_match_full(causal, bwd_impl):
+    """Both backward implementations — the FlashAttention-2 pallas kernels
+    (default) and the XLA-recompute escape hatch — match differentiating
+    the reference formulation, with a non-symmetric cotangent."""
+    q, k, v = _qkv(5, t=64)
+    w = jax.random.normal(jax.random.key(9), (2, 64, 2, 16))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       bwd_impl=bwd_impl) * w)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bwd_ragged_seq_and_uneven_blocks():
+    """Kernel backward through the causal end-padding path (T=100) and a
+    block size that doesn't divide T (clamped): padded rows/keys must
+    contribute exactly zero gradient."""
+    q, k, v = _qkv(6, t=100)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=64, block_k=32) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bwd_bfloat16_finite_and_close():
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(7, t=64))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        full_attention(q, k, v, causal=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gf):
+        assert np.isfinite(np.asarray(a, np.float32)).all()
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=0.1, atol=0.1)
+
+
+def test_flash_bwd_impl_validated():
+    q, k, v = _qkv(8, t=32)
+    with pytest.raises(ValueError, match="bwd_impl"):
+        flash_attention(q, k, v, bwd_impl="cuda")
