@@ -187,15 +187,21 @@ def _plan(t, d, causal, block_q, block_k, interpret):
             t_pad = -(-t // 128) * 128
 
     def clamp(block: int) -> int:
-        # Largest block <= requested that divides t (halving preserves the
-        # power-of-two shape the kernel tiles well with; bottoms out at 1).
+        if not interpret:
+            # On real TPUs the lse/delta tiles put the block on the lane
+            # dim, so blocks must be multiples of 128 AND divide t_pad
+            # (grid/loop counts floor silently otherwise). t_pad is a
+            # multiple of 128 here, so search divisors in 128-lane units.
+            m_units = t_pad // 128
+            d_units = max(1, min(block // 128, m_units))
+            while m_units % d_units:
+                d_units -= 1
+            return 128 * d_units
+        # Interpret mode (tests): largest block <= requested that divides
+        # t (halving preserves the power-of-two shape; bottoms out at 1).
         blk = min(block, t_pad)
         while t_pad % blk:
             blk //= 2
-        if not interpret:
-            # On real TPUs the lse/delta tiles put the block on the lane
-            # dim, so blocks must be multiples of 128; t_pad already is.
-            blk = max(128, blk // 128 * 128)
         return blk
 
     d_pad = max(128, d) if not interpret else d
@@ -323,6 +329,10 @@ def _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
     o, lse = _flash_impl(q, k, v, causal, block_q, block_k, interpret)
+    if bwd_impl == "xla":
+        # The XLA-recompute backward reads only (q, k, v); don't hold the
+        # output and lse in residual HBM for nothing.
+        return o, (q, k, v, None, None)
     return o, (q, k, v, o, lse)
 
 

@@ -71,8 +71,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         m_acc, l_acc, o_acc, k_t, v_t = carry
         src = (idx - t) % n                             # origin of this block
         k_pos = src * t_local + jnp.arange(t_local)
-        m_b, l_b, o_b = _block_attn(q, k_t, v_t, scale=scale, q_pos=q_pos,
-                                    k_pos=k_pos, causal=causal)
+
+        def compute():
+            return _block_attn(q, k_t, v_t, scale=scale, q_pos=q_pos,
+                               k_pos=k_pos, causal=causal)
+
+        if causal:
+            # Blocks entirely above the diagonal (src > idx) are fully
+            # masked; skip their score matmuls at runtime. The (0, 0, 0)
+            # stand-in is exactly what _block_attn returns for a fully
+            # masked block (m_safe=0, l=0, o=0), so the merge below is
+            # bit-identical — this halves the average per-hop compute,
+            # the ring analog of the flash kernel's diagonal block skip.
+            m_b, l_b, o_b = jax.lax.cond(
+                src <= idx, compute,
+                lambda: (jnp.zeros_like(m_acc), jnp.zeros_like(l_acc),
+                         jnp.zeros_like(o_acc)))
+        else:
+            m_b, l_b, o_b = compute()
         m_new = jnp.maximum(m_acc, m_b)
         # Rescale old and new contributions onto the common max.
         a = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - m_new), 0.0)
