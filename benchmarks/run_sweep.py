@@ -40,6 +40,11 @@ def parse_args():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--skip-attention", action="store_true")
     p.add_argument("--skip-batch", action="store_true")
+    p.add_argument("--grad", action="store_true",
+                   help="attention sweep times fwd+bwd (training step "
+                        "shape) instead of forward only; compares the "
+                        "FlashAttention-2 backward kernels against the "
+                        "XLA-recompute backward (bwd_impl='xla')")
     return p.parse_args()
 
 
@@ -111,6 +116,18 @@ def attention_sweep(args, results):
         if on_tpu:
             impls["flash_pallas"] = (
                 lambda q, k, v: flash_attention(q, k, v, causal=True))
+            if args.grad:
+                impls["flash_pallas_xla_bwd"] = (
+                    lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                    bwd_impl="xla"))
+        if args.grad:
+            def as_grad(f):
+                def grad_fn(q, k, v):
+                    def loss(q, k, v):
+                        return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+                    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                return grad_fn
+            impls = {name: as_grad(f) for name, f in impls.items()}
         for impl_name, fn in impls.items():
             # In-scan timing: attention runs fused inside larger programs in
             # real use, so kernel time (not per-program dispatch) is the
@@ -121,14 +138,17 @@ def attention_sweep(args, results):
                 # e.g. XLA fails to compile the materialized T^2 scores at
                 # long seq — record the failure, keep sweeping.
                 row = {"sweep": "attention", "impl": impl_name,
-                       "seq_len": seq, "failed": type(e).__name__}
+                       "seq_len": seq, "grad": bool(args.grad),
+                       "failed": type(e).__name__}
                 results.append(row)
                 print(json.dumps(row), flush=True)
                 continue
-            # causal: ~half the FLOPs of full attention
+            # causal: ~half the FLOPs of full attention; bwd ~2.5x fwd
             flops = 2 * 2 * batch * heads * seq * seq * head_dim / 2
+            if args.grad:
+                flops *= 3.5
             row = {"sweep": "attention", "impl": impl_name, "seq_len": seq,
-                   "time_s": round(dt, 5),
+                   "grad": bool(args.grad), "time_s": round(dt, 5),
                    "tflops": round(flops / dt / 1e12, 2)}
             results.append(row)
             print(json.dumps(row), flush=True)
