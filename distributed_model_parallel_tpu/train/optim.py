@@ -76,6 +76,14 @@ def make_optimizer(config: OptimizerConfig, steps_per_epoch: int,
     elif config.name == "lamb":
         parts.append(optax.lamb(learning_rate=schedule,
                                 weight_decay=config.weight_decay))
+    elif config.name == "adafactor":
+        # Sub-linear optimizer memory (factored second moments) — pairs
+        # with FSDP/ZeRO for the largest-model regime.
+        parts.append(optax.adafactor(learning_rate=schedule,
+                                     weight_decay_rate=config.weight_decay
+                                     or None))
+    elif config.name == "adam":
+        parts.append(optax.adam(learning_rate=schedule))
     elif config.name == "lars":
         parts.append(optax.lars(learning_rate=schedule,
                                 weight_decay=config.weight_decay,
@@ -83,7 +91,8 @@ def make_optimizer(config: OptimizerConfig, steps_per_epoch: int,
                                 nesterov=config.nesterov))
     else:
         raise KeyError(
-            f"unknown optimizer {config.name!r}; known: sgd, adamw, lamb, lars")
+            f"unknown optimizer {config.name!r}; known: sgd, adam, adamw, "
+            f"adafactor, lamb, lars")
     tx = optax.chain(*parts)
     if config.accum_steps > 1:
         # Running-mean gradient accumulation: the inner transform (and so the

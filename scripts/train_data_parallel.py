@@ -50,8 +50,10 @@ def parse_args():
     p.add_argument("--steps-per-dispatch", default=1, type=int,
                    help="train steps per jitted program with --device-data")
     p.add_argument("--optimizer", default="sgd",
-                   choices=["sgd", "adamw", "lamb", "lars"],
-                   help="lars/lamb: layerwise-adaptive large-batch training")
+                   choices=["sgd", "adam", "adamw", "adafactor", "lamb",
+                            "lars"],
+                   help="lars/lamb: layerwise-adaptive large-batch training; "
+                        "adafactor: sub-linear optimizer memory")
     p.add_argument("--momentum", default=0.9, type=float)
     p.add_argument("--wd", default=1e-4, type=float)
     p.add_argument("--epochs", default=100, type=int)

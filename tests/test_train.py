@@ -144,7 +144,8 @@ def test_prefetch_matches_synchronous(tmp_path):
         assert a["acc1_val"] == pytest.approx(b["acc1_val"])
 
 
-@pytest.mark.parametrize("name", ["sgd", "adamw", "lamb", "lars"])
+@pytest.mark.parametrize("name", ["sgd", "adam", "adamw", "adafactor",
+                                  "lamb", "lars"])
 def test_optimizer_family_minimizes_quadratic(name):
     """Every factory optimizer takes steps that reduce a simple loss."""
     import optax
