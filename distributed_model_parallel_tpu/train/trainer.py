@@ -360,9 +360,14 @@ class Trainer:
             try:
                 restored = self.ckpt.restore({**tmpl, "state": layout}, name)
                 break
-            except (ValueError, KeyError, TypeError):
+            except (ValueError, KeyError, TypeError) as e:
                 if i == len(layouts) - 1:
-                    raise
+                    raise ValueError(
+                        f"checkpoint {name!r} does not match the current "
+                        f"configuration's train-state structure — resuming "
+                        f"requires the same model and optimizer as the "
+                        f"saving run (only the EMA setting may toggle)"
+                    ) from e
         rs = restored["state"]
         want_ema = self.config.optimizer.ema_decay is not None
         if want_ema:
