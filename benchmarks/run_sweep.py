@@ -40,6 +40,9 @@ def parse_args():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--skip-attention", action="store_true")
     p.add_argument("--skip-batch", action="store_true")
+    p.add_argument("--window", type=int, default=None,
+                   help="attention sweep: sliding-window width for the "
+                        "flash impl (reproduces the banded-compute numbers)")
     p.add_argument("--grad", action="store_true",
                    help="attention sweep times fwd+bwd (training step "
                         "shape) instead of forward only; compares the "
@@ -115,7 +118,8 @@ def attention_sweep(args, results):
         impls = {"xla": xla_attn}
         if on_tpu:
             impls["flash_pallas"] = (
-                lambda q, k, v: flash_attention(q, k, v, causal=True))
+                lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                window=args.window))
             if args.grad:
                 impls["flash_pallas_xla_bwd"] = (
                     lambda q, k, v: flash_attention(q, k, v, causal=True,
@@ -150,6 +154,12 @@ def attention_sweep(args, results):
             row = {"sweep": "attention", "impl": impl_name, "seq_len": seq,
                    "grad": bool(args.grad), "time_s": round(dt, 5),
                    "tflops": round(flops / dt / 1e12, 2)}
+            if args.window is not None and impl_name == "flash_pallas":
+                # Only this impl receives the window (the xla paths have no
+                # banded formulation). FLOPs model above assumes the full
+                # causal triangle; banded rows report time only.
+                row["window"] = args.window
+                row.pop("tflops")
             results.append(row)
             print(json.dumps(row), flush=True)
     if not on_tpu:
@@ -160,6 +170,8 @@ def attention_sweep(args, results):
 
 def main():
     args = parse_args()
+    if args.window is not None and args.window < 1:
+        sys.exit("--window must be >= 1")
     if args.platform == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")

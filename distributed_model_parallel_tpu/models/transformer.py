@@ -56,6 +56,11 @@ class TransformerConfig:
     # v5e (1.0/3.2/10.9 ms at seq 2k/4k/8k, B4 H8 D64 bf16 — ~88 TFLOPS at
     # 8k). "xla" / "flash" force one implementation.
     attn_impl: str = "auto"
+    # Sliding-window (local) attention: each token attends the last W
+    # positions. Runs on the flash kernels' banded block-skipping (compute
+    # O(T*W) both directions); requires attn_impl="flash" and no
+    # sequence-parallel axis. Training-path feature; generation rejects it.
+    attn_window: int | None = None
     remat: bool = False            # jax.checkpoint each block: recompute
                                    # activations in backward (HBM for FLOPs —
                                    # the long-context memory lever)
@@ -229,6 +234,9 @@ def _repeat_kv(x: jax.Array, q: jax.Array) -> jax.Array:
 
 def _attention(q, k, v, cfg: TransformerConfig):
     if cfg.sp_axis is not None:
+        if cfg.attn_window is not None:
+            raise ValueError(
+                "attn_window is not supported with sequence parallelism")
         if cfg.sp_impl == "ring":
             return ring_attention(q, k, v, cfg.sp_axis, causal=True)
         from distributed_model_parallel_tpu.ops.ring_attention import (
@@ -239,6 +247,14 @@ def _attention(q, k, v, cfg: TransformerConfig):
         flash_attention,
         should_use_flash,
     )
+    if cfg.attn_window is not None:
+        # Banded compute lives in the flash kernels (both directions);
+        # there is no windowed XLA fallback, so the knob forces flash.
+        if cfg.attn_impl != "flash":
+            raise ValueError(
+                "attn_window requires attn_impl='flash' (the banded "
+                "block-skipping lives in the pallas kernels)")
+        return flash_attention(q, k, v, causal=True, window=cfg.attn_window)
     if should_use_flash(q.shape[1], causal=True, impl=cfg.attn_impl):
         return flash_attention(q, k, v, causal=True)
     return full_attention(q, k, v, causal=True)
@@ -445,6 +461,11 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     if total > cfg.max_seq_len:
         raise ValueError(f"prompt + steps = {total} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
+    if cfg.attn_window is not None:
+        raise ValueError(
+            "generation with sliding-window attention is not supported yet "
+            "(the KV-cache decode path attends the full prefix); train with "
+            "attn_window and evaluate via apply(), or decode without it")
     if (top_k is not None or top_p is not None) and temperature <= 0:
         raise ValueError("top_k/top_p filter the sampling distribution; "
                          "set temperature > 0 (greedy ignores them)")

@@ -28,14 +28,34 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _band_keep(q_pos, k_pos, window):
+    """Causal (and optionally banded) keep-mask — the single definition all
+    three kernels share so forward and backward masking cannot diverge."""
+    keep = k_pos <= q_pos
+    if window is not None:
+        keep = jnp.logical_and(keep, k_pos > q_pos - window)
+    return keep
+
+
+def _band_start_k(qi, bq, window, block_k):
+    """First K block intersecting any band in q block qi (0 if unwindowed)."""
+    if window is None:
+        return 0
+    return jnp.maximum(0, (qi * bq - window + 1) // block_k)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  seq_len: int, causal: bool, scale: float):
+                  seq_len: int, causal: bool, scale: float,
+                  window: int | None = None):
     """Grid: (batch*heads, num_q_blocks). Blocks: q/o [1, BQ, D]; k/v [1, T, D];
-    lse [1, BQ] (per-row logsumexp of the scaled scores, for the backward)."""
+    lse [1, BQ] (per-row logsumexp of the scaled scores, for the backward).
+    ``window`` (causal only): each query attends keys in
+    (q_pos - window, q_pos] — sliding-window/local attention, with K blocks
+    entirely outside the band skipped."""
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -53,12 +73,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         k = k_ref[0, pl.dslice(j * block_k, block_k), :]   # [BK, D]
         v = v_ref[0, pl.dslice(j * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
+        keep = None
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            keep = _band_keep(q_pos, k_pos, window)
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
+        if causal and window is not None:
+            # A row whose every key in this block is banded out while m is
+            # still at the sentinel would get exp(NEG_INF - NEG_INF) = 1;
+            # zero masked entries explicitly. Unreachable without a window
+            # (the first processed block always holds each row's diagonal),
+            # so the unwindowed hot path pays nothing.
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = alpha[:, None] * acc + jnp.dot(
@@ -67,9 +96,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     if causal:
         # Skip K blocks entirely above the diagonal: the last contributing
-        # block covers query position (qi+1)*bq - 1.
+        # block covers query position (qi+1)*bq - 1. A window also skips
+        # blocks entirely left of the band.
         num_k_eff = ((qi + 1) * bq - 1) // block_k + 1
-        m, l, acc = jax.lax.fori_loop(0, num_k_eff, body, (m0, l0, acc0))
+        start_k = _band_start_k(qi, bq, window, block_k)
+        m, l, acc = jax.lax.fori_loop(start_k, num_k_eff, body,
+                                      (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
 
@@ -88,7 +120,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, seq_len: int, causal: bool,
-                         scale: float):
+                         scale: float, window: int | None = None):
     """Grid: (batch*heads, num_q_blocks). dq_i = scale * sum_j ds_ij k_j with
     ds = p * (dO·v^T - delta); delta = rowsum(dO * O)."""
     qi = pl.program_id(1)
@@ -109,7 +141,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            p = jnp.where(k_pos <= q_pos, p, 0.0)
+            p = jnp.where(_band_keep(q_pos, k_pos, window), p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -117,7 +149,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_k = seq_len // block_k
     if causal:
         num_k_eff = ((qi + 1) * bq - 1) // block_k + 1
-        acc = jax.lax.fori_loop(0, num_k_eff, body, acc0)
+        start_k = _band_start_k(qi, bq, window, block_k)
+        acc = jax.lax.fori_loop(start_k, num_k_eff, body, acc0)
     else:
         acc = jax.lax.fori_loop(0, num_k, body, acc0)
     dq_ref[0] = acc.astype(dq_ref.dtype)
@@ -125,10 +158,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, seq_len: int,
-                          causal: bool, scale: float):
+                          causal: bool, scale: float,
+                          window: int | None = None):
     """Grid: (batch*heads, num_k_blocks). dv_j = sum_i p_ij dO_i;
     dk_j = scale * sum_i ds_ij q_i. Causal skips query blocks strictly above
-    the diagonal (queries before this key block attend none of it)."""
+    the diagonal (queries before this key block attend none of it); a
+    window also skips query blocks past the band's lower edge."""
     ki = pl.program_id(1)
     bk = k_ref.shape[1]
     k = k_ref[0]                                           # [BK, D] (input
@@ -150,7 +185,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
-            p = jnp.where(k_pos <= q_pos, p, 0.0)
+            p = jnp.where(_band_keep(q_pos, k_pos, window), p, 0.0)
         pc = p.astype(do.dtype)
         dv = dv + jnp.dot(pc.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -162,7 +197,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # First query block intersecting the diagonal for this key block.
         start_q = (ki * bk) // block_q
-        dk, dv = jax.lax.fori_loop(start_q, num_q, body, (dk0, dv0))
+        if window is None:
+            end_q = num_q
+        else:
+            # Last query that can see any key in this block attends the
+            # block's last key ((ki+1)*bk - 1) from window - 1 positions
+            # later.
+            end_q = jnp.minimum(
+                num_q, ((ki + 1) * bk - 1 + window - 1) // block_q + 1)
+        dk, dv = jax.lax.fori_loop(start_q, end_q, body, (dk0, dv0))
     else:
         dk, dv = jax.lax.fori_loop(0, num_q, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
@@ -223,7 +266,7 @@ def _unpad_bthd(x, b, h, t, d):
     return x[:, :t, :, :d]
 
 
-def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_impl(q, k, v, causal, block_q, block_k, interpret, window=None):
     """Run the forward kernel; returns (o [B,T,H,D], lse [B*H, T_pad] f32)
     — lse stays in the padded flat layout for the backward (which re-tiles
     it to 8 sublanes alongside delta)."""
@@ -233,7 +276,7 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
     scale = d ** -0.5
     qf, kf, vf = (_pad_bhtd(x, t_pad, d_pad) for x in (q, k, v))
     kernel = functools.partial(_flash_kernel, block_k=bk, seq_len=t_pad,
-                               causal=causal, scale=scale)
+                               causal=causal, scale=scale, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t_pad // bq),
@@ -257,7 +300,8 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
     return _unpad_bthd(o, b, h, t, d), lse[:, 0, :]
 
 
-def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+                    window=None):
     """Pallas backward: dq/dk/dv with [T, T] never in HBM."""
     b, t, h, d = q.shape
     t_pad, d_pad, bq, bk, interp = _plan(t, d, causal, block_q, block_k,
@@ -274,7 +318,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     lse = jnp.broadcast_to(lse[:, None, :], (b * h, 8, t_pad))
     qf, kf, vf, gf = (_pad_bhtd(x, t_pad, d_pad) for x in (q, k, v, g))
 
-    common = dict(seq_len=t_pad, causal=causal, scale=scale)
+    common = dict(seq_len=t_pad, causal=causal, scale=scale, window=window)
     row_spec = pl.BlockSpec((1, t_pad, d_pad), lambda bh, i: (bh, 0, 0))
     vec_spec = pl.BlockSpec((1, 8, t_pad), lambda bh, i: (bh, 0, 0))
 
@@ -322,13 +366,14 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
 # public differentiable entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
-    return _flash_impl(q, k, v, causal, block_q, block_k, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window):
+    return _flash_impl(q, k, v, causal, block_q, block_k, interpret,
+                       window)[0]
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
-    o, lse = _flash_impl(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window):
+    o, lse = _flash_impl(q, k, v, causal, block_q, block_k, interpret, window)
     if bwd_impl == "xla":
         # The XLA-recompute backward reads only (q, k, v); don't hold the
         # output and lse in residual HBM for nothing.
@@ -336,7 +381,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, window, res, g):
     """Backward dispatch: the pallas FlashAttention-2 kernels by default
     (no [T, T] in HBM), or the XLA recompute formulation (``bwd_impl="xla"``,
     materializes scores — the pre-kernel behavior, kept as an escape hatch).
@@ -351,7 +396,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, res, g):
             lambda q, k, v: full_attention(q, k, v, causal=causal), q, k, v)
         return vjp(g)
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret)
+                           interpret, window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -378,7 +423,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, block_q: int = 512,
                     block_k: int = 1024,
                     interpret: bool | None = None,
-                    bwd_impl: str = "flash") -> jax.Array:
+                    bwd_impl: str = "flash",
+                    window: int | None = None) -> jax.Array:
     """[B, T, H, D] -> [B, T, H, D] causal attention, pallas-blocked.
 
     ``interpret=None`` auto-selects interpret mode off-TPU. Default block
@@ -388,11 +434,24 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     attention from seq ~2048 up, and still compiles at seq 8192 where the
     materialized T^2 score tensor makes XLA fail.
 
+    ``window=W`` (causal only) restricts each query to the last W keys —
+    sliding-window/local attention. Both directions skip blocks entirely
+    outside the band, so compute drops from O(T^2) toward O(T*W).
+
     Differentiable via a custom VJP: the FlashAttention-2 backward kernels
     recompute score tiles from the saved logsumexp, so neither direction
     puts [T, T] in HBM; ``bwd_impl="xla"`` selects the old
-    recompute-with-XLA backward instead.
+    recompute-with-XLA backward instead (full/causal only — it has no
+    windowed reference formulation).
     """
     if bwd_impl not in ("flash", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}; known: flash, xla")
-    return _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl)
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if bwd_impl == "xla":
+            raise ValueError("window is only supported with bwd_impl='flash'")
+    return _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl,
+                  window)

@@ -40,6 +40,9 @@ def parse_args():
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings instead of a learned "
                         "table (relative positions; extrapolates)")
+    p.add_argument("--attn-window", type=int, default=None,
+                   help="sliding-window attention width (flash kernels, "
+                        "O(T*W) compute); incompatible with --sp")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="grouped-query attention: k/v head count (must "
                         "divide --heads; 1 = multi-query). Shrinks the "
@@ -69,6 +72,8 @@ def main():
     if args.moe_experts and not (1 <= args.moe_top_k <= args.moe_experts):
         raise SystemExit(
             f"--moe-top-k must be in [1, --moe-experts={args.moe_experts}]")
+    if args.attn_window is not None and args.attn_window < 1:
+        raise SystemExit("--attn-window must be >= 1")
     config = LMTrainConfig(
         model=TransformerConfig(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.heads,
@@ -79,7 +84,9 @@ def main():
             moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
             ep_axis="expert" if args.ep > 1 else None,
             pos_embedding="rope" if args.rope else "learned",
-            n_kv_heads=args.kv_heads),
+            n_kv_heads=args.kv_heads,
+            attn_window=args.attn_window,
+            attn_impl="flash" if args.attn_window is not None else "auto"),
         mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
                         seq=args.sp, expert=args.ep),
         optimizer=OptimizerConfig(learning_rate=args.lr, weight_decay=0.0,
