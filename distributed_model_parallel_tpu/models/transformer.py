@@ -57,9 +57,10 @@ class TransformerConfig:
     # 8k). "xla" / "flash" force one implementation.
     attn_impl: str = "auto"
     # Sliding-window (local) attention: each token attends the last W
-    # positions. Runs on the flash kernels' banded block-skipping (compute
-    # O(T*W) both directions); requires attn_impl="flash" and no
-    # sequence-parallel axis. Training-path feature; generation rejects it.
+    # positions. Training runs on the flash kernels' banded block-skipping
+    # (compute O(T*W) both directions; requires attn_impl="flash", no
+    # sequence-parallel axis); generation band-masks the prefill and the
+    # KV-cache scores with the same (pos-W, pos] band.
     attn_window: int | None = None
     remat: bool = False            # jax.checkpoint each block: recompute
                                    # activations in backward (HBM for FLOPs —
@@ -84,6 +85,11 @@ class TransformerConfig:
     # wqkv); 1 = multi-query. The KV cache shrinks by n_heads/n_kv_heads —
     # the long-context decode memory lever.
     n_kv_heads: int | None = None
+
+    def __post_init__(self):
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(
+                f"attn_window must be >= 1, got {self.attn_window}")
 
     @property
     def head_dim(self) -> int:
@@ -405,8 +411,12 @@ def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
     hkv = kc.shape[2]
     qg = q.reshape(b, 1, hkv, q.shape[2] // hkv, cfg.head_dim)
     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc) * (cfg.head_dim ** -0.5)
-    mask = jnp.arange(total)[None, None, None, None, :] <= pos
-    s = jnp.where(mask, s, -jnp.inf)
+    # Same (pos - W, pos] band predicate as the training kernels
+    # (ops/pallas_attention.band_keep; pure causal when attn_window=None).
+    from distributed_model_parallel_tpu.ops.pallas_attention import band_keep
+
+    keep = band_keep(pos, jnp.arange(total), cfg.attn_window)
+    s = jnp.where(keep[None, None, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
     o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vc)         # [B,1,Hkv,G,Dh]
     x = x + o.reshape(b, 1, -1) @ bp["wo"]
@@ -461,11 +471,6 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     if total > cfg.max_seq_len:
         raise ValueError(f"prompt + steps = {total} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
-    if cfg.attn_window is not None:
-        raise ValueError(
-            "generation with sliding-window attention is not supported yet "
-            "(the KV-cache decode path attends the full prefix); train with "
-            "attn_window and evaluate via apply(), or decode without it")
     if (top_k is not None or top_p is not None) and temperature <= 0:
         raise ValueError("top_k/top_p filter the sampling distribution; "
                          "set temperature > 0 (greedy ignores them)")
@@ -498,7 +503,23 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
         # Cache the Hkv-head k/v; attention itself runs on broadcast heads.
-        o = full_attention(q, _repeat_kv(k, q), _repeat_kv(v, q), causal=True)
+        kr, vr = _repeat_kv(k, q), _repeat_kv(v, q)
+        if cfg.attn_window is None:
+            o = full_attention(q, kr, vr, causal=True)
+        else:
+            # Banded prefill: the shared band predicate keeps this, the
+            # cached decode, and the training kernels on one definition.
+            # Prompts are short, so the explicit mask is fine here.
+            from distributed_model_parallel_tpu.ops.pallas_attention import (
+                band_keep,
+            )
+
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * (cfg.head_dim ** -0.5)
+            posa = jnp.arange(t0)
+            keep = band_keep(posa[:, None], posa[None, :], cfg.attn_window)
+            s = jnp.where(keep[None, None], s, -jnp.inf)
+            o = jnp.einsum("bhqk,bkhd->bqhd",
+                           jax.nn.softmax(s, axis=-1).astype(q.dtype), vr)
         x = x + o.reshape(b, t0, -1) @ bp["wo"]
         h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
         h, _ = _ffn(bp, h, cfg, tp_axis=None, ep_axis=None)

@@ -28,7 +28,7 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _band_keep(q_pos, k_pos, window):
+def band_keep(q_pos, k_pos, window):
     """Causal (and optionally banded) keep-mask — the single definition all
     three kernels share so forward and backward masking cannot diverge."""
     keep = k_pos <= q_pos
@@ -77,7 +77,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            keep = _band_keep(q_pos, k_pos, window)
+            keep = band_keep(q_pos, k_pos, window)
             s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -141,7 +141,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            p = jnp.where(_band_keep(q_pos, k_pos, window), p, 0.0)
+            p = jnp.where(band_keep(q_pos, k_pos, window), p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -185,7 +185,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
-            p = jnp.where(_band_keep(q_pos, k_pos, window), p, 0.0)
+            p = jnp.where(band_keep(q_pos, k_pos, window), p, 0.0)
         pc = p.astype(do.dtype)
         dv = dv + jnp.dot(pc.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)

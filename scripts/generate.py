@@ -35,6 +35,8 @@ def parse_args():
     p.add_argument("--kv-heads", type=int, default=None,
                    help="grouped-query k/v heads; must match the training "
                         "run")
+    p.add_argument("--attn-window", type=int, default=None,
+                   help="sliding-window width; must match the training run")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="experts per block; must match the training run")
     p.add_argument("--moe-top-k", type=int, default=2,
@@ -72,7 +74,9 @@ def main():
         max_seq_len=max(args.max_seq_len, 128),
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
         pos_embedding="rope" if args.rope else "learned",
-        n_kv_heads=args.kv_heads)
+        n_kv_heads=args.kv_heads,
+        attn_window=args.attn_window,
+        attn_impl="flash" if args.attn_window is not None else "auto")
     params = tfm.init_params(jax.random.key(args.seed), cfg)
 
     ckpt = Checkpointer(args.checkpoint_dir)
