@@ -35,6 +35,7 @@ The single-program SPMD pipeline (``shard_map`` + ``ppermute`` over a
 from __future__ import annotations
 
 import dataclasses
+from collections.abc import Mapping
 from functools import partial
 from typing import Any, Callable, Sequence
 
@@ -58,6 +59,52 @@ class StageState:
     opt_state: Any
 
 
+def merge_microbatch_bn_states(micro_states, *, momentum: float):
+    """Pool per-microbatch BN state updates into the single update an
+    equivalent big-batch forward would have produced.
+
+    Every microbatch forward observes the *same* pre-step running stats
+    ``o`` and yields ``new_m = mu*o + (1-mu)*stat_m`` (flax BatchNorm EMA).
+    The big-batch update is ``mu*o + (1-mu)*stat_big`` where ``stat_big``
+    pools the microbatch moments: means average, and variances pick up the
+    between-microbatch spread (law of total variance, equal-sized
+    microbatches). Both pooled leaves follow from the EMA'd states alone —
+    no access to the raw batch moments needed:
+
+        merged_mean = avg_m(new_mean_m)
+        merged_var  = avg_m(new_var_m) + Var_m(new_mean_m) / (1 - mu)
+
+    (``Var_m(new_mean_m) = (1-mu)^2 Var_m(mean_m)`` and the pooled variance
+    needs ``(1-mu) * Var_m(mean_m)`` more than the plain average.) Leaves
+    not part of a mean/var pair are averaged. ``momentum == 1`` freezes the
+    stats: every new_m equals the old state, so the plain average is already
+    exact and the correction term (0/0) must be skipped.
+    """
+    one_minus = 1.0 - momentum
+
+    def rec(nodes):
+        n0 = nodes[0]
+        if isinstance(n0, Mapping):
+            out = {}
+            for k in n0:
+                if k == "var" and "mean" in n0:
+                    varz = jnp.stack([n["var"] for n in nodes])
+                    if one_minus == 0.0:
+                        out[k] = varz.mean(0)
+                        continue
+                    means = jnp.stack([n["mean"] for n in nodes])
+                    out[k] = varz.mean(0) + jnp.var(means, axis=0) / one_minus
+                else:
+                    out[k] = rec([n[k] for n in nodes])
+            return out if isinstance(n0, dict) else type(n0)(out)
+        if isinstance(n0, (tuple, list)):
+            return type(n0)(rec([n[i] for n in nodes])
+                            for i in range(len(n0)))
+        return jnp.stack(nodes).mean(0)
+
+    return rec(list(micro_states))
+
+
 class PipelineRunner:
     """Drives a StagedModel split across devices, one jitted program per
     stage, with the schedule expressed in (async-dispatched) Python."""
@@ -72,6 +119,7 @@ class PipelineRunner:
                  augment: bool = True,
                  schedule: str = "gpipe",
                  virtual_stages: int = 1,
+                 bn_momentum: float = 0.9,
                  dtype=jnp.float32):
         """``virtual_stages > 1`` gives the Megatron interleaved placement:
         the model splits into ``V*S`` chunks and device ``s`` owns chunks
@@ -90,6 +138,7 @@ class PipelineRunner:
         self.augment = augment
         self.schedule = schedule
         self.mean, self.std, self.dtype = mean, std, dtype
+        self.bn_momentum = bn_momentum
 
         params, model_state = model.init(rng, jnp.zeros(sample_shape, dtype))
         self.stages: list[StageState] = []
@@ -158,6 +207,8 @@ class PipelineRunner:
 
         self._apply = jax.jit(apply_updates)
         self._accum = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+        self._merge_states = jax.jit(partial(
+            merge_microbatch_bn_states, momentum=self.bn_momentum))
         self._prep = jax.jit(
             lambda rng, imgs: normalize(
                 augment_batch(rng, imgs) if self.augment else imgs,
@@ -184,7 +235,7 @@ class PipelineRunner:
         for c in range(C):
             x = self._to_stage(c, x)
             acts[m][c] = x
-            x, new_states[c] = self._fwd[c](
+            x, new_states[m][c] = self._fwd[c](
                 self.stages[c].params, self.stages[c].model_state, x, True)
         # logits -> stage 0 for the loss (last→0 hop, utils.py:56).
         loss, dlogits, mets = self._loss_grad(
@@ -232,7 +283,10 @@ class PipelineRunner:
         """One optimizer step over the global batch (all microbatches)."""
         C, M = self.num_chunks, self.num_microbatches
         grads: list[Any] = [None] * C
-        new_states: list[Any] = [None] * C
+        # Per-microbatch BN state updates, pooled after the schedule — a
+        # single [c]-indexed slot would keep only the last microbatch's
+        # statistics (a silent divergence from the big-batch run).
+        new_states: list[list[Any]] = [[None] * C for _ in range(M)]
 
         micro = self._split(jnp.asarray(images_u8), jnp.asarray(labels))
         acts: list[list[Any]] = [[None] * C for _ in range(M)]  # chunk inputs
@@ -254,8 +308,11 @@ class PipelineRunner:
                 dp = jax.tree.map(lambda x: x / M, dp)
             new_params, new_opt = self._apply(
                 self.stages[c].params, self.stages[c].opt_state, dp)
+            merged_state = (new_states[0][c] if M == 1 else
+                            self._merge_states([new_states[m][c]
+                                                for m in range(M)]))
             self.stages[c] = StageState(params=new_params,
-                                        model_state=new_states[c],
+                                        model_state=merged_state,
                                         opt_state=new_opt)
 
         # ---- host-side metric reduction over microbatches

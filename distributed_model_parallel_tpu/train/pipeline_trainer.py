@@ -85,7 +85,8 @@ class PipelineTrainer:
             num_microbatches=config.num_microbatches,
             augment=config.data.augment,
             schedule=config.pipeline_schedule,
-            virtual_stages=config.virtual_stages)
+            virtual_stages=config.virtual_stages,
+            bn_momentum=config.model.bn_momentum)
 
         from distributed_model_parallel_tpu.train.preemption import (
             PreemptionGuard,
