@@ -109,9 +109,18 @@ def main():
         fetch(jax.tree.leaves(out)[0])
         dt = max(1e-9, time.perf_counter() - t0 - t_fetch) / args.iters
         row = {"transport": name, "model": args.model, "dtype": args.dtype,
+               # All transports now flatten/reduce in the gradient's native
+               # dtype (collectives.py), so payload bytes are equal across
+               # rows — no upcast confound.
+               "wire_dtype": args.dtype,
                "devices": n, "dcn_data": args.dcn_data,
                "grad_bytes": nbytes, "allreduce_us": round(dt * 1e6, 1),
                "platform": jax.devices()[0].platform}
+        if row["platform"] == "cpu":
+            row["caveat"] = (
+                "virtual CPU mesh: collectives are shared-memory copies; "
+                "rows rank transports relatively, they are NOT ICI timings "
+                "or transport guidance for TPU hardware")
         print(json.dumps(row), flush=True)
         results.append(row)
 

@@ -435,15 +435,19 @@ def _filter_top_k(logits: jax.Array, k: int) -> jax.Array:
 def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
     """Nucleus filtering: keep the smallest set of tokens whose cumulative
     probability reaches p (always at least the top token). Static-shape:
-    sort, exclusive cumulative softmax mass, scatter the mask back."""
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    argsort, exclusive cumulative softmax mass, scatter the per-rank keep
+    mask back through the sort permutation — a value threshold would also
+    keep any token whose logit *ties* the last-kept one, letting duplicate
+    logits outside the nucleus leak into the sampling set."""
+    b, v = logits.shape
+    order = jnp.argsort(logits, axis=-1)[:, ::-1]        # descending ranks
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     # Exclusive cumsum: a token is kept if the mass *before* it is < p.
-    cum_before = jnp.cumsum(probs, axis=-1) - probs
-    keep_sorted = cum_before < p
-    kth_idx = jnp.sum(keep_sorted, axis=-1) - 1         # last kept rank
-    threshold = jnp.take_along_axis(sorted_logits, kth_idx[:, None], axis=-1)
-    return jnp.where(logits < threshold, -jnp.inf, logits)
+    keep_sorted = (jnp.cumsum(probs, axis=-1) - probs) < p
+    keep = jnp.zeros((b, v), bool).at[
+        jnp.arange(b)[:, None], order].set(keep_sorted)
+    return jnp.where(keep, logits, -jnp.inf)
 
 
 def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,

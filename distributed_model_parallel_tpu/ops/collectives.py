@@ -28,13 +28,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def flatten_padded(tree: Any, n_shards: int) -> jax.Array:
-    """Concatenate all leaves (as f32) into one flat vector padded to a
-    multiple of ``n_shards`` — the canonical pre-shape for contiguous
-    scatter/gather collectives. Shared by the ZeRO optimizer sharding
-    (parallel/zero.py) and the hierarchical allreduce below."""
+def flatten_padded(tree: Any, n_shards: int, dtype=jnp.float32) -> jax.Array:
+    """Concatenate all leaves (cast to ``dtype``, f32 by default) into one
+    flat vector padded to a multiple of ``n_shards`` — the canonical
+    pre-shape for contiguous scatter/gather collectives. Shared by the ZeRO
+    optimizer sharding (parallel/zero.py, which wants the f32 master copy)
+    and the hierarchical allreduce below (which passes the native gradient
+    dtype so the wire payload matches the per-leaf transports)."""
     flat = jnp.concatenate(
-        [l.astype(jnp.float32).reshape(-1) for l in jax.tree.leaves(tree)])
+        [l.astype(dtype).reshape(-1) for l in jax.tree.leaves(tree)])
     pad = (-flat.size) % n_shards
     return jnp.pad(flat, (0, pad))
 
@@ -116,6 +118,12 @@ def bucketed_psum(tree: Any, axis_name: str, *,
     ``reduce_fn(flat, axis_name) -> flat`` swaps the transport (default
     ``lax.psum``; see ``ops/ring_reduce.ring_psum_tree`` for the explicit
     ring).
+
+    Each bucket is flattened in its own *promoted leaf dtype* (bf16
+    gradients reduce as bf16, like torch DDP; a stray f32 leaf upcasts only
+    its own bucket) so the wire payload matches the per-leaf ``psum``
+    transport byte-for-byte — a global f32 upcast would move 2x the bytes
+    and confound transport comparisons.
     """
     if reduce_fn is None:
         reduce_fn = jax.lax.psum
@@ -123,8 +131,9 @@ def bucketed_psum(tree: Any, axis_name: str, *,
     n = jax.lax.psum(1, axis_name) if mean else 1
     out: list[Any] = [None] * len(leaves)
     for bucket in plan_buckets(tree, bucket_bytes):
+        wire_dtype = jnp.result_type(*(leaves[i] for i in bucket))
         flat = jnp.concatenate(
-            [leaves[i].astype(jnp.float32).reshape(-1) for i in bucket])
+            [leaves[i].astype(wire_dtype).reshape(-1) for i in bucket])
         red = reduce_fn(flat, axis_name)
         if mean:
             red = red / n
@@ -165,8 +174,10 @@ def hierarchical_psum_tree(tree: Any, inner_axis: str, outer_axis: str, *,
     vector (so the scatter is contiguous and every leaf shape is legal),
     two-level reduce, split back. Like ``hierarchical_psum`` (and
     ``lax.psum``) this sums by default; pass ``mean=True`` for DDP-style
-    gradient averaging."""
-    flat = flatten_padded(tree, jax.lax.axis_size(inner_axis))
+    gradient averaging. The flat vector uses the promoted leaf dtype, not
+    f32 — same wire-payload rule as ``bucketed_psum``."""
+    flat = flatten_padded(tree, jax.lax.axis_size(inner_axis),
+                          dtype=jnp.result_type(*jax.tree.leaves(tree)))
     red = hierarchical_psum(flat, inner_axis, outer_axis, mean=mean)
     return unflatten_like(red, tree)
 
@@ -179,5 +190,14 @@ def unused_param_mask(grads: Any) -> Any:
     parameters not on the loss path (no hang to avoid — there are no autograd
     hooks waiting), so "detection" reduces to reporting which leaves were
     untouched, useful for debugging partially-frozen models.
+
+    Caveat: this is a *value* test, not a graph-reachability test — a
+    parameter that IS on the loss path but happens to receive an exactly-zero
+    gradient at this step (e.g. behind a relu that is off for the whole
+    batch) is also flagged. Treat a True as "no gradient signal this step";
+    for a structural unused-parameter check, inspect the jaxpr of the loss
+    instead (a leaf is structurally unused iff the grad jaxpr pipes a
+    symbolic zero to it, which this debugging aid deliberately does not
+    compute — it would force a retrace per call).
     """
     return jax.tree.map(lambda g: jnp.all(g == 0), grads)

@@ -101,7 +101,8 @@ class LMTrainer:
         self.logger = RunLogger(config.log_dir, config.log_name)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.start_epoch = 0
-        if config.resume and self.ckpt.exists("lm"):
+        if config.resume and (self.ckpt.exists("lm")
+                              or self.ckpt.exists("lm-preempt")):
             self._resume()
 
     # ------------------------------------------------------------------ data
@@ -118,7 +119,11 @@ class LMTrainer:
                 "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
 
     def _resume(self):
-        restored = self.ckpt.restore(self._ckpt_tree(), "lm")
+        # Prefer whichever save is newest: the end-of-epoch "lm" slot or the
+        # dedicated "lm-preempt" slot — the partial-epoch preemption save
+        # must never supersede a full-epoch save under versioning.
+        name = self.ckpt.newest_name(("lm", "lm-preempt")) or "lm"
+        restored = self.ckpt.restore(self._ckpt_tree(), name)
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.start_epoch = int(restored["epoch"])
@@ -150,7 +155,7 @@ class LMTrainer:
 
                     self.start_epoch = epoch
                     checkpoint_on_preempt(self.preemption, self.ckpt,
-                                          self._ckpt_tree(), "lm",
+                                          self._ckpt_tree(), "lm-preempt",
                                           self.logger, epoch)
                     break
                 record = dict(epoch=epoch, loss_train=meter.avg,

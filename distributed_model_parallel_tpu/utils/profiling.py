@@ -91,8 +91,13 @@ def time_fn_in_scan(fn: Callable, *args, iters: int = 20) -> float:
         def run(first):
             def body(acc, _):
                 out = fn(first + acc.astype(first.dtype) * 0, *args[1:])
-                leaf = jax.tree.leaves(out)[0]
-                return acc + (jnp.sum(leaf) * 1e-20).astype(jnp.float32), ()
+                # Every output leaf must reach the carry — depending on just
+                # one would let XLA dead-code-eliminate the computation of
+                # the others (e.g. the dk/dv kernel of a multi-output
+                # backward), timing only part of the work.
+                dep = sum((jnp.sum(leaf) * 1e-20).astype(jnp.float32)
+                          for leaf in jax.tree.leaves(out))
+                return acc + dep, ()
 
             acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None,
                                   length=n)

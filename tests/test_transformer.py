@@ -372,6 +372,19 @@ def test_top_p_filter_keeps_nucleus():
     assert np.isfinite(out[0, 0]) and np.isneginf(out[0, 1:]).all()
 
 
+def test_top_p_filter_excludes_tied_logits_outside_nucleus():
+    # probs ~ [0.464, 0.171, 0.171, 0.171, 0.023]: exclusive mass passes p
+    # after two of the tied 3.0s (0 + 0.464 + 0.635 < 0.7 ≤ 0.806). A value
+    # threshold would keep the third tied token too (4 survivors); the
+    # scatter-through-argsort mask keeps exactly the minimal nucleus of 3.
+    logits = jnp.asarray([[4.0, 3.0, 3.0, 3.0, 1.0]])
+    out = np.asarray(tfm._filter_top_p(logits, 0.7))
+    assert int(np.isfinite(out).sum()) == 3
+    assert np.isfinite(out[0, 0])
+    assert int(np.isfinite(out[0, 1:4]).sum()) == 2   # one tied token dropped
+    assert np.isneginf(out[0, 4])
+
+
 def test_generate_top_k_restricts_tokens(params):
     """With top_k=1, sampling at any temperature degenerates to greedy."""
     prompt = jnp.zeros((2, 3), jnp.int32)
