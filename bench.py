@@ -1,15 +1,23 @@
 """Benchmark: MobileNetV2/CIFAR-10 train-step throughput per chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
 
 Baseline anchor (BASELINE.md): the reference's data-parallel MobileNetV2
 CIFAR-10 run at global batch 512 on 4 GPUs takes 0.396 s/batch
 (``Readme.md:286``) = 1292.9 samples/s total = **323.2 samples/s/GPU**.
 ``vs_baseline`` is our per-chip throughput divided by that per-GPU number.
+``mfu`` (model-FLOPs-utilization: XLA cost-analysis FLOPs per step / step
+time / chip peak bf16 FLOP/s) makes the efficiency claim absolute rather
+than relative to a 2019 GPU anchor; null off-TPU where peak is unknown.
 
 The timed region is the full jitted train step — on-device augmentation,
 forward, backward, SGD update — at batch 512 on however many chips are
 visible (per-chip = total / n_chips). bfloat16 compute, float32 params.
+
+Env knobs: DMP_BENCH_MODEL (mobilenetv2 | resnet50 | ...), DMP_BENCH_BATCH,
+DMP_BENCH_STEPS, DMP_BENCH_SPD, and DMP_BENCH_WORKLOAD=lm for the
+long-context Transformer train step (DMP_BENCH_SEQ, default 8192) measured
+in tokens/s/chip.
 """
 
 from __future__ import annotations
@@ -30,6 +38,71 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def bench_lm() -> None:
+    """Long-context Transformer train-step bench (tokens/s/chip + MFU).
+
+    The flagship long-context workload: flash-attention pallas kernels,
+    RoPE, causal LM loss, one full SPMD train step at DMP_BENCH_SEQ tokens
+    (default 8192 — the sequence length PARITY.md's kernel numbers quote).
+    """
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+    from distributed_model_parallel_tpu.utils.profiling import (
+        compiled_flops,
+        fetch,
+        fetch_overhead,
+        peak_flops_per_chip,
+    )
+
+    n_chips = len(jax.devices())
+    seq = int(os.environ.get("DMP_BENCH_SEQ", "8192"))
+    batch = int(os.environ.get("DMP_BENCH_BATCH", str(2 * n_chips)))
+    steps = max(4, int(os.environ.get("DMP_BENCH_STEPS", "16")))
+    cfg = LMTrainConfig(
+        model=tfm.TransformerConfig(
+            vocab_size=32_000, d_model=1024, n_heads=8, n_layers=8,
+            d_ff=4096, max_seq_len=seq, pos_embedding="rope",
+            remat=True, dtype=jnp.bfloat16),
+        batch_size=batch, seq_len=seq, n_tokens=4 * batch * (seq + 1),
+        log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",
+    )
+    t = LMTrainer(cfg)
+    toks, tgts = t.sample_batch()
+    toks, tgts = jnp.asarray(toks), jnp.asarray(tgts)
+    _log(f"lm bench: seq={seq} batch={batch} layers={cfg.model.n_layers} "
+         f"d_model={cfg.model.d_model}")
+
+    def step():
+        t.params, t.opt_state, loss = t._step(t.params, t.opt_state,
+                                              toks, tgts)
+        return loss
+
+    fetch(step())                       # compile + warm
+    t_fetch = fetch_overhead()
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = step()
+    fetch(loss)
+    dt = max(1e-9, time.perf_counter() - t0 - t_fetch) / steps
+
+    flops = compiled_flops(t._step, t.params, t.opt_state, toks, tgts)
+    peak = peak_flops_per_chip()
+    mfu = (round(flops / dt / (peak * n_chips), 4)
+           if flops and peak else None)
+    tokens_per_s_per_chip = batch * seq / dt / n_chips
+    print(json.dumps({
+        "metric": f"lm_seq{seq}_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,   # the reference has no LM workload to anchor on
+        "mfu": mfu,
+    }))
+
+
 def main() -> None:
     from distributed_model_parallel_tpu.config import (
         DataConfig,
@@ -39,6 +112,10 @@ def main() -> None:
         TrainConfig,
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    if os.environ.get("DMP_BENCH_WORKLOAD") == "lm":
+        bench_lm()
+        return
 
     t_start = time.perf_counter()
     _log(f"devices: {jax.devices()}")
@@ -127,11 +204,27 @@ def main() -> None:
     vs_baseline = (round(
         samples_per_sec_per_chip / BASELINE_SAMPLES_PER_SEC_PER_GPU, 3)
         if model_name == "mobilenetv2" and batch == 512 else None)
+    # MFU: cost-analysis FLOPs of one dispatched program (steps_per_dispatch
+    # full train steps) normalized to per-step, over the chip's peak.
+    from distributed_model_parallel_tpu.utils.profiling import (
+        compiled_flops,
+        peak_flops_per_chip,
+    )
+
+    rng, sub = jax.random.split(rng)
+    idx = jnp.asarray(idx_rng.integers(
+        0, n, (steps_per_dispatch, batch)).astype(np.int64))
+    flops = compiled_flops(trainer._multi_step, trainer.state, sub,
+                           trainer._dev_images, trainer._dev_labels, idx)
+    peak = peak_flops_per_chip()
+    mfu = (round(flops / steps_per_dispatch / dt / (peak * n_chips), 4)
+           if flops and peak else None)
     print(json.dumps({
         "metric": f"{model_name}_cifar10_bs{batch}_train_samples_per_sec_per_chip",
         "value": round(samples_per_sec_per_chip, 2),
         "unit": "samples/s/chip",
         "vs_baseline": vs_baseline,
+        "mfu": mfu,
     }))
 
 

@@ -27,6 +27,56 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Published bf16 peak matmul throughput per chip (FLOP/s), keyed by
+# device_kind prefix. Used to turn measured step time + XLA cost-analysis
+# FLOPs into model-FLOPs-utilization (MFU) — an absolute efficiency number,
+# unlike throughput ratios against a historical baseline.
+TPU_PEAK_FLOPS: dict[str, float] = {
+    "TPU v6": 918e12,        # v6e (Trillium)
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # bare "v5" = v5p
+    "TPU v4 lite": 137.5e12,  # v4i
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+}
+
+
+def match_device_kind(table: dict, device=None):
+    """Longest-prefix lookup of ``device.device_kind`` in ``table`` (so
+    "TPU v5 lite..." hits a "TPU v5 lite" row, not "TPU v5"). Shared by the
+    peak-FLOPs table here and the flash dispatch table
+    (ops/pallas_attention.py). Returns the value or None."""
+    device = device if device is not None else jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or ""
+    for prefix in sorted(table, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return table[prefix]
+    return None
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    """bf16 peak FLOP/s for ``device`` (default: devices()[0]); None when
+    unknown (e.g. CPU), in which case MFU cannot be reported honestly."""
+    return match_device_kind(TPU_PEAK_FLOPS, device)
+
+
+def compiled_flops(jitted: Callable, *args) -> float | None:
+    """Total FLOPs of the compiled program for ``jitted(*args)`` via XLA's
+    cost analysis (client-side on the HLO — no execution, no donation)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):    # older JAX: one dict per comp
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None
+
+
 @contextlib.contextmanager
 def trace(log_dir: str = "/tmp/dmp_trace"):
     """Capture an XLA/TPU profiler trace for the enclosed region."""
