@@ -48,6 +48,19 @@ def parse_args():
                         "shape) instead of forward only; compares the "
                         "FlashAttention-2 backward kernels against the "
                         "XLA-recompute backward (bwd_impl='xla')")
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"],
+                   help="attention sweep compute dtype (the flash-vs-XLA "
+                        "crossover is dtype-dependent; feeds the dispatch "
+                        "table in ops/pallas_attention.py)")
+    p.add_argument("--head-dim", type=int, default=64,
+                   help="attention sweep head dimension (dispatch-table "
+                        "axis)")
+    p.add_argument("--heads", type=int, default=8,
+                   help="attention sweep head count")
+    p.add_argument("--out", default="sweep_results.json",
+                   help="output JSON filename under benchmarks/ (e.g. "
+                        "dispatch_sweep.json for dispatch-table evidence)")
     return p.parse_args()
 
 
@@ -98,13 +111,14 @@ def attention_sweep(args, results):
     from distributed_model_parallel_tpu.utils.profiling import time_fn_in_scan
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    batch, heads, head_dim = 4, 8, 64
+    batch, heads, head_dim = 4, args.heads, args.head_dim
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     for seq in (int(s) for s in args.seq_lens.split(",")):
         # [B, T, H, D] — the layout flash_attention takes.
         q = jax.random.normal(jax.random.key(0), (batch, seq, heads, head_dim),
-                              jnp.bfloat16)
-        k = jax.random.normal(jax.random.key(1), q.shape, jnp.bfloat16)
-        v = jax.random.normal(jax.random.key(2), q.shape, jnp.bfloat16)
+                              dtype)
+        k = jax.random.normal(jax.random.key(1), q.shape, dtype)
+        v = jax.random.normal(jax.random.key(2), q.shape, dtype)
 
         def xla_attn(q, k, v):
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -142,7 +156,9 @@ def attention_sweep(args, results):
                 # e.g. XLA fails to compile the materialized T^2 scores at
                 # long seq — record the failure, keep sweeping.
                 row = {"sweep": "attention", "impl": impl_name,
-                       "seq_len": seq, "grad": bool(args.grad),
+                       "seq_len": seq, "dtype": args.dtype,
+                       "head_dim": head_dim, "heads": heads,
+                       "grad": bool(args.grad),
                        "failed": type(e).__name__}
                 results.append(row)
                 print(json.dumps(row), flush=True)
@@ -152,7 +168,9 @@ def attention_sweep(args, results):
             if args.grad:
                 flops *= 3.5
             row = {"sweep": "attention", "impl": impl_name, "seq_len": seq,
-                   "grad": bool(args.grad), "time_s": round(dt, 5),
+                   "dtype": args.dtype, "head_dim": head_dim,
+                   "heads": heads, "grad": bool(args.grad),
+                   "time_s": round(dt, 5),
                    "tflops": round(flops / dt / 1e12, 2)}
             if args.window is not None and impl_name == "flash_pallas":
                 # Only this impl receives the window (the xla paths have no
@@ -188,7 +206,7 @@ def main():
         attention_sweep(args, results)
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "sweep_results.json")
+                       args.out)
     with open(out, "w") as f:
         json.dump({"ts": time.time(), "platform": jax.devices()[0].platform,
                    "results": results}, f, indent=2)

@@ -261,7 +261,8 @@ def _attention(q, k, v, cfg: TransformerConfig):
                 "attn_window requires attn_impl='flash' (the banded "
                 "block-skipping lives in the pallas kernels)")
         return flash_attention(q, k, v, causal=True, window=cfg.attn_window)
-    if should_use_flash(q.shape[1], causal=True, impl=cfg.attn_impl):
+    if should_use_flash(q.shape[1], causal=True, impl=cfg.attn_impl,
+                        head_dim=q.shape[-1], dtype=q.dtype):
         return flash_attention(q, k, v, causal=True)
     return full_attention(q, k, v, causal=True)
 

@@ -402,35 +402,91 @@ def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, window, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def should_use_flash(t: int, *, causal: bool = True,
-                     impl: str = "auto") -> bool:
+# Flash-vs-XLA dispatch table, keyed by device_kind prefix. Values are
+# measured, not guessed — benchmarks/dispatch_sweep.json holds the sweep
+# rows each entry was derived from (benchmarks/run_sweep.py --grad across
+# seq/dtype/head_dim on the named hardware). Unlisted TPU generations
+# inherit the "tpu" row (same MXU/VMEM architecture; re-sweep to
+# specialize); non-TPU platforms never auto-select flash — pallas interpret
+# mode is orders of magnitude slower than XLA's fused attention.
+#
+# min_seq: crossover sequence length per compute dtype (crossovers shift
+#   ~2x between bf16 and f32 because XLA's materialized-scores path
+#   gains more from f32 MXU passthrough than the tiled kernel loses).
+# block_q/block_k: fastest measured tile shape (clamped to seq at call
+#   time; 512x1024 measured ~6x over 128x128 at seq 2-4k on v5e).
+# max_head_dim: the kernel keeps [block, D] tiles resident in VMEM; above
+#   this, tiles spill and XLA wins regardless of seq.
+_DISPATCH_TABLE: dict[str, dict] = {
+    "TPU v5 lite": {"min_seq": {"bfloat16": 2048, "float32": 4096},
+                    "block_q": 512, "block_k": 1024, "max_head_dim": 256},
+    "tpu": {"min_seq": {"bfloat16": 2048, "float32": 4096},
+            "block_q": 512, "block_k": 1024, "max_head_dim": 256},
+}
+
+
+def dispatch_entry(device=None) -> dict | None:
+    """The dispatch-table row for ``device`` (default ``jax.devices()[0]``);
+    None on non-TPU platforms, the generic "tpu" row for unlisted TPUs."""
+    from distributed_model_parallel_tpu.utils.profiling import (
+        match_device_kind,
+    )
+
+    device = device if device is not None else jax.devices()[0]
+    if device.platform != "tpu":
+        return None
+    specific = {k: v for k, v in _DISPATCH_TABLE.items() if k != "tpu"}
+    return (match_device_kind(specific, device)
+            or _DISPATCH_TABLE["tpu"])
+
+
+def default_blocks(device=None) -> tuple[int, int]:
+    """Per-platform (block_q, block_k) kernel tile defaults (the kernel
+    itself clamps them to the actual sequence length)."""
+    entry = dispatch_entry(device) or _DISPATCH_TABLE["tpu"]
+    return entry["block_q"], entry["block_k"]
+
+
+def should_use_flash(t: int, *, causal: bool = True, impl: str = "auto",
+                     head_dim: int = 64, dtype=None,
+                     device=None) -> bool:
     """Single home for the flash-vs-XLA dispatch heuristic (used by
     models/transformer and ops/ring_attention): "flash"/"xla" force an
-    implementation; "auto" picks flash on TPU for causal sequences >=
-    2048, where the kernel's forward is 3-10x faster than XLA
-    (benchmarks/run_sweep.py)."""
+    implementation; "auto" consults the per-platform dispatch table —
+    sequence-length crossover by compute dtype, and a head-dim cap above
+    which the kernel's VMEM tiles spill."""
     if impl == "flash":
         return True
     if impl == "xla":
         return False
     if impl != "auto":
         raise ValueError(f"unknown attn impl {impl!r}; known: auto, xla, flash")
-    return (causal and t >= 2048
-            and jax.devices()[0].platform == "tpu")
+    if not causal:
+        return False
+    entry = dispatch_entry(device)
+    if entry is None:
+        return False
+    if head_dim > entry["max_head_dim"]:
+        return False
+    dtype_name = jnp.dtype(dtype).name if dtype is not None else "bfloat16"
+    min_seq = entry["min_seq"].get(dtype_name,
+                                   entry["min_seq"]["bfloat16"])
+    return t >= min_seq
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = True, block_q: int = 512,
-                    block_k: int = 1024,
+                    causal: bool = True, block_q: int | None = None,
+                    block_k: int | None = None,
                     interpret: bool | None = None,
                     bwd_impl: str = "flash",
                     window: int | None = None) -> jax.Array:
     """[B, T, H, D] -> [B, T, H, D] causal attention, pallas-blocked.
 
     ``interpret=None`` auto-selects interpret mode off-TPU. Default block
-    sizes come from a v5e sweep with forced-sync timing (block 512x1024 is
-    ~6x faster than 128x128 at seq 2-4k: 63 vs 9 TFLOPS at seq 2048;
-    blocks clamp to the sequence length for short inputs). Beats plain XLA
+    sizes (``block_q``/``block_k`` = None) come from the per-platform
+    dispatch table (``dispatch_entry``; on v5e 512x1024, measured ~6x
+    faster than 128x128 at seq 2-4k: 63 vs 9 TFLOPS at seq 2048; blocks
+    clamp to the sequence length for short inputs). Beats plain XLA
     attention from seq ~2048 up, and still compiles at seq 8192 where the
     materialized T^2 score tensor makes XLA fail.
 
@@ -446,6 +502,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     if bwd_impl not in ("flash", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}; known: flash, xla")
+    if block_q is None or block_k is None:
+        dq, dk = default_blocks()
+        block_q = block_q if block_q is not None else dq
+        block_k = block_k if block_k is not None else dk
     if window is not None:
         if not causal:
             raise ValueError("window requires causal attention")

@@ -138,7 +138,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         flash_attention,
         should_use_flash,
     )
-    if should_use_flash(t, causal=causal):
+    if should_use_flash(t, causal=causal, head_dim=qh.shape[-1],
+                        dtype=qh.dtype):
         return heads_to_seq(flash_attention(qh, kh, vh, causal=causal))
     scale = qh.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale

@@ -286,3 +286,38 @@ def test_transformer_attn_window_config_validated():
         tfm.TransformerConfig(attn_window=0)
     with pytest.raises(ValueError, match="attn_window"):
         tfm.TransformerConfig(attn_window=-3)
+
+
+def test_dispatch_table_heuristic():
+    """should_use_flash consults the per-platform table: seq crossover by
+    dtype, head-dim VMEM cap, forced impls, and non-TPU fallback."""
+    import types
+
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.ops.pallas_attention import (
+        default_blocks,
+        dispatch_entry,
+        should_use_flash,
+    )
+
+    v5e = types.SimpleNamespace(platform="tpu", device_kind="TPU v5 lite")
+    cpu = types.SimpleNamespace(platform="cpu", device_kind="cpu")
+    # forced impls ignore everything else
+    assert should_use_flash(64, impl="flash", device=cpu)
+    assert not should_use_flash(1 << 20, impl="xla", device=v5e)
+    # per-dtype crossovers (v5e row: bf16 2048, f32 4096)
+    assert should_use_flash(2048, dtype=jnp.bfloat16, device=v5e)
+    assert not should_use_flash(1024, dtype=jnp.bfloat16, device=v5e)
+    assert not should_use_flash(2048, dtype=jnp.float32, device=v5e)
+    assert should_use_flash(4096, dtype=jnp.float32, device=v5e)
+    # head-dim cap: VMEM tiles spill above the table's max_head_dim
+    assert not should_use_flash(8192, head_dim=512, device=v5e)
+    assert should_use_flash(8192, head_dim=256, device=v5e)
+    # non-causal and non-TPU never auto-select flash
+    assert not should_use_flash(8192, causal=False, device=v5e)
+    assert not should_use_flash(8192, device=cpu)
+    # unknown TPU generations inherit the "tpu" row
+    v9 = types.SimpleNamespace(platform="tpu", device_kind="TPU v9 mega")
+    assert dispatch_entry(v9) is dispatch_entry.__globals__["_DISPATCH_TABLE"]["tpu"]
+    assert default_blocks(v5e) == (512, 1024)
