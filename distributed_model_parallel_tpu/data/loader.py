@@ -135,6 +135,23 @@ def maybe_prefetch(loader: Iterable, depth: int) -> Iterable:
     return PrefetchLoader(loader, depth=depth) if depth > 0 else loader
 
 
+def resize_batch(images_u8: jnp.ndarray, size: int) -> jnp.ndarray:
+    """On-device bilinear resize NHWC uint8 -> (B, size, size, C) uint8.
+
+    The input stage the reference's 224px finetune recipe needs
+    (``Readme.md:186-196``: CIFAR images upsampled to the pretrained
+    backbone's native resolution). Runs on the accelerator inside the train
+    step — the wire still carries the small native-size uint8 batch, and
+    XLA fuses the upsample with augmentation/normalization.
+    """
+    b, h, w, c = images_u8.shape
+    if (h, w) == (size, size):
+        return images_u8
+    x = jax.image.resize(images_u8.astype(jnp.float32), (b, size, size, c),
+                         method="bilinear")
+    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+
+
 def normalize(images_u8: jnp.ndarray, mean: np.ndarray, std: np.ndarray,
               dtype=jnp.float32) -> jnp.ndarray:
     """uint8 NHWC -> normalized float (on device)."""

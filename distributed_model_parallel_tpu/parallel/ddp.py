@@ -30,7 +30,11 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-from distributed_model_parallel_tpu.data.loader import augment_batch, normalize
+from distributed_model_parallel_tpu.data.loader import (
+    augment_batch,
+    normalize,
+    resize_batch,
+)
 from distributed_model_parallel_tpu.mesh import MeshSpec
 from distributed_model_parallel_tpu.models.staged import StagedModel
 from distributed_model_parallel_tpu.ops.collectives import (
@@ -52,7 +56,8 @@ def replicate_model_state(state: Any, num_replicas: int) -> Any:
 def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
                         spec: MeshSpec, *, mean, std, augment: bool = True,
                         dtype=jnp.float32, bucket_bytes: int | None = None,
-                        allreduce: str = "psum") -> Callable:
+                        allreduce: str = "psum",
+                        resize_to: int | None = None) -> Callable:
     """Returns jitted step(state, rng, images_u8, labels) -> (state, metrics).
 
     ``state.model_state`` must carry a leading per-replica axis
@@ -87,6 +92,8 @@ def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
         # Per-replica program: local shard of the batch, own BN state.
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
         local_state = jax.tree.map(lambda x: x[0], state.model_state)
+        if resize_to is not None:
+            images_u8 = resize_batch(images_u8, resize_to)
         images_u8 = augment_batch(rng, images_u8) if augment else images_u8
         images = normalize(images_u8, mean, std, dtype)
         (loss, (logits, new_local_state)), grads = jax.value_and_grad(
@@ -140,11 +147,14 @@ def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
 
 
 def make_ddp_eval_step(model: StagedModel, spec: MeshSpec, *, mean, std,
-                       dtype=jnp.float32) -> Callable:
+                       dtype=jnp.float32,
+                       resize_to: int | None = None) -> Callable:
     axis = spec.data_axis
 
     def replica_eval(state: TrainState, images_u8, labels):
         local_state = jax.tree.map(lambda x: x[0], state.model_state)
+        if resize_to is not None:
+            images_u8 = resize_batch(images_u8, resize_to)
         images = normalize(images_u8, mean, std, dtype)
         logits, _ = model.apply(state.params, local_state, images, train=False)
         n = jax.lax.psum(1, axis)

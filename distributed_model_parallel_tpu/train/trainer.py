@@ -33,6 +33,7 @@ from distributed_model_parallel_tpu.data.loader import (
     augment_batch,
     maybe_prefetch,
     normalize,
+    resize_batch,
 )
 from distributed_model_parallel_tpu.data.registry import ArrayDataset, load_dataset
 from distributed_model_parallel_tpu.mesh import MeshSpec, make_mesh
@@ -64,14 +65,16 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
                     *, mean, std, augment: bool = True,
-                    dtype=jnp.float32, ema_decay: float | None = None
-                    ) -> Callable:
+                    dtype=jnp.float32, ema_decay: float | None = None,
+                    resize_to: int | None = None) -> Callable:
     """Returns step(state, rng, images_u8, labels) -> (state, metrics).
 
     Augmentation + normalization run on-device so XLA fuses them with the
     forward pass; metrics are computed on-device as sums (psum-friendly).
     With ``ema_decay``, ``state.ema_params`` tracks
-    ``d*ema + (1-d)*params`` after each update.
+    ``d*ema + (1-d)*params`` after each update. ``resize_to`` upsamples the
+    uint8 batch on-device before augmentation (the 224px finetune input
+    path; data/loader.resize_batch).
     """
 
     def loss_fn(params, model_state, images, labels):
@@ -80,6 +83,8 @@ def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
         return loss, (logits, new_state)
 
     def step(state: TrainState, rng: jax.Array, images_u8, labels):
+        if resize_to is not None:
+            images_u8 = resize_batch(images_u8, resize_to)
         images_u8 = augment_batch(rng, images_u8) if augment else images_u8
         images = normalize(images_u8, mean, std, dtype)
         (loss, (logits, new_model_state)), grads = jax.value_and_grad(
@@ -116,8 +121,8 @@ def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
 
 def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
                     *, image_shape, mean, std, augment: bool = True,
-                    dtype=jnp.float32, ema_decay: float | None = None
-                    ) -> Callable:
+                    dtype=jnp.float32, ema_decay: float | None = None,
+                    resize_to: int | None = None) -> Callable:
     """K train steps per dispatched program (lax.scan) over a
     device-resident dataset.
 
@@ -129,7 +134,8 @@ def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
     ``make_train_step``'s.
     """
     step = make_train_step(model, tx, mean=mean, std=std, augment=augment,
-                           dtype=dtype, ema_decay=ema_decay)
+                           dtype=dtype, ema_decay=ema_decay,
+                           resize_to=resize_to)
     h, w, c = image_shape
 
     def multi(state: TrainState, rng: jax.Array, images_flat, labels_all, idx):
@@ -148,8 +154,11 @@ def make_multi_step(model: StagedModel, tx: optax.GradientTransformation,
 
 
 def make_eval_step(model: StagedModel, *, mean, std, dtype=jnp.float32,
-                   use_ema: bool = False) -> Callable:
+                   use_ema: bool = False,
+                   resize_to: int | None = None) -> Callable:
     def step(state: TrainState, images_u8, labels):
+        if resize_to is not None:
+            images_u8 = resize_batch(images_u8, resize_to)
         images = normalize(images_u8, mean, std, dtype)
         params = state.ema_params if use_ema else state.params
         model_state = state.ema_model_state if use_ema else state.model_state
@@ -197,14 +206,23 @@ class Trainer:
 
         self.tx = make_optimizer(config.optimizer, len(self.train_loader),
                                  config.epochs)
-        sample = jnp.zeros((2,) + train_ds.images.shape[1:], jnp.uint8)
+        # On-device resize stage when the configured input size differs from
+        # the dataset's native resolution (the 224px finetune input path):
+        # the model initializes at the *target* size and every step upsamples
+        # the uint8 batch before augmentation.
+        native_hw = train_ds.images.shape[1]
+        resize_to = (config.data.image_size
+                     if config.data.image_size != native_hw else None)
+        in_hw = resize_to or native_hw
+        sample = jnp.zeros((2, in_hw, in_hw, train_ds.images.shape[3]),
+                           jnp.uint8)
         params, model_state = self.model.init(
             jax.random.key(config.seed),
             normalize(sample, train_ds.mean, train_ds.std))
         # Replicate state over the mesh; shard batches on the data axis.
         self._repl = self.spec.replicated()
         self._batch_sh = self.spec.batch_sharded()
-        kw = dict(mean=train_ds.mean, std=train_ds.std)
+        kw = dict(mean=train_ds.mean, std=train_ds.std, resize_to=resize_to)
 
         ema = config.optimizer.ema_decay
         if ema is not None and not (0.0 <= ema <= 1.0):

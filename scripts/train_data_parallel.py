@@ -87,6 +87,10 @@ def parse_args():
                    choices=["psum", "bucketed", "ring", "hierarchical"],
                    help="DDP gradient allreduce implementation "
                         "(hierarchical needs --dcn-data > 1)")
+    p.add_argument("--image-size", default=32, type=int,
+                   help="train/eval input resolution; when it differs from "
+                        "the dataset's native size the batch is resized "
+                        "on-device (224 = the reference finetune recipe)")
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--prefetch", default=2, type=int,
                    help="host prefetch depth (0 disables)")
@@ -127,6 +131,7 @@ def main():
                                      else "sync" if args.sync_bn else "local"),
                           dtype="bfloat16" if args.bf16 else "float32"),
         data=DataConfig(name=args.dataset_type, root=args.data,
+                        image_size=args.image_size,
                         batch_size=args.batch_size, num_workers=args.workers,
                         augment=not args.no_augment, prefetch=args.prefetch,
                         use_native=args.native_loader),

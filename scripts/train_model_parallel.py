@@ -60,6 +60,10 @@ def parse_args():
     p.add_argument("--batch-size", "-b", default=512, type=int)
     p.add_argument("--warmup-epochs", default=10, type=int)
     p.add_argument("--resume", "-r", action="store_true")
+    p.add_argument("--image-size", default=32, type=int,
+                   help="train/eval input resolution; when it differs from "
+                        "the dataset's native size the batch is resized "
+                        "on-device (224 = the reference finetune recipe)")
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--log-name", default=None)
     return p.parse_args()
@@ -76,6 +80,7 @@ def main():
     config = TrainConfig(
         model=ModelConfig(name=args.model),
         data=DataConfig(name=args.dataset_type, root=args.data,
+                        image_size=args.image_size,
                         batch_size=args.batch_size,
                         augment=not args.no_augment),
         optimizer=OptimizerConfig(
