@@ -34,6 +34,8 @@ def parse_args():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--model", default="mobilenetv2")
     p.add_argument("--ways", default="2,4")
+    p.add_argument("--microbatches", default="8",
+                   help="comma list: one gpipe row per count (e.g. 2,4,8)")
     return p.parse_args()
 
 
@@ -106,14 +108,27 @@ def main():
         run(f"data_parallel_{w}way", Trainer, MeshConfig(data=w))
         run(f"model_parallel_{w}way_naive", PipelineTrainer,
             MeshConfig(data=1, stage=w), microbatches=1)
-        run(f"model_parallel_{w}way_gpipe8", PipelineTrainer,
-            MeshConfig(data=1, stage=w), microbatches=8)
+        for m in (int(x) for x in args.microbatches.split(",")):
+            run(f"model_parallel_{w}way_gpipe{m}", PipelineTrainer,
+                MeshConfig(data=1, stage=w), microbatches=m)
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "results.json")
+    platform = jax.devices()[0].platform
+    meta = {"ts": time.time(), "platform": platform,
+            "host_cpus": os.cpu_count(), "results": results}
+    if platform == "cpu":
+        # A virtual CPU mesh time-slices one host: stage/replica programs
+        # SERIALIZE on the host cores (fully so when host_cpus == 1), so
+        # wall-clock rows measure total work + per-program dispatch, never
+        # pipeline overlap. Relative DP-vs-MP shape is meaningful; GPipe-vs-
+        # naive differences are dispatch overhead, not bubble fraction.
+        meta["caveat"] = (
+            f"virtual CPU mesh on {os.cpu_count()} host core(s): no "
+            f"inter-device overlap exists; schedule comparisons reflect "
+            f"dispatch overhead only — see docs/design.md §4")
     with open(out, "w") as f:
-        json.dump({"ts": time.time(), "platform": jax.devices()[0].platform,
-                   "results": results}, f, indent=2)
+        json.dump(meta, f, indent=2)
     print(f"wrote {out}", file=sys.stderr)
 
 

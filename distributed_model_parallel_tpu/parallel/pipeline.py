@@ -44,7 +44,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from distributed_model_parallel_tpu.data.loader import augment_batch, normalize
+from distributed_model_parallel_tpu.data.loader import (
+    augment_batch,
+    normalize,
+    resize_batch,
+)
 from distributed_model_parallel_tpu.models.staged import StagedModel, stage_slices
 from distributed_model_parallel_tpu.train.metrics import topk_correct
 from distributed_model_parallel_tpu.train.trainer import cross_entropy
@@ -120,6 +124,7 @@ class PipelineRunner:
                  schedule: str = "gpipe",
                  virtual_stages: int = 1,
                  bn_momentum: float = 0.9,
+                 resize_to: int | None = None,
                  dtype=jnp.float32):
         """``virtual_stages > 1`` gives the Megatron interleaved placement:
         the model splits into ``V*S`` chunks and device ``s`` owns chunks
@@ -139,6 +144,12 @@ class PipelineRunner:
         self.schedule = schedule
         self.mean, self.std, self.dtype = mean, std, dtype
         self.bn_momentum = bn_momentum
+        self.resize_to = resize_to
+        if resize_to is not None:
+            # Model (and stage splits) see the resized resolution; batches
+            # arrive at native size and upsample on stage 0's device.
+            sample_shape = (sample_shape[0], resize_to, resize_to,
+                            sample_shape[3])
 
         params, model_state = model.init(rng, jnp.zeros(sample_shape, dtype))
         self.stages: list[StageState] = []
@@ -173,6 +184,24 @@ class PipelineRunner:
             jax.jit(partial(fwd, lo, hi), static_argnames=("train",))
             for lo, hi in self.slices]
 
+        # Chunk 0 fused with augment+normalize: one dispatched program per
+        # microbatch instead of two (prep cost rides the same XLA program,
+        # and the prepped activations come back for the backward's remat
+        # input). Dispatch count is the single-controller runner's per-
+        # microbatch overhead, so every fused call matters at high M.
+        lo0, hi0 = self.slices[0]
+
+        def fwd0(params, state, rng, imgs_u8, train):
+            if self.resize_to is not None:
+                imgs_u8 = resize_batch(imgs_u8, self.resize_to)
+            x = normalize(
+                augment_batch(rng, imgs_u8) if self.augment else imgs_u8,
+                self.mean, self.std, self.dtype)
+            y, ns = fwd(lo0, hi0, params, state, x, train)
+            return y, ns, x
+
+        self._fwd0 = jax.jit(fwd0, static_argnames=("train",))
+
         def bwd(lo, hi, params, state, x, g):
             """Recompute the stage forward and pull the cotangent back.
             Replaces the reference's wire-received-gradient backward
@@ -185,6 +214,15 @@ class PipelineRunner:
             return dp, dx
 
         self._bwd = [jax.jit(partial(bwd, lo, hi)) for lo, hi in self.slices]
+
+        def bwd_acc(lo, hi, params, state, x, g, acc):
+            """Backward fused with gradient accumulation: one program per
+            (chunk, microbatch) instead of a bwd + a separate add."""
+            dp, dx = bwd(lo, hi, params, state, x, g)
+            return jax.tree.map(jnp.add, acc, dp), dx
+
+        self._bwd_acc = [jax.jit(partial(bwd_acc, lo, hi))
+                         for lo, hi in self.slices]
 
         def loss_and_grad(logits, labels):
             """Runs on stage 0's device: reference semantics — labels live
@@ -206,13 +244,8 @@ class PipelineRunner:
             return optax.apply_updates(params, updates), new_opt
 
         self._apply = jax.jit(apply_updates)
-        self._accum = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
         self._merge_states = jax.jit(partial(
             merge_microbatch_bn_states, momentum=self.bn_momentum))
-        self._prep = jax.jit(
-            lambda rng, imgs: normalize(
-                augment_batch(rng, imgs) if self.augment else imgs,
-                self.mean, self.std, self.dtype))
 
     # ------------------------------------------------------------------ steps
     def _to_stage(self, c: int, x):
@@ -231,8 +264,10 @@ class PipelineRunner:
                        logits_grads, micro_metrics):
         """Forward one microbatch through all chunks + loss on stage 0."""
         C = self.num_chunks
-        x = self._prep(self._to_stage(0, sub_rng), self._to_stage(0, imgs))
-        for c in range(C):
+        x, new_states[m][0], acts[m][0] = self._fwd0(
+            self.stages[0].params, self.stages[0].model_state,
+            self._to_stage(0, sub_rng), self._to_stage(0, imgs), True)
+        for c in range(1, C):
             x = self._to_stage(c, x)
             acts[m][c] = x
             x, new_states[m][c] = self._fwd[c](
@@ -249,9 +284,14 @@ class PipelineRunner:
         g = self._to_stage(C - 1, logits_grads[m])   # 0→last hop
         for c in reversed(range(C)):
             g = self._to_stage(c, g)
-            dp, g = self._bwd[c](self.stages[c].params,
-                                 self.stages[c].model_state, acts[m][c], g)
-            grads[c] = dp if grads[c] is None else self._accum(grads[c], dp)
+            if grads[c] is None:
+                grads[c], g = self._bwd[c](
+                    self.stages[c].params, self.stages[c].model_state,
+                    acts[m][c], g)
+            else:
+                grads[c], g = self._bwd_acc[c](
+                    self.stages[c].params, self.stages[c].model_state,
+                    acts[m][c], g, grads[c])
         acts[m] = [None] * C                          # free chunk inputs
 
     def _schedule(self) -> list[tuple[str, int]]:
@@ -336,6 +376,8 @@ class PipelineRunner:
                 "correct@5": float(mets["correct@5"])}
 
     def _prep_eval(self, imgs):
+        if self.resize_to is not None:
+            imgs = resize_batch(imgs, self.resize_to)
         return normalize(imgs, self.mean, self.std, self.dtype)
 
     # ------------------------------------------------------------- utilities

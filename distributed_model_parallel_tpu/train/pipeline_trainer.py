@@ -56,6 +56,14 @@ class PipelineTrainer:
             shuffle=False, use_native=config.data.use_native,
             num_workers=config.data.num_workers)
 
+        # On-device resize when the configured input size differs from the
+        # dataset's native resolution (same rule as the DP Trainer).
+        native_hw = train_ds.images.shape[1]
+        resize_to = (config.data.image_size
+                     if config.data.image_size != native_hw else None)
+        in_hw = resize_to or native_hw
+        in_shape = (in_hw, in_hw, train_ds.images.shape[3])
+
         model = get_model(config.model)
         if config.optimizer.ema_decay is not None:
             raise ValueError(
@@ -76,10 +84,11 @@ class PipelineTrainer:
             micro = max(1, config.data.batch_size // max(
                 1, config.num_microbatches))
             boundaries = auto_boundaries(
-                model, (micro,) + train_ds.images.shape[1:], n_chunks)
+                model, (micro,) + in_shape, n_chunks)
         self.runner = PipelineRunner(
             model, devices, tx=tx, rng=jax.random.key(config.seed),
             sample_shape=(2,) + train_ds.images.shape[1:],
+            resize_to=resize_to,
             mean=train_ds.mean, std=train_ds.std,
             boundaries=boundaries,
             num_microbatches=config.num_microbatches,
