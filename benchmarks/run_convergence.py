@@ -61,6 +61,17 @@ def parse_args():
                    default="gspmd,ddp,fsdp,pipe_naive,pipe_gpipe8")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
+    p.add_argument("--device-resident", action="store_true",
+                   help="gspmd/fsdp: dataset lives on device, K steps per "
+                        "dispatch — the fast path for the full-scale "
+                        "headline run (the host-streaming path pays a "
+                        "per-step batch upload through the remote tunnel)")
+    p.add_argument("--out", default="convergence.json",
+                   help="output filename under benchmarks/")
+    p.add_argument("--eval-every", type=int, default=1,
+                   help="eval pass every N epochs (final epoch always "
+                        "evals); raise when remote-tunnel eval dominates "
+                        "short epochs")
     return p.parse_args()
 
 
@@ -83,11 +94,18 @@ def build_config(args, strategy):
             warmup_steps=args.warmup_epochs * steps_per_epoch),
         epochs=args.epochs,
         seed=args.seed,
+        eval_every=args.eval_every,
         log_dir="/tmp/dmp_conv_log", checkpoint_dir=f"/tmp/dmp_conv_ckpt_{strategy}",
         log_every_n_steps=10_000,
     )
     if strategy in ("gspmd", "ddp", "fsdp"):
         kw.update(strategy=strategy, mesh=MeshConfig(data=n_dev))
+        if args.device_resident and strategy in ("gspmd", "fsdp"):
+            kw.update(device_resident_data=True, steps_per_dispatch=10)
+        elif args.device_resident:
+            raise ValueError(
+                f"--device-resident is a gspmd/fsdp fast path; strategy "
+                f"{strategy!r} streams batches from host (no silent ignores)")
     elif strategy == "pipe_naive":
         kw.update(mesh=MeshConfig(data=1, stage=n_dev), num_microbatches=1)
     elif strategy == "pipe_gpipe8":
@@ -153,7 +171,7 @@ def main():
         "results": out_rows,
     }
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "convergence.json")
+                       args.out)
     with open(out, "w") as f:
         json.dump(meta, f, indent=2)
     print(f"wrote {out}", file=sys.stderr)

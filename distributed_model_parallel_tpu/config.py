@@ -152,6 +152,11 @@ class TrainConfig:
     # epoch doesn't stall behind filesystem writes; fit() drains at the end.
     async_checkpoint: bool = False
     log_every_n_steps: int = 30             # reference data_parallel.py:116
+    # Run the eval pass every N epochs (always on the final epoch). The
+    # reference evals every epoch (data_parallel.py:160-172) — keep 1 for
+    # parity; raise it when eval wall-clock dominates short epochs (e.g.
+    # through a remote device tunnel where each eval batch pays an upload).
+    eval_every: int = 1
     max_inflight_steps: int = 8             # bound on host run-ahead (async dispatch)
     # Device-resident fast path (gspmd strategy): upload the train set to the
     # accelerators once and run steps_per_dispatch train steps per jitted

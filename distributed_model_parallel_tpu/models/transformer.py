@@ -65,6 +65,13 @@ class TransformerConfig:
     remat: bool = False            # jax.checkpoint each block: recompute
                                    # activations in backward (HBM for FLOPs —
                                    # the long-context memory lever)
+    # Remat granularity when remat=True: "full" recomputes the whole block
+    # in the backward; "dots" saves matmul/einsum outputs and recomputes
+    # only the cheap elementwise ops (jax.checkpoint_policies.
+    # dots_with_no_batch_dims_saveable) — most of full-remat's memory win
+    # at a fraction of its recompute FLOPs, usually the better MFU point
+    # for long-sequence training.
+    remat_policy: str = "full"
     # Mixture-of-experts FFN (0 = dense). When > 0 every block's MLP is a
     # top-k routed MoE (ops/moe.py); ep_axis shards experts over the
     # ``expert`` mesh axis inside a shard_map. MoE replaces the FFN, so
@@ -321,7 +328,15 @@ def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig
     Returns ``(x, aux)``; aux is the mean per-layer MoE load-balance loss."""
     apply = block_apply
     if cfg.remat:
-        apply = jax.checkpoint(block_apply, static_argnums=(2,))
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "full":
+            policy = None
+        else:
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                             f"known: full, dots")
+        apply = jax.checkpoint(block_apply, static_argnums=(2,),
+                               policy=policy)
 
     def body(carry, bp):
         carry, aux = apply(bp, carry, cfg)

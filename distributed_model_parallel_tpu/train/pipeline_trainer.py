@@ -178,15 +178,22 @@ class PipelineTrainer:
                                           "pipeline-preempt", self.logger,
                                           epoch)
                     break
-                ev = self._run_epoch(epoch, train=False)
+                from distributed_model_parallel_tpu.train.trainer import (
+                    eval_now,
+                )
+
+                ev = (self._run_epoch(epoch, train=False)
+                      if eval_now(epoch, epochs, self.config.eval_every)
+                      else None)
                 record = dict(epoch=epoch, loss_train=tr.loss,
                               acc1_train=tr.acc1,
-                              loss_val=ev.loss, acc1_val=ev.acc1,
+                              loss_val=ev.loss if ev else None,
+                              acc1_val=ev.acc1 if ev else None,
                               time_per_batch=tr.step_time,
                               time_load_per_batch=tr.data_time)
                 self.logger.log_epoch(**record)
                 history.append(record)
-                if ev.acc1 > self.best_acc:
+                if ev is not None and ev.acc1 > self.best_acc:
                     self.best_acc = ev.acc1
                     self.start_epoch = epoch + 1
                     self.ckpt.save(self._ckpt_tree(), "pipeline")

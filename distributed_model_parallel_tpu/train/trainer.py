@@ -63,6 +63,13 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def eval_now(epoch: int, total_epochs: int, eval_every: int) -> bool:
+    """Eval-cadence rule shared by the DP and pipeline trainers: every Nth
+    epoch, and always the final one (so final-loss artifacts exist)."""
+    return ((epoch + 1) % max(1, eval_every) == 0
+            or epoch == total_epochs - 1)
+
+
 def make_train_step(model: StagedModel, tx: optax.GradientTransformation,
                     *, mean, std, augment: bool = True,
                     dtype=jnp.float32, ema_decay: float | None = None,
@@ -559,15 +566,18 @@ class Trainer:
                                           self._ckpt_tree(), "preempt",
                                           self.logger, epoch)
                     break
-                ev = self.evaluate()
+                ev = (self.evaluate()
+                      if eval_now(epoch, epochs, self.config.eval_every)
+                      else None)
                 record = dict(epoch=epoch, loss_train=tr.loss,
                               acc1_train=tr.acc1,
-                              loss_val=ev.loss, acc1_val=ev.acc1,
+                              loss_val=ev.loss if ev else None,
+                              acc1_val=ev.acc1 if ev else None,
                               time_per_batch=tr.step_time,
                               time_load_per_batch=tr.data_time)
                 self.logger.log_epoch(**record)
                 history.append(record)
-                if ev.acc1 > self.best_acc:
+                if ev is not None and ev.acc1 > self.best_acc:
                     self.best_acc = ev.acc1
                     self._save(epoch)
         self.ckpt.wait_until_finished()

@@ -47,6 +47,14 @@ def parse_args():
                    help="grouped-query attention: k/v head count (must "
                         "divide --heads; 1 = multi-query). Shrinks the "
                         "decode KV cache by heads/kv-heads")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block (activation recompute — "
+                        "the long-context memory lever)")
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "dots"],
+                   help="with --remat: 'dots' saves matmul outputs and "
+                        "recomputes only elementwise ops (less recompute, "
+                        "slightly more HBM)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--microbatches", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.1)
@@ -86,6 +94,7 @@ def main():
             pos_embedding="rope" if args.rope else "learned",
             n_kv_heads=args.kv_heads,
             attn_window=args.attn_window,
+            remat=args.remat, remat_policy=args.remat_policy,
             attn_impl="flash" if args.attn_window is not None else "auto"),
         mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
                         seq=args.sp, expert=args.ep),
