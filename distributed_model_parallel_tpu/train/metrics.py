@@ -69,6 +69,11 @@ class StepTimer:
         self.step.update(now - self._mark)
         self._mark = now
 
+    def mark(self):
+        """Reset the reference point without attributing the elapsed time
+        (for loops that account step time as a wall-clock residual)."""
+        self._mark = time.perf_counter()
+
     def window_done(self, n_steps: int):
         """Attribute the time since the last mark to ``n_steps`` batches.
 

@@ -12,6 +12,8 @@ entirely (SURVEY.md §5 "Checkpoint/resume").
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
@@ -24,7 +26,7 @@ from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
 from distributed_model_parallel_tpu.train.logging_util import RunLogger
 from distributed_model_parallel_tpu.train.metrics import AverageMeter, StepTimer
 from distributed_model_parallel_tpu.train.optim import make_optimizer
-from distributed_model_parallel_tpu.train.trainer import EpochResult
+from distributed_model_parallel_tpu.train.trainer import EpochResult, eval_now
 
 
 class PipelineTrainer:
@@ -136,27 +138,57 @@ class PipelineTrainer:
         timer = StepTimer()
         loader = self.train_loader if train else self.eval_loader
         loader = maybe_prefetch(loader, self.config.data.prefetch)
-        for i, (images, labels) in enumerate(loader):
-            if train and self.preemption.requested():
-                break
-            timer.data_ready()
-            if train:
-                self._rng, sub = jax.random.split(self._rng)
-                m = self.runner.train_step(sub, images, labels)
-            else:
-                m = self.runner.eval_step(images, labels)
-            timer.step_done()
-            b = m["batch"]
+        # Metrics stay on device between sync points (train path): a
+        # per-step host fetch through a remote device transport serializes
+        # upload/compute across steps (the v5e tunnel charges a blocking
+        # round trip per fetch). Step time is reported as the wall-clock
+        # residual after loader-fetch time — per-phase meters would
+        # misattribute the async dispatch cost of non-drain steps.
+        pending: list = []
+
+        def update(m, b):
             meters["loss"].update(m["loss"], int(b))
             meters["acc1"].update(m["correct@1"] / b * 100, int(b))
             meters["acc5"].update(m["correct@5"] / b * 100, int(b))
-            if train and i % self.config.log_every_n_steps == 0:
-                self.logger.log_step(epoch, i, loss=meters["loss"].avg,
-                                     acc1=meters["acc1"].avg,
-                                     step_time=timer.step.avg,
-                                     data_time=timer.data.avg)
+
+        def drain():
+            for mm, b in pending:
+                update(self.runner.finalize_metrics(mm, b), b)
+            pending.clear()
+
+        max_inflight = max(1, self.config.max_inflight_steps)
+        t_epoch = time.perf_counter()
+        n_steps = 0
+        timer.mark()
+        for i, (images, labels) in enumerate(loader):
+            if train and self.preemption.requested():
+                break
+            timer.data_ready()          # pure loader-fetch time
+            n_steps += 1
+            if train:
+                self._rng, sub = jax.random.split(self._rng)
+                pending.append(
+                    (self.runner.train_step_device(sub, images, labels),
+                     float(labels.shape[0])))
+                log_now = i % self.config.log_every_n_steps == 0
+                if log_now or len(pending) >= max_inflight:
+                    drain()
+                if log_now:
+                    run_step = (max(0.0, time.perf_counter() - t_epoch
+                                    - timer.data.sum) / max(1, n_steps))
+                    self.logger.log_step(epoch, i, loss=meters["loss"].avg,
+                                         acc1=meters["acc1"].avg,
+                                         step_time=run_step,
+                                         data_time=timer.data.avg)
+            else:
+                m = self.runner.eval_step(images, labels)
+                update(m, m["batch"])
+            timer.mark()                # dispatch time -> residual, not data
+        drain()
+        wall = time.perf_counter() - t_epoch
+        step_avg = max(0.0, wall - timer.data.sum) / max(1, n_steps)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
-                           meters["acc5"].avg, timer.step.avg, timer.data.avg)
+                           meters["acc5"].avg, step_avg, timer.data.avg)
 
     def fit(self, epochs: int | None = None) -> list[dict]:
         epochs = epochs if epochs is not None else self.config.epochs
@@ -178,10 +210,6 @@ class PipelineTrainer:
                                           "pipeline-preempt", self.logger,
                                           epoch)
                     break
-                from distributed_model_parallel_tpu.train.trainer import (
-                    eval_now,
-                )
-
                 ev = (self._run_epoch(epoch, train=False)
                       if eval_now(epoch, epochs, self.config.eval_every)
                       else None)

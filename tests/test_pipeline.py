@@ -128,6 +128,45 @@ def test_gpipe_bn_running_stats_match_big_batch(batch):
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=5e-4)
 
 
+def test_fused_single_device_matches_single_device_step(batch):
+    """S=1 routes through the fused one-program step (remote transports
+    charge ~60ms per jitted call, so the dispatched schedule is pure
+    overhead on one device); numerics must equal the plain DP step."""
+    images, labels = batch
+    model, tx, runner = _setup(1)
+    assert runner._fused is not None
+    metrics = runner.train_step(jax.random.key(9), images, labels)
+    ts, single_metrics = _single_device_step(model, tx, images, labels)
+    assert metrics["loss"] == pytest.approx(float(single_metrics["loss"]),
+                                            rel=1e-5)
+    for a, b in zip(jax.tree.leaves(runner.merged_params()),
+                    jax.tree.leaves(jax.device_get(ts.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(runner.merged_model_state()),
+                    jax.tree.leaves(jax.device_get(ts.model_state))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_microbatched_matches_dispatched_schedule(batch):
+    """Fused S=1 GPipe(M=4) == dispatched S=2 GPipe(M=4): identical
+    microbatch rng order, grad accumulation, and pooled-BN accounting —
+    only the program structure differs."""
+    images, labels = batch
+    _, _, r_fused = _setup(1, microbatches=4)
+    _, _, r_disp = _setup(2, microbatches=4)
+    assert r_fused._fused is not None and r_disp._fused is None
+    m1 = r_fused.train_step(jax.random.key(9), images, labels)
+    m2 = r_disp.train_step(jax.random.key(9), images, labels)
+    assert m1["loss"] == pytest.approx(m2["loss"], rel=1e-5)
+    assert m1["correct@1"] == m2["correct@1"]
+    for a, b in zip(jax.tree.leaves(r_fused.merged_params()),
+                    jax.tree.leaves(r_disp.merged_params())):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(r_fused.merged_model_state()),
+                    jax.tree.leaves(r_disp.merged_model_state())):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
 def test_1f1b_matches_gpipe_exactly(batch):
     """The 1F1B schedule reorders dispatch only — identical numerics."""
     images, labels = batch
