@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Merge convergence curves salvaged from trainer epoch logs with a
+run_convergence JSON (used when a multi-strategy run is interrupted after
+some strategies completed: the per-epoch records live in the trainers'
+``train.jsonl``, one line per epoch, strategies appended in run order).
+
+Usage:
+    merge_convergence.py salvage.jsonl name1,name2,... base.json out.json
+
+Finds complete 0..N-1 epoch blocks in the salvage log, labels them with
+the given strategy names (in order), rebuilds result rows in
+run_convergence's schema, and prepends them to base.json's results.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def blocks(lines):
+    """Split epoch-record lines into maximal runs of consecutive epochs
+    starting at 0."""
+    out, cur = [], []
+    for rec in lines:
+        e = rec.get("epoch")
+        if e is None:
+            continue
+        if e == 0 and cur:
+            out.append(cur)
+            cur = []
+        if e == (cur[-1]["epoch"] + 1 if cur else 0):
+            cur.append(rec)
+        else:
+            cur = [rec] if e == 0 else []
+    if cur:
+        out.append(cur)
+    return out
+
+
+def main():
+    salvage_path, names_csv, base_path, out_path = sys.argv[1:5]
+    names = names_csv.split(",")
+    with open(salvage_path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    complete = [b for b in blocks(lines) if len(b) >= 2 and b[-1]["epoch"] == len(b) - 1]
+    if not complete:
+        sys.exit("found no complete epoch blocks in the salvage log")
+    # keep only full-length blocks matching the longest (the finished runs)
+    full_len = max(len(b) for b in complete)
+    complete = [b for b in complete if len(b) == full_len]
+    if len(complete) < len(names):
+        sys.exit(f"found {len(complete)} complete {full_len}-epoch blocks, "
+                 f"need {len(names)}")
+    complete = complete[-len(names):]   # the final runs in the log
+
+    rows = []
+    for name, curve in zip(names, complete):
+        last = curve[-1]
+        rows.append({
+            "strategy": name,
+            "epochs": len(curve),
+            "final_loss_train": last["loss_train"],
+            "final_loss_val": last.get("loss_val"),
+            "final_acc1_val": last.get("acc1_val"),
+            "best_acc1_val": max((c.get("acc1_val") or 0.0) for c in curve),
+            # ts stamps are at epoch END: excludes trainer construction,
+            # compile, and epoch 0 — NOT comparable to run_convergence's
+            # construction-to-finish wall_s; the basis field flags it.
+            "wall_s": round(curve[-1]["ts"] - curve[0]["ts"], 1),
+            "wall_s_basis": "epoch_ts_delta (excludes construction+epoch0)",
+            "curve": [{"epoch": c["epoch"], "loss_train": c["loss_train"],
+                       "loss_val": c.get("loss_val"),
+                       "acc1_val": c.get("acc1_val")} for c in curve],
+        })
+
+    with open(base_path) as f:
+        base = json.load(f)
+    base["results"] = rows + base["results"]
+    with open(out_path, "w") as f:
+        json.dump(base, f, indent=2)
+    print(f"wrote {out_path}: " + ", ".join(
+        f"{r['strategy']}={r['final_loss_train']:.6g}" for r in base["results"]))
+
+
+if __name__ == "__main__":
+    main()

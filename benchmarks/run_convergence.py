@@ -30,7 +30,8 @@ Dataset: real CIFAR-10 when present under ``--data-root``; otherwise the
 deterministic synthetic stand-in at CIFAR scale (50k/10k) — parity across
 strategies is a property of the engines, not the pixels.
 
-Writes benchmarks/convergence.json and RESULTS.md (repo root).
+Writes benchmarks/<--out> (default convergence.json); RESULTS.md
+at the repo root narrates the committed artifacts.
 """
 
 from __future__ import annotations
@@ -100,18 +101,18 @@ def build_config(args, strategy):
     )
     if strategy in ("gspmd", "ddp", "fsdp"):
         kw.update(strategy=strategy, mesh=MeshConfig(data=n_dev))
-        if args.device_resident and strategy in ("gspmd", "fsdp"):
+        if args.device_resident:
             kw.update(device_resident_data=True, steps_per_dispatch=10)
-        elif args.device_resident:
-            raise ValueError(
-                f"--device-resident is a gspmd/fsdp fast path; strategy "
-                f"{strategy!r} streams batches from host (no silent ignores)")
     elif strategy == "pipe_naive":
         kw.update(mesh=MeshConfig(data=1, stage=n_dev), num_microbatches=1)
     elif strategy == "pipe_gpipe8":
         kw.update(mesh=MeshConfig(data=1, stage=n_dev), num_microbatches=8)
     else:
         raise KeyError(strategy)
+    if args.device_resident and strategy not in ("gspmd", "fsdp"):
+        raise ValueError(
+            f"--device-resident is a gspmd/fsdp fast path; strategy "
+            f"{strategy!r} streams batches from host (no silent ignores)")
     return TrainConfig(**kw)
 
 
