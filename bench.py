@@ -67,7 +67,7 @@ def bench_lm() -> None:
             vocab_size=32_000, d_model=1024, n_heads=8, n_layers=8,
             d_ff=4096, max_seq_len=seq, pos_embedding="rope",
             remat=True,
-            remat_policy=os.environ.get("DMP_BENCH_REMAT", "full"),
+            remat_policy=os.environ.get("DMP_BENCH_REMAT", "dots"),
             dtype=jnp.bfloat16),
         batch_size=batch, seq_len=seq, n_tokens=4 * batch * (seq + 1),
         log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",
@@ -92,7 +92,21 @@ def bench_lm() -> None:
     fetch(loss)
     dt = max(1e-9, time.perf_counter() - t0 - t_fetch) / steps
 
-    flops = compiled_flops(t._step, t.params, t.opt_state, toks, tgts)
+    # MFU counts MODEL FLOPs: a remat program re-executes forward work in
+    # the backward, and crediting that recompute would inflate the number
+    # (that would be HFU). Cost-analyze the same step compiled WITHOUT
+    # remat (compile only — never executed, so the non-remat activation
+    # memory is irrelevant).
+    import dataclasses as _dc
+
+    from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+        make_spmd_train_step,
+    )
+
+    step_no_remat = make_spmd_train_step(
+        _dc.replace(cfg.model, remat=False), t.spec, t.tx,
+        num_microbatches=cfg.num_microbatches)
+    flops = compiled_flops(step_no_remat, t.params, t.opt_state, toks, tgts)
     peak = peak_flops_per_chip()
     mfu = (round(flops / dt / (peak * n_chips), 4)
            if flops and peak else None)

@@ -20,18 +20,20 @@ import sys
 
 def blocks(lines):
     """Split epoch-record lines into maximal runs of consecutive epochs
-    starting at 0."""
+    starting at 0. Any break in the chain (a restart at 0 OR a
+    resume-at-epoch jump) flushes the current block — completeness is
+    judged downstream, so a finished run followed by a mid-epoch resume
+    block is preserved, not discarded."""
     out, cur = [], []
     for rec in lines:
         e = rec.get("epoch")
         if e is None:
             continue
-        if e == 0 and cur:
-            out.append(cur)
-            cur = []
-        if e == (cur[-1]["epoch"] + 1 if cur else 0):
+        if cur and e == cur[-1]["epoch"] + 1:
             cur.append(rec)
         else:
+            if cur:
+                out.append(cur)
             cur = [rec] if e == 0 else []
     if cur:
         out.append(cur)

@@ -52,9 +52,10 @@ class TransformerConfig:
     # 3-10x faster than XLA (benchmarks/run_sweep.py). Training uses the
     # FlashAttention-2 backward kernels (score tiles recomputed from the
     # saved logsumexp), so neither direction materializes [T, T] in HBM;
-    # fwd+bwd measures 2.5-5.7x faster than the XLA-recompute backward on
-    # v5e (1.0/3.2/10.9 ms at seq 2k/4k/8k, B4 H8 D64 bf16 — ~88 TFLOPS at
-    # 8k). "xla" / "flash" force one implementation.
+    # fwd+bwd measures 2.3-3.3x faster than the XLA-recompute backward on
+    # v5e (1.7/5.4/18.5 ms at seq 2k/4k/8k, B4 H8 D64 bf16 — 52 TFLOPS at
+    # 8k, benchmarks/grad_sweep.json; plain XLA cannot compile 8k at all).
+    # "xla" / "flash" force one implementation.
     attn_impl: str = "auto"
     # Sliding-window (local) attention: each token attends the last W
     # positions. Training runs on the flash kernels' banded block-skipping

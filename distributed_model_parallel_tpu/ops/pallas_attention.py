@@ -403,24 +403,29 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 # Flash-vs-XLA dispatch table, keyed by device_kind prefix. Values are
-# measured, not guessed — benchmarks/dispatch_sweep.json holds the sweep
-# rows each entry was derived from (benchmarks/run_sweep.py --grad across
-# seq/dtype/head_dim on the named hardware). Unlisted TPU generations
-# inherit the "tpu" row (same MXU/VMEM architecture; re-sweep to
-# specialize); non-TPU platforms never auto-select flash — pallas interpret
-# mode is orders of magnitude slower than XLA's fused attention.
+# measured, not guessed — benchmarks/dispatch_sweep.json holds the v5e
+# sweep rows each entry was derived from (benchmarks/run_sweep.py across
+# seq/dtype/head_dim). Unlisted TPU generations inherit the "tpu" row
+# (same MXU/VMEM architecture; re-sweep to specialize); non-TPU platforms
+# never auto-select flash — pallas interpret mode is orders of magnitude
+# slower than XLA's fused attention.
 #
-# min_seq: crossover sequence length per compute dtype (crossovers shift
-#   ~2x between bf16 and f32 because XLA's materialized-scores path
-#   gains more from f32 MXU passthrough than the tiled kernel loses).
+# min_seq: crossover sequence length per compute dtype; None = never
+#   auto-select for that dtype. bf16 head-dim 64: flash wins from 2048
+#   (3.4x) and 10x at 4096; head-dim 128 crosses earlier (1024) but 2048
+#   is kept as the single safe threshold. float32 is None NOT for speed —
+#   the kernel's MXU passes accumulate at bf16-input precision (measured
+#   ~8e-3 abs error on unit-scale f32 inputs vs true-f32 XLA attention,
+#   i.e. bf16-class), so auto-dispatch would silently degrade f32
+#   attention; forcing attn_impl="flash" remains available and documented.
 # block_q/block_k: fastest measured tile shape (clamped to seq at call
 #   time; 512x1024 measured ~6x over 128x128 at seq 2-4k on v5e).
 # max_head_dim: the kernel keeps [block, D] tiles resident in VMEM; above
 #   this, tiles spill and XLA wins regardless of seq.
 _DISPATCH_TABLE: dict[str, dict] = {
-    "TPU v5 lite": {"min_seq": {"bfloat16": 2048, "float32": 4096},
+    "TPU v5 lite": {"min_seq": {"bfloat16": 2048, "float32": None},
                     "block_q": 512, "block_k": 1024, "max_head_dim": 256},
-    "tpu": {"min_seq": {"bfloat16": 2048, "float32": 4096},
+    "tpu": {"min_seq": {"bfloat16": 2048, "float32": None},
             "block_q": 512, "block_k": 1024, "max_head_dim": 256},
 }
 
@@ -469,8 +474,12 @@ def should_use_flash(t: int, *, causal: bool = True, impl: str = "auto",
     if head_dim > entry["max_head_dim"]:
         return False
     dtype_name = jnp.dtype(dtype).name if dtype is not None else "bfloat16"
-    min_seq = entry["min_seq"].get(dtype_name,
-                                   entry["min_seq"]["bfloat16"])
+    # Unlisted dtypes (e.g. float64 under x64) stay on XLA: the kernel
+    # computes at bf16-input precision, so only dtypes with an explicit
+    # measured entry may auto-select it.
+    min_seq = entry["min_seq"].get(dtype_name)
+    if min_seq is None:
+        return False
     return t >= min_seq
 
 
