@@ -256,7 +256,8 @@ def _attention(q, k, v, cfg: TransformerConfig):
         from distributed_model_parallel_tpu.ops.ring_attention import (
             ulysses_attention,
         )
-        return ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+        return ulysses_attention(q, k, v, cfg.sp_axis, causal=True,
+                                 impl=cfg.attn_impl)
     from distributed_model_parallel_tpu.ops.pallas_attention import (
         flash_attention,
         should_use_flash,

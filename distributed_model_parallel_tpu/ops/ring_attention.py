@@ -111,12 +111,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                      axis_name: str, *, causal: bool = True) -> jax.Array:
+                      axis_name: str, *, causal: bool = True,
+                      impl: str = "auto") -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Re-shards [B, T/n, H, Dh] -> [B, T, H/n, Dh], runs full softmax attention
     over the complete sequence for the local head subset, then re-shards back.
-    Requires H % axis_size == 0.
+    Requires H % axis_size == 0. ``impl`` is the flash-vs-XLA selector
+    (``should_use_flash``): "auto" consults the measured dispatch table;
+    "flash" forces the pallas kernel (the escape hatch for dtypes the table
+    excludes, e.g. f32 long-context where XLA cannot materialize [T, T]).
     """
     n = jax.lax.axis_size(axis_name)
     if q.shape[2] % n:
@@ -138,8 +142,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         flash_attention,
         should_use_flash,
     )
-    if should_use_flash(t, causal=causal, head_dim=qh.shape[-1],
-                        dtype=qh.dtype):
+    if should_use_flash(t, causal=causal, impl=impl,
+                        head_dim=qh.shape[-1], dtype=qh.dtype):
         return heads_to_seq(flash_attention(qh, kh, vh, causal=causal))
     scale = qh.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale

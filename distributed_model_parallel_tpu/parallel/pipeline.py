@@ -260,6 +260,17 @@ class PipelineRunner:
         self._fused = (jax.jit(self._build_fused_step(fwd, apply_updates))
                        if self.num_stages == 1 else None)
 
+        slices = self.slices
+
+        def fused_eval(stage_params, stage_states, imgs_u8, lbls):
+            x = self._prep_eval(imgs_u8)   # same prep as the dispatched path
+            for c, (lo, hi) in enumerate(slices):
+                x, _ = fwd(lo, hi, stage_params[c], stage_states[c], x, False)
+            return {"loss": cross_entropy(x, lbls), **topk_correct(x, lbls)}
+
+        self._fused_eval = (jax.jit(fused_eval)
+                            if self.num_stages == 1 else None)
+
     def _build_fused_step(self, fwd, apply_updates):
         slices = self.slices
 
@@ -467,6 +478,16 @@ class PipelineRunner:
         return micro_metrics
 
     def eval_step(self, images_u8, labels) -> dict[str, float]:
+        if self._fused_eval is not None:   # S=1: one program, one dispatch
+            mets = jax.device_get(self._fused_eval(
+                tuple(st.params for st in self.stages),
+                tuple(st.model_state for st in self.stages),
+                self._to_stage(0, jnp.asarray(images_u8)),
+                self._to_stage(0, jnp.asarray(labels))))
+            return {"loss": float(mets["loss"]),
+                    "batch": float(labels.shape[0]),
+                    "correct@1": float(mets["correct@1"]),
+                    "correct@5": float(mets["correct@5"])}
         x = self._prep_eval(jnp.asarray(images_u8))
         for c in range(self.num_chunks):
             x = self._to_stage(c, x)

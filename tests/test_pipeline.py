@@ -167,6 +167,19 @@ def test_fused_microbatched_matches_dispatched_schedule(batch):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
+def test_fused_eval_matches_dispatched_eval(batch):
+    """S=1 eval routes through the fused one-program path; numerics must
+    equal the multi-stage dispatched eval."""
+    images, labels = batch
+    _, _, r1 = _setup(1)
+    _, _, r3 = _setup(3)
+    assert r1._fused_eval is not None and r3._fused_eval is None
+    e1 = r1.eval_step(images, labels)
+    e3 = r3.eval_step(images, labels)
+    assert e1["loss"] == pytest.approx(e3["loss"], rel=1e-5)
+    assert e1["correct@1"] == e3["correct@1"]
+
+
 def test_1f1b_matches_gpipe_exactly(batch):
     """The 1F1B schedule reorders dispatch only — identical numerics."""
     images, labels = batch
