@@ -499,6 +499,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     attention from seq ~2048 up, and still compiles at seq 8192 where the
     materialized T^2 score tensor makes XLA fail.
 
+    Single-chip sequence ceiling: the backward's dk/dv accumulators are
+    held full-T in VMEM per (batch, head) program, which exceeds the v5e's
+    16 MB scoped VMEM around T=16384 (measured: 19.5 MB requested). Longer
+    sequences on one chip need the FlashAttention-2 k-block grid for dk/dv
+    (one program per key block, looping query blocks — planned rework);
+    today the supported long-context route past 8k is sequence parallelism
+    over the ``seq`` mesh axis (ops/ring_attention.py), which shards T
+    before the kernel runs.
+
     ``window=W`` (causal only) restricts each query to the last W keys —
     sliding-window/local attention. Both directions skip blocks entirely
     outside the band, so compute drops from O(T^2) toward O(T*W).
