@@ -112,3 +112,28 @@ def test_resized_step_matches_pre_resized_data(tmp_path):
                        resize_batch(images, 48), labels)
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                rtol=1e-6)
+
+
+def test_pipeline_trainer_trains_at_non_native_image_size(tmp_path):
+    """The pipeline path resizes on stage 0's device (fused S=1 program):
+    32px on-disk CIFAR fixture trained at image_size=48 end-to-end."""
+    _cifar_fixture(tmp_path)
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    cfg = TrainConfig(
+        model=ModelConfig(name="tinycnn"),
+        data=DataConfig(name="cifar10", root=str(tmp_path), image_size=48,
+                        batch_size=8, eval_batch_size=8, synthetic_ok=False),
+        optimizer=OptimizerConfig(learning_rate=0.05, warmup_steps=0),
+        mesh=MeshConfig(data=1, stage=1),
+        num_microbatches=2,
+        epochs=1,
+        log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    t = PipelineTrainer(cfg)
+    assert t.runner.resize_to == 48 and t.runner._fused is not None
+    history = t.fit(epochs=1)
+    assert np.isfinite(history[0]["loss_train"])
+    assert np.isfinite(history[0]["loss_val"])
