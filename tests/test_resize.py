@@ -60,11 +60,9 @@ def _cifar_fixture(tmp_path, n_train=16, n_test=8):
                            np.uint8), np.arange(n_test) % 10)
 
 
-def test_trainer_trains_at_non_native_image_size(tmp_path):
-    """32px on-disk CIFAR fixture trained at image_size=48: the resize runs
-    inside the jitted step and the whole epoch goes through."""
-    _cifar_fixture(tmp_path)
-    cfg = TrainConfig(
+def _resize_cfg(tmp_path, **overrides):
+    """Shared 48px-on-32px-fixture config for the trainer resize tests."""
+    kw = dict(
         model=ModelConfig(name="tinycnn"),
         data=DataConfig(name="cifar10", root=str(tmp_path), image_size=48,
                         batch_size=8, eval_batch_size=8, synthetic_ok=False),
@@ -73,7 +71,15 @@ def test_trainer_trains_at_non_native_image_size(tmp_path):
         epochs=1,
         log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ckpt"),
     )
-    t = Trainer(cfg)
+    kw.update(overrides)
+    return TrainConfig(**kw)
+
+
+def test_trainer_trains_at_non_native_image_size(tmp_path):
+    """32px on-disk CIFAR fixture trained at image_size=48: the resize runs
+    inside the jitted step and the whole epoch goes through."""
+    _cifar_fixture(tmp_path)
+    t = Trainer(_resize_cfg(tmp_path))
     history = t.fit(epochs=1)
     assert np.isfinite(history[0]["loss_train"])
     # The model really saw 48px inputs: eval at 48 too.
@@ -122,17 +128,8 @@ def test_pipeline_trainer_trains_at_non_native_image_size(tmp_path):
         PipelineTrainer,
     )
 
-    cfg = TrainConfig(
-        model=ModelConfig(name="tinycnn"),
-        data=DataConfig(name="cifar10", root=str(tmp_path), image_size=48,
-                        batch_size=8, eval_batch_size=8, synthetic_ok=False),
-        optimizer=OptimizerConfig(learning_rate=0.05, warmup_steps=0),
-        mesh=MeshConfig(data=1, stage=1),
-        num_microbatches=2,
-        epochs=1,
-        log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ckpt"),
-    )
-    t = PipelineTrainer(cfg)
+    t = PipelineTrainer(_resize_cfg(tmp_path, mesh=MeshConfig(data=1, stage=1),
+                                    num_microbatches=2))
     assert t.runner.resize_to == 48 and t.runner._fused is not None
     history = t.fit(epochs=1)
     assert np.isfinite(history[0]["loss_train"])

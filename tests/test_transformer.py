@@ -79,6 +79,36 @@ def test_ulysses_attention_matches_full():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_attention_impl_forcing(monkeypatch):
+    """impl='flash' forces the pallas kernel inside Ulysses (the escape
+    hatch for dtypes the dispatch table excludes from auto); the kernel
+    must actually run, and its results must match impl='xla'."""
+    from distributed_model_parallel_tpu.ops import pallas_attention as pa
+
+    spec = make_mesh(MeshConfig(data=1, seq=4))
+    q, k, v = _qkv()
+    calls = []
+    real_flash = pa.flash_attention
+    monkeypatch.setattr(
+        pa, "flash_attention",
+        lambda *a, **kw: (calls.append(1), real_flash(*a, **kw))[1])
+
+    def run(impl):
+        f = jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=True,
+                                              impl=impl),
+            mesh=spec.mesh,
+            in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+            check_vma=False)
+        return np.asarray(f(q, k, v))
+
+    xla_out = run("xla")
+    assert not calls                     # "xla" never touches the kernel
+    flash_out = run("flash")
+    assert calls                         # "flash" really forced it
+    np.testing.assert_allclose(flash_out, xla_out, rtol=2e-2, atol=2e-2)
+
+
 def test_ring_attention_grads_match_full():
     spec = make_mesh(MeshConfig(data=1, seq=4))
     q, k, v = _qkv(seed=1)
