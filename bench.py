@@ -108,7 +108,9 @@ def bench_lm() -> None:
         num_microbatches=cfg.num_microbatches)
     flops = compiled_flops(step_no_remat, t.params, t.opt_state, toks, tgts)
     peak = peak_flops_per_chip()
-    mfu = (round(flops / dt / (peak * n_chips), 4)
+    # Per-device cost-analysis FLOPs over per-device peak (see the MFU
+    # normalization note in bench_cnn).
+    mfu = (round(flops / dt / peak, 4)
            if flops and peak else None)
     tokens_per_s_per_chip = batch * seq / dt / n_chips
     print(json.dumps({
@@ -234,7 +236,10 @@ def main() -> None:
     flops = compiled_flops(trainer._multi_step, trainer.state, sub,
                            trainer._dev_images, trainer._dev_labels, idx)
     peak = peak_flops_per_chip()
-    mfu = (round(flops / steps_per_dispatch / dt / (peak * n_chips), 4)
+    # compiled.cost_analysis() reports the per-device partitioned HLO
+    # module, so normalize by one chip's peak: per-device FLOPs over
+    # per-device peak IS the fleet MFU under SPMD (ADVICE r2).
+    mfu = (round(flops / steps_per_dispatch / dt / peak, 4)
            if flops and peak else None)
     print(json.dumps({
         "metric": f"{model_name}_cifar10_bs{batch}_train_samples_per_sec_per_chip",

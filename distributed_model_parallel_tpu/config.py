@@ -158,6 +158,14 @@ class TrainConfig:
     # through a remote device tunnel where each eval batch pays an upload).
     eval_every: int = 1
     max_inflight_steps: int = 8             # bound on host run-ahead (async dispatch)
+    # Numerical/stall guards (train/guards.py:GuardRunner): N > 0 checks
+    # drained metrics for NaN/Inf at every sync and the full params every N
+    # steps (raises NonFiniteError); stall_budget_s arms a wall-clock
+    # watchdog around blocking drains (logs, never raises). Both close the
+    # reference's silent-failure gap (SURVEY.md §5: a dead rank blocks
+    # forever on dist.recv, distributed_layers.py:20).
+    check_finite_every: int = 0
+    stall_budget_s: float | None = None
     # Device-resident fast path (gspmd strategy): upload the train set to the
     # accelerators once and run steps_per_dispatch train steps per jitted
     # program (lax.scan over on-device index gathers) — amortizes dispatch

@@ -34,7 +34,8 @@ class BatchLoader:
 
     def __init__(self, ds: ArrayDataset, batch_size: int, *,
                  shuffle: bool = True, seed: int = 0, drop_last: bool = True,
-                 use_native: bool = False, num_workers: int = 4):
+                 use_native: bool = False, num_workers: int = 4,
+                 shard_by_process: bool = False):
         if batch_size > len(ds):
             raise ValueError(
                 f"batch size {batch_size} exceeds dataset size {len(ds)}")
@@ -45,6 +46,18 @@ class BatchLoader:
         self.use_native = use_native
         self.num_workers = num_workers
         self._rng = np.random.default_rng(seed)
+        # Multi-process feeding: every process draws the *same* global batch
+        # order (the rng seed is config-fixed, so permutations agree), but
+        # materializes only its contiguous slice of each batch — the local
+        # shard ``mesh.host_local_batch_to_global`` stitches into the global
+        # array. Mirrors the per-rank DistributedSampler role in the
+        # reference's multi-process runs (model_parallel.py:89-97).
+        self.process_index = jax.process_index() if shard_by_process else 0
+        self.process_count = jax.process_count() if shard_by_process else 1
+        if batch_size % self.process_count:
+            raise ValueError(
+                f"batch size {batch_size} not divisible by process count "
+                f"{self.process_count}")
 
     def __len__(self) -> int:
         n = len(self.ds)
@@ -57,6 +70,22 @@ class BatchLoader:
         n = len(self.ds)
         return self._rng.permutation(n) if self.shuffle else np.arange(n)
 
+    def _local_slice(self, sel: np.ndarray) -> np.ndarray:
+        """This process's contiguous rows of one global batch's indices."""
+        if self.process_count == 1:
+            return sel
+        if len(sel) % self.process_count:
+            # Only reachable on a drop_last=False final partial batch (the
+            # constructor validates batch_size itself): silently flooring
+            # would drop samples and break the "same global batch stream as
+            # single-process" invariant.
+            raise ValueError(
+                f"partial batch of {len(sel)} rows not divisible by "
+                f"process count {self.process_count}; use drop_last=True "
+                f"or pad the dataset")
+        local = len(sel) // self.process_count
+        return sel[self.process_index * local:(self.process_index + 1) * local]
+
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         n = len(self.ds)
         idx = self.epoch_indices()
@@ -64,13 +93,13 @@ class BatchLoader:
         if self.use_native:
             from distributed_model_parallel_tpu.data import native
             for lo in range(0, stop, self.batch_size):
-                sel = idx[lo:lo + self.batch_size]
+                sel = self._local_slice(idx[lo:lo + self.batch_size])
                 yield (native.gather_rows(self.ds.images, sel,
                                           n_threads=self.num_workers),
                        self.ds.labels[sel])
         else:
             for lo in range(0, stop, self.batch_size):
-                sel = idx[lo:lo + self.batch_size]
+                sel = self._local_slice(idx[lo:lo + self.batch_size])
                 yield self.ds.images[sel], self.ds.labels[sel]
 
 
@@ -133,6 +162,24 @@ class PrefetchLoader:
 def maybe_prefetch(loader: Iterable, depth: int) -> Iterable:
     """Wrap ``loader`` in a PrefetchLoader when ``depth > 0`` (else as-is)."""
     return PrefetchLoader(loader, depth=depth) if depth > 0 else loader
+
+
+def resolve_input_size(images_shape, image_size: int) -> tuple[int | None, int]:
+    """(resize_to, input_hw) for the on-device resize input stage.
+
+    ``resize_to`` is None when the configured ``image_size`` already matches
+    the dataset's native resolution (no resize step compiled in). Shared by
+    the DP and pipeline trainers so the squareness assumption is validated
+    in exactly one place (ADVICE r2: comparing height alone would silently
+    skip the resize for a non-square dataset whose height matches).
+    """
+    native_h, native_w = images_shape[1:3]
+    if native_h != native_w:
+        raise ValueError(
+            f"the resize/input path assumes square images; dataset is "
+            f"{native_h}x{native_w} — pre-crop it square")
+    resize_to = image_size if image_size != native_h else None
+    return resize_to, (resize_to or native_h)
 
 
 def resize_batch(images_u8: jnp.ndarray, size: int) -> jnp.ndarray:

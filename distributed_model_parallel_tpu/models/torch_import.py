@@ -1,0 +1,269 @@
+"""Torch state_dict → staged-model weight import.
+
+The reference trains torch models and resumes from torch checkpoints
+(``{'net': state_dict, 'acc': ..., 'epoch': ...}`` written by its DP driver,
+reference ``data_parallel.py:84-87``). A user migrating mid-experiment
+therefore owns torch weights; this module maps them onto a ``StagedModel``'s
+flax pytrees so training (or eval) continues on TPU from the same numbers.
+
+The mapping is *structural*, not name-based: both frameworks register
+modules in execution order (torch: ``__init__`` registration order, which a
+``state_dict``'s insertion order preserves; flax: ``nn.compact`` creation
+order, which the params dict preserves), so the importer walks both sides as
+a sequence of typed records — conv / linear / norm — and pairs them up in
+order. Every pairing is shape-checked after layout conversion, so a
+misaligned walk fails loudly with both names in the error rather than
+silently loading a transposed layer. Layout conversions:
+
+* conv weight  ``(O, I/g, kH, kW)`` → ``(kH, kW, I/g, O)``  (NCHW → NHWC;
+  the same transpose covers depthwise convs, where torch's per-channel
+  ``(C, 1, kH, kW)`` becomes flax's ``feature_group_count`` form
+  ``(kH, kW, 1, C)``)
+* linear weight ``(O, I)`` → ``(I, O)``
+* batchnorm ``weight/bias/running_mean/running_var`` →
+  ``scale/bias`` (params) + ``mean/var`` (batch_stats);
+  ``num_batches_tracked`` is dropped (flax keeps no step counter)
+
+Caveat: a torch ``Flatten`` of an ``(N, C, H, W)`` tensor with H*W > 1
+orders features C-major while an NHWC flatten orders them C-minor, so a
+linear layer *after* such a flatten needs its input dim permuted. The zoo's
+heads all pool to (N, C) before the linear (``models/layers.py:110``), where
+the two orders coincide; the importer cannot see pre-flatten shapes, so it
+does not attempt the permutation.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any, Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_model_parallel_tpu.models.staged import Params, StagedModel, State
+
+
+def _to_numpy(t) -> np.ndarray:
+    """torch.Tensor | array-like -> np.ndarray (no torch import needed)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def strip_prefix(state_dict: Mapping[str, Any],
+                 prefix: str = "module.") -> dict[str, Any]:
+    """Remove a wrapper prefix (torch ``DataParallel``/``DistributedDataParallel``
+    register the wrapped net under ``module.``) from every key carrying it."""
+    return {(k[len(prefix):] if k.startswith(prefix) else k): v
+            for k, v in state_dict.items()}
+
+
+# ---------------------------------------------------------------------------
+# torch side: group flat keys into typed module records
+# ---------------------------------------------------------------------------
+
+def _torch_records(state_dict: Mapping[str, Any]) -> list[dict]:
+    """Group ``a.b.weight``-style keys by module prefix, in first-appearance
+    order, and classify each group as conv / linear / norm."""
+    groups: dict[str, dict[str, np.ndarray]] = {}
+    for key, value in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        prefix, _, leaf = key.rpartition(".")
+        groups.setdefault(prefix, {})[leaf] = _to_numpy(value)
+    records = []
+    for name, tensors in groups.items():
+        if "running_mean" in tensors or (
+                "weight" in tensors and tensors["weight"].ndim == 1):
+            kind = "norm"
+        elif "weight" in tensors and tensors["weight"].ndim == 4:
+            kind = "conv"
+        elif "weight" in tensors and tensors["weight"].ndim == 2:
+            kind = "linear"
+        else:
+            shapes = {k: v.shape for k, v in tensors.items()}
+            raise ValueError(
+                f"cannot classify torch module {name!r} with tensors "
+                f"{shapes}; expected a conv (4-d weight), linear (2-d "
+                f"weight), or norm (1-d weight / running stats)")
+        records.append({"name": name or "<root>", "kind": kind,
+                        "tensors": tensors})
+    return records
+
+
+# ---------------------------------------------------------------------------
+# flax side: walk the staged trees into typed module records
+# ---------------------------------------------------------------------------
+
+def _is_module_leaf(d: Mapping[str, Any]) -> bool:
+    return any(not isinstance(v, Mapping) for v in d.values())
+
+
+def _walk_modules(tree: Mapping[str, Any], path: str) -> Iterator[tuple[str, Any]]:
+    """Yield (dotted-path, leaf-module dict) in insertion (= creation) order."""
+    for key, value in tree.items():
+        sub = f"{path}.{key}" if path else key
+        if isinstance(value, Mapping) and value:
+            if _is_module_leaf(value):
+                yield sub, value
+            else:
+                yield from _walk_modules(value, sub)
+
+
+def _flax_records(model: StagedModel, params: Params, state: State) -> list[dict]:
+    """Typed records for every conv/dense/norm module across the units, in
+    execution order, each carrying setters into (new_params, new_state)."""
+    records = []
+    for i in range(model.num_units):
+        for path, leaves in _walk_modules(params[i], f"unit{i}"):
+            if "kernel" in leaves:
+                kind = "conv" if np.ndim(leaves["kernel"]) == 4 else "linear"
+            elif "scale" in leaves or "bias" in leaves:
+                kind = "norm"
+            else:
+                raise ValueError(
+                    f"cannot classify flax module {path!r} with leaves "
+                    f"{list(leaves)}")
+            records.append({"name": path, "kind": kind, "unit": i,
+                            "params": leaves, "stats": None})
+        for path, leaves in _walk_modules(state[i], f"unit{i}"):
+            # Attach running stats to the norm record of the same path.
+            for rec in records:
+                if rec["name"] == path and rec["kind"] == "norm":
+                    rec["stats"] = leaves
+                    break
+            else:
+                raise ValueError(f"batch_stats at {path!r} with no matching "
+                                 f"norm params")
+    return records
+
+
+# ---------------------------------------------------------------------------
+# pairing + conversion
+# ---------------------------------------------------------------------------
+
+def _convert(torch_rec: dict, flax_rec: dict) -> tuple[dict, dict | None]:
+    """Convert one torch module's tensors into the flax record's layout.
+    Returns (new_params_leaves, new_stats_leaves | None)."""
+    t = torch_rec["tensors"]
+    f = flax_rec["params"]
+
+    def check(name, got, want):
+        if tuple(got.shape) != tuple(np.shape(want)):
+            raise ValueError(
+                f"shape mismatch importing torch {torch_rec['name']!r} -> "
+                f"flax {flax_rec['name']!r} ({name}): converted "
+                f"{tuple(got.shape)} vs expected {tuple(np.shape(want))} — "
+                f"the walks are misaligned or the architectures differ")
+        return jnp.asarray(got, dtype=np.asarray(want).dtype)
+
+    if flax_rec["kind"] == "conv":
+        out = {"kernel": check("kernel", t["weight"].transpose(2, 3, 1, 0),
+                               f["kernel"])}
+        if "bias" in f:
+            if "bias" not in t:
+                raise ValueError(
+                    f"flax conv {flax_rec['name']!r} has a bias but torch "
+                    f"{torch_rec['name']!r} does not")
+            out["bias"] = check("bias", t["bias"], f["bias"])
+        return out, None
+    def require(leaf):
+        if leaf not in t:
+            raise ValueError(
+                f"flax module {flax_rec['name']!r} has a {leaf!r} but torch "
+                f"{torch_rec['name']!r} does not (keys: {sorted(t)})")
+        return t[leaf]
+
+    if flax_rec["kind"] == "linear":
+        out = {"kernel": check("kernel", t["weight"].T, f["kernel"])}
+        if "bias" in f:
+            out["bias"] = check("bias", require("bias"), f["bias"])
+        return out, None
+    # norm
+    out = {}
+    if "scale" in f:
+        out["scale"] = check("scale", require("weight"), f["scale"])
+    if "bias" in f:
+        out["bias"] = check("bias", require("bias"), f["bias"])
+    stats = None
+    if flax_rec["stats"] is not None:
+        stats = {"mean": check("mean", t["running_mean"],
+                               flax_rec["stats"]["mean"]),
+                 "var": check("var", t["running_var"],
+                              flax_rec["stats"]["var"])}
+    return out, stats
+
+
+def _set_path(tree: dict, path: list[str], leaves: dict) -> dict:
+    """Functionally replace the dict at ``path`` inside ``tree``."""
+    if not path:
+        return {**tree, **leaves}
+    head, *rest = path
+    return {**tree, head: _set_path(tree[head], rest, leaves)}
+
+
+def from_torch_state_dict(model: StagedModel, params: Params, state: State,
+                          state_dict: Mapping[str, Any]) -> tuple[Params, State]:
+    """Map a torch ``state_dict`` onto staged flax trees.
+
+    ``params``/``state`` are the target trees (e.g. fresh ``model.init``
+    output) — they fix the expected module order, shapes, and dtypes.
+    Returns new ``(params, state)`` with every conv/linear/norm leaf
+    replaced by the converted torch weights. Raises ``ValueError`` with
+    both module names on any count, kind, or shape mismatch.
+
+    ``module.``-prefixed keys (torch ``DataParallel`` wrappers, as the
+    reference's checkpoints carry) are stripped automatically.
+    """
+    state_dict = strip_prefix(dict(state_dict))
+    torch_recs = _torch_records(state_dict)
+    flax_recs = _flax_records(model, params, state)
+    if len(torch_recs) != len(flax_recs):
+        t_names = [f"{r['kind']}:{r['name']}" for r in torch_recs]
+        f_names = [f"{r['kind']}:{r['name']}" for r in flax_recs]
+        raise ValueError(
+            f"module count mismatch: torch state_dict has {len(torch_recs)} "
+            f"conv/linear/norm modules, the staged model has "
+            f"{len(flax_recs)}.\n torch: {t_names}\n flax: {f_names}")
+
+    new_params = [dict(p) if isinstance(p, Mapping) else p for p in params]
+    new_state = [dict(s) if isinstance(s, Mapping) else s for s in state]
+    for t_rec, f_rec in zip(torch_recs, flax_recs):
+        if t_rec["kind"] != f_rec["kind"]:
+            raise ValueError(
+                f"module kind mismatch at torch {t_rec['name']!r} "
+                f"({t_rec['kind']}) vs flax {f_rec['name']!r} "
+                f"({f_rec['kind']}) — the walks are misaligned")
+        leaves, stats = _convert(t_rec, f_rec)
+        unit = f_rec["unit"]
+        # Path inside the unit subtree (strip the synthetic "unitN" head).
+        rel = f_rec["name"].split(".")[1:]
+        new_params[unit] = _set_path(new_params[unit], rel, leaves)
+        if stats is not None:
+            new_state[unit] = _set_path(new_state[unit], rel, stats)
+    return tuple(new_params), tuple(new_state)
+
+
+def load_torch_checkpoint(path: str) -> dict[str, Any]:
+    """Read a torch checkpoint file and return its weight ``state_dict``.
+
+    Accepts both a bare ``state_dict`` and the reference's wrapped format
+    ``{'net': state_dict, 'acc': ..., 'epoch': ...}`` (reference
+    ``data_parallel.py:84-87``; also tries the common ``'state_dict'`` /
+    ``'model'`` wrapper keys). torch is imported lazily — the framework has
+    no hard torch dependency.
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, Mapping) and not any(
+            hasattr(v, "detach") or isinstance(v, np.ndarray)
+            for v in obj.values()):
+        for key in ("net", "state_dict", "model"):
+            if key in obj:
+                return dict(obj[key])
+        raise ValueError(
+            f"checkpoint at {path!r} has no tensor values and none of the "
+            f"known wrapper keys ('net', 'state_dict', 'model'); keys: "
+            f"{list(obj)[:10]}")
+    return dict(obj)

@@ -252,7 +252,8 @@ def _attention(q, k, v, cfg: TransformerConfig):
             raise ValueError(
                 "attn_window is not supported with sequence parallelism")
         if cfg.sp_impl == "ring":
-            return ring_attention(q, k, v, cfg.sp_axis, causal=True)
+            return ring_attention(q, k, v, cfg.sp_axis, causal=True,
+                                  impl=cfg.attn_impl)
         from distributed_model_parallel_tpu.ops.ring_attention import (
             ulysses_attention,
         )

@@ -107,7 +107,8 @@ def plan_buckets(tree: Any, bucket_bytes: int = 25 * 1024 * 1024
 
 def bucketed_psum(tree: Any, axis_name: str, *,
                   bucket_bytes: int = 25 * 1024 * 1024,
-                  mean: bool = True, reduce_fn: Any = None) -> Any:
+                  mean: bool = True, reduce_fn: Any = None,
+                  accum_dtype: Any = None) -> Any:
     """Allreduce a gradient pytree in flat coalesced buckets.
 
     Each bucket is flattened+concatenated into one vector, reduced with a
@@ -119,11 +120,16 @@ def bucketed_psum(tree: Any, axis_name: str, *,
     ``lax.psum``; see ``ops/ring_reduce.ring_psum_tree`` for the explicit
     ring).
 
-    Each bucket is flattened in its own *promoted leaf dtype* (bf16
-    gradients reduce as bf16, like torch DDP; a stray f32 leaf upcasts only
-    its own bucket) so the wire payload matches the per-leaf ``psum``
-    transport byte-for-byte — a global f32 upcast would move 2x the bytes
-    and confound transport comparisons.
+    Reduction dtype: by default each bucket is flattened in its own
+    *promoted leaf dtype* (bf16 gradients reduce as bf16, like torch DDP; a
+    stray f32 leaf upcasts only its own bucket) so the wire payload matches
+    the per-leaf ``psum`` transport byte-for-byte. Note the conflation this
+    implies: the accumulation across replicas then also happens at bf16
+    precision, and the error grows with replica count. ``accum_dtype=
+    jnp.float32`` decouples them — reduce (and mean-divide) in f32,
+    downcast to the leaf dtype after — at the cost of a 2x wire payload
+    for bf16 buckets (the XLA collective carries the accumulation dtype);
+    the same trade torch DDP exposes via fp32-reduce comm hooks.
     """
     if reduce_fn is None:
         reduce_fn = jax.lax.psum
@@ -131,7 +137,8 @@ def bucketed_psum(tree: Any, axis_name: str, *,
     n = jax.lax.psum(1, axis_name) if mean else 1
     out: list[Any] = [None] * len(leaves)
     for bucket in plan_buckets(tree, bucket_bytes):
-        wire_dtype = jnp.result_type(*(leaves[i] for i in bucket))
+        wire_dtype = (jnp.dtype(accum_dtype) if accum_dtype is not None
+                      else jnp.result_type(*(leaves[i] for i in bucket)))
         flat = jnp.concatenate(
             [leaves[i].astype(wire_dtype).reshape(-1) for i in bucket])
         red = reduce_fn(flat, axis_name)

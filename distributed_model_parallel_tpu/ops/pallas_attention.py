@@ -7,6 +7,16 @@ scores live tile-by-tile in VMEM, the MXU does the matmuls, and only O([T, D])
 touches HBM. Composes with ring attention (ops/ring_attention.py) which
 handles the *cross-chip* blocking; this kernel is the *on-chip* blocking.
 
+All three kernels stream K/V (or Q, for dk/dv) through VMEM one block per
+grid step: the key/query sequence is a *grid dimension*, not a whole-sequence
+VMEM block, so Mosaic double-buffers the next block's DMA against the current
+block's MXU work and VMEM usage is O(block), independent of sequence length.
+The online-softmax running state (m, l, acc) is carried across those grid
+steps in f32 VMEM scratch — initialized on the first step of each row,
+flushed to the output on the last. Causal (and windowed) programs clamp their
+streaming index map to the diagonal band, so out-of-band grid steps fetch
+nothing new and `pl.when` skips their compute entirely.
+
 Backward is the FlashAttention-2 scheme: the forward also emits the per-row
 logsumexp, and two kernels recompute score tiles from (q, k, lse) to produce
 dq (grid over query blocks) and dk/dv (grid over key blocks) — so the
@@ -24,6 +34,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -44,43 +55,72 @@ def _band_start_k(qi, bq, window, block_k):
     return jnp.maximum(0, (qi * bq - window + 1) // block_k)
 
 
+def _last_k_block(qi, bq, block_k):
+    """Last K block at or below the diagonal for q block qi (causal)."""
+    return ((qi + 1) * bq - 1) // block_k
+
+
+def _kv_stream_map(causal, bq, bk, window):
+    """Index map for K/V blocks streamed over the minor grid dim. Causal
+    programs clamp j into the band [start, diag] so the out-of-band steps
+    re-map to an already-resident block — Mosaic elides the repeat DMA —
+    while `pl.when` in the kernel skips their compute."""
+    if not causal:
+        return lambda bh, i, j: (bh, j, 0)
+
+    def index(bh, i, j):
+        lo = _band_start_k(i, bq, window, bk)
+        hi = _last_k_block(i, bq, bk)
+        return (bh, jnp.clip(j, lo, hi), 0)
+
+    return index
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  seq_len: int, causal: bool, scale: float,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, num_k: int, causal: bool, scale: float,
                   window: int | None = None):
-    """Grid: (batch*heads, num_q_blocks). Blocks: q/o [1, BQ, D]; k/v [1, T, D];
-    lse [1, BQ] (per-row logsumexp of the scaled scores, for the backward).
-    ``window`` (causal only): each query attends keys in
-    (q_pos - window, q_pos] — sliding-window/local attention, with K blocks
-    entirely outside the band skipped."""
+    """Grid: (batch*heads, num_q_blocks, num_k_blocks). Blocks: q/o [1, BQ, D];
+    k/v [1, BK, D] (streamed over the minor grid dim); lse [1, 8, BQ] (per-row
+    logsumexp of the scaled scores, for the backward, broadcast over 8
+    sublanes for tile legality). Scratch: m/l [BQ, 128] f32 (sublane-major,
+    lanes redundant), acc [BQ, D] f32 — the online-softmax carry across K
+    steps. ``window`` (causal only): each
+    query attends keys in (q_pos - window, q_pos]."""
     qi = pl.program_id(1)
+    j = pl.program_id(2)
     bq = q_ref.shape[1]
-    d = q_ref.shape[2]
-    q = q_ref[0] * scale                                   # [BQ, D]
+    bk = k_ref.shape[1]
 
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    num_k = seq_len // block_k
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :]   # [BK, D]
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :]
+    def _step():
+        q = q_ref[0] * scale                               # [BQ, D]
+        k = k_ref[0]                                       # [BK, D]
+        v = v_ref[0]
+        # m/l ride sublane-major ([BQ, LW] with identical lanes) so every
+        # step's broadcasts against [BQ, BK] tiles stay on the sublane axis
+        # — no lane<->sublane relayout in the inner loop.
+        m = m_scr[...]                                     # [BQ, LW]
+        l = l_scr[...]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
         keep = None
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             keep = band_keep(q_pos, k_pos, window)
             s = jnp.where(keep, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1)[:, None])     # [BQ, LW]
+        p = jnp.exp(s - m_new[:, :1])
         if causal and window is not None:
             # A row whose every key in this block is banded out while m is
             # still at the sentinel would get exp(NEG_INF - NEG_INF) = 1;
@@ -88,30 +128,35 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
             # (the first processed block always holds each row's diagonal),
             # so the unwindowed hot path pays nothing.
             p = jnp.where(keep, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1)
-        acc_new = alpha[:, None] * acc + jnp.dot(
+        alpha = jnp.exp(m - m_new)                               # [BQ, LW]
+        l_new = alpha * l + jnp.sum(p, axis=-1)[:, None]
+        acc_scr[...] = alpha[:, :1] * acc_scr[...] + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
     if causal:
-        # Skip K blocks entirely above the diagonal: the last contributing
-        # block covers query position (qi+1)*bq - 1. A window also skips
-        # blocks entirely left of the band.
-        num_k_eff = ((qi + 1) * bq - 1) // block_k + 1
-        start_k = _band_start_k(qi, bq, window, block_k)
-        m, l, acc = jax.lax.fori_loop(start_k, num_k_eff, body,
-                                      (m0, l0, acc0))
+        # Skip K blocks entirely outside the band: above the diagonal, and
+        # (windowed) entirely left of the band. Their grid steps still run,
+        # but fetch no new block (the index map clamps) and do no compute.
+        in_band = jnp.logical_and(j >= _band_start_k(qi, bq, window, bk),
+                                  j <= _last_k_block(qi, bq, bk))
+        pl.when(in_band)(_step)
     else:
-        m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+        _step()
 
-    l_safe = jnp.where(l == 0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse rides in an (8, lane)-tiled layout: Mosaic requires the last two
-    # block dims divisible by (8, 128), so the per-row vector is broadcast
-    # over 8 sublanes (read back as row 0).
-    lse = jnp.where(l == 0, NEG_INF, m + jnp.log(l_safe))
-    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, bq))
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        l = l_scr[...]                                     # [BQ, LW]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, :1]).astype(o_ref.dtype)
+        # lse rides in an (8, lane)-tiled layout: Mosaic requires the last
+        # two block dims divisible by (8, 128), so the per-row vector is
+        # broadcast over 8 sublanes (read back as row 0). The sublane->lane
+        # relayout happens once per q row, not per K step.
+        m_col, l_col = m_scr[:, 0], l_safe[:, 0]           # [BQ]
+        lse = jnp.where(l_scr[:, 0] == 0, NEG_INF, m_col + jnp.log(l_col))
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, bq))
 
 
 # ---------------------------------------------------------------------------
@@ -119,97 +164,143 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 # ---------------------------------------------------------------------------
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, seq_len: int, causal: bool,
-                         scale: float, window: int | None = None):
-    """Grid: (batch*heads, num_q_blocks). dq_i = scale * sum_j ds_ij k_j with
-    ds = p * (dO·v^T - delta); delta = rowsum(dO * O)."""
+                         dq_ref, acc_scr, lse_scr, delta_scr, *, num_k: int,
+                         causal: bool, scale: float,
+                         window: int | None = None):
+    """Grid: (batch*heads, num_q_blocks, num_k_blocks), K/V streamed over the
+    minor dim. dq_i = scale * sum_j ds_ij k_j with ds = p * (dO·v^T - delta);
+    delta = rowsum(dO * O). Scratch: the dq accumulator [BQ, D] f32, plus
+    sublane-major copies of lse/delta ([BQ, LW]) transposed once per q row
+    so the K loop broadcasts without lane<->sublane relayouts."""
     qi = pl.program_id(1)
+    j = pl.program_id(2)
     bq = q_ref.shape[1]
-    q = q_ref[0]                                           # [BQ, D] (input
-    do = do_ref[0]                                         # dtype for MXU)
-    lse = lse_ref[0, 0]                                    # [BQ] (row 0 of
-    delta = delta_ref[0, 0]                                # the 8-sublane tile)
+    bk = k_ref.shape[1]
 
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+        lw = lse_scr.shape[1]
+        lse_scr[...] = jnp.broadcast_to(lse_ref[0, 0][:, None], (bq, lw))
+        delta_scr[...] = jnp.broadcast_to(delta_ref[0, 0][:, None], (bq, lw))
 
-    def body(j, acc):
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :]
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :]
+    def _step():
+        q = q_ref[0]                                       # [BQ, D] (input
+        do = do_ref[0]                                     # dtype for MXU)
+        lse = lse_scr[:, :1]                               # [BQ, 1]
+        delta = delta_scr[:, :1]
+        k = k_ref[0]
+        v = v_ref[0]
         s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        p = jnp.exp(s - lse[:, None])                      # [BQ, BK] f32
+        p = jnp.exp(s - lse)                               # [BQ, BK] f32
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             p = jnp.where(band_keep(q_pos, k_pos, window), p, 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        acc_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
-    num_k = seq_len // block_k
     if causal:
-        num_k_eff = ((qi + 1) * bq - 1) // block_k + 1
-        start_k = _band_start_k(qi, bq, window, block_k)
-        acc = jax.lax.fori_loop(start_k, num_k_eff, body, acc0)
+        in_band = jnp.logical_and(j >= _band_start_k(qi, bq, window, bk),
+                                  j <= _last_k_block(qi, bq, bk))
+        pl.when(in_band)(_step)
     else:
-        acc = jax.lax.fori_loop(0, num_k, body, acc0)
-    dq_ref[0] = acc.astype(dq_ref.dtype)
+        _step()
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _q_bounds_for_k(ki, bk, bq, num_q, causal, window):
+    """[start, end) of query blocks attending any key in key block ki."""
+    if not causal:
+        return 0, num_q
+    start_q = (ki * bk) // bq
+    if window is None:
+        return start_q, num_q
+    # Last query that can see any key in this block attends the block's
+    # last key ((ki+1)*bk - 1) from window - 1 positions later.
+    end_q = jnp.minimum(num_q, ((ki + 1) * bk - 1 + window - 1) // bq + 1)
+    return start_q, end_q
+
+
+def _q_stream_map(causal, bq, bk, num_q, window):
+    """Index map for Q/dO (and lse/delta via ``lane_row``) blocks streamed
+    over the dk/dv kernel's minor grid dim, clamped to the band like
+    ``_kv_stream_map``."""
+    if not causal:
+        return lambda bh, ki, i: (bh, i, 0)
+
+    def index(bh, ki, i):
+        lo, hi = _q_bounds_for_k(ki, bk, bq, num_q, causal, window)
+        return (bh, jnp.clip(i, lo, hi - 1), 0)
+
+    return index
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, seq_len: int,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, num_q: int,
                           causal: bool, scale: float,
                           window: int | None = None):
-    """Grid: (batch*heads, num_k_blocks). dv_j = sum_i p_ij dO_i;
-    dk_j = scale * sum_i ds_ij q_i. Causal skips query blocks strictly above
-    the diagonal (queries before this key block attend none of it); a
-    window also skips query blocks past the band's lower edge."""
+    """Grid: (batch*heads, num_k_blocks, num_q_blocks), Q/dO/lse/delta
+    streamed over the minor dim. dv_j = sum_i p_ij dO_i; dk_j = scale *
+    sum_i ds_ij q_i. Scratch: dk/dv accumulators [BK, D] f32. Causal skips
+    query blocks strictly above the diagonal (queries before this key block
+    attend none of it); a window also skips query blocks past the band's
+    lower edge."""
     ki = pl.program_id(1)
+    i = pl.program_id(2)
     bk = k_ref.shape[1]
-    k = k_ref[0]                                           # [BK, D] (input
-    v = v_ref[0]                                           # dtype for MXU)
+    bq = q_ref.shape[1]
 
-    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-    d = k.shape[1]
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :]
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)]
-        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        p = jnp.exp(s - lse[:, None])                      # [BQ, BK] f32
+    # The whole step works in transposed score space — s^T [BK, BQ], keys on
+    # sublanes, queries on lanes — so the per-query lse/delta vectors (which
+    # arrive lane-major) broadcast along sublanes for free, and dk/dv land
+    # sublane-major [BK, D] straight from the MXU. No lane<->sublane
+    # relayout anywhere in the Q loop.
+    def _step():
+        k = k_ref[0]                                       # [BK, D] (input
+        v = v_ref[0]                                       # dtype for MXU)
+        q = q_ref[0]                                       # [BQ, D]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]                                # [BQ] lane-major
+        delta = delta_ref[0, 0]
+        contract_d = (((1,), (1,)), ((), ()))
+        s_t = scale * jax.lax.dot_general(                 # [BK, BQ]
+            k, q, contract_d, preferred_element_type=jnp.float32)
+        p_t = jnp.exp(s_t - lse[None, :])                  # [BK, BQ] f32
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            p = jnp.where(band_keep(q_pos, k_pos, window), p, 0.0)
-        pc = p.astype(do.dtype)
-        dv = dv + jnp.dot(pc.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bk, bq), 0)
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bk, bq), 1)
+            p_t = jnp.where(band_keep(q_pos, k_pos, window), p_t, 0.0)
+        pc_t = p_t.astype(do.dtype)
+        dv_scr[...] += jnp.dot(pc_t, do, preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(                        # [BK, BQ]
+            v, do, contract_d, preferred_element_type=jnp.float32)
+        ds_t = (p_t * (dp_t - delta[None, :]) * scale).astype(q.dtype)
+        dk_scr[...] += jnp.dot(ds_t, q, preferred_element_type=jnp.float32)
 
-    num_q = seq_len // block_q
     if causal:
-        # First query block intersecting the diagonal for this key block.
-        start_q = (ki * bk) // block_q
-        if window is None:
-            end_q = num_q
-        else:
-            # Last query that can see any key in this block attends the
-            # block's last key ((ki+1)*bk - 1) from window - 1 positions
-            # later.
-            end_q = jnp.minimum(
-                num_q, ((ki + 1) * bk - 1 + window - 1) // block_q + 1)
-        dk, dv = jax.lax.fori_loop(start_q, end_q, body, (dk0, dv0))
+        lo, hi = _q_bounds_for_k(ki, bk, bq, num_q, causal, window)
+        pl.when(jnp.logical_and(i >= lo, i < hi))(_step)
     else:
-        dk, dv = jax.lax.fori_loop(0, num_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        _step()
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +357,12 @@ def _unpad_bthd(x, b, h, t, d):
     return x[:, :t, :, :d]
 
 
+_SEQ_SEMANTICS = ("parallel", "parallel", "arbitrary")
+# Lane width of the sublane-major [BQ, _LANE_W] m/l/lse/delta scratch tiles
+# (all 128 lanes carry the same per-row value; column 0 is read back).
+_LANE_W = 128
+
+
 def _flash_impl(q, k, v, causal, block_q, block_k, interpret, window=None):
     """Run the forward kernel; returns (o [B,T,H,D], lse [B*H, T_pad] f32)
     — lse stays in the padded flat layout for the backward (which re-tiles
@@ -274,25 +371,34 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret, window=None):
     t_pad, d_pad, bq, bk, interp = _plan(t, d, causal, block_q, block_k,
                                          interpret)
     scale = d ** -0.5
+    num_k = t_pad // bk
     qf, kf, vf = (_pad_bhtd(x, t_pad, d_pad) for x in (q, k, v))
-    kernel = functools.partial(_flash_kernel, block_k=bk, seq_len=t_pad,
-                               causal=causal, scale=scale, window=window)
+    kernel = functools.partial(_flash_kernel, num_k=num_k, causal=causal,
+                               scale=scale, window=window)
+    kv_map = _kv_stream_map(causal, bq, bk, window)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, t_pad // bq),
+        grid=(b * h, t_pad // bq, num_k),
         in_specs=[
-            pl.BlockSpec((1, bq, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t_pad, d_pad), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t_pad, d_pad), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d_pad), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d_pad), kv_map),
+            pl.BlockSpec((1, bk, d_pad), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, 8, bq), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((1, bq, d_pad), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda bh, i, j: (bh, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_pad, d_pad), q.dtype),
             jax.ShapeDtypeStruct((b * h, 8, t_pad), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANE_W), jnp.float32),
+            pltpu.VMEM((bq, _LANE_W), jnp.float32),
+            pltpu.VMEM((bq, d_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=_SEQ_SEMANTICS),
         interpret=interp,
     )(qf, kf, vf)
     # Keep only sublane row 0 as the residual (the 8 rows are identical
@@ -307,6 +413,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
     t_pad, d_pad, bq, bk, interp = _plan(t, d, causal, block_q, block_k,
                                          interpret)
     scale = d ** -0.5
+    num_q, num_k = t_pad // bq, t_pad // bk
     # delta = rowsum(dO * O) — tiny elementwise pass in plain XLA. Padded
     # rows get delta 0 and g 0, so they contribute nothing below. Tiled to
     # 8 sublanes like lse (Mosaic block-layout requirement).
@@ -314,47 +421,63 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
     delta = delta.transpose(0, 2, 1).reshape(b * h, t)
     if t_pad != t:
         delta = jnp.pad(delta, [(0, 0), (0, t_pad - t)])
+    if lse.shape[1] != t_pad:
+        # Callers holding only the real-T lse (the ring composition slices
+        # padding off): pad with 0 — padded rows have zero cotangents, so
+        # any finite lse keeps their p finite and their contributions zero.
+        lse = jnp.pad(lse, [(0, 0), (0, t_pad - lse.shape[1])])
     delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, t_pad))
     lse = jnp.broadcast_to(lse[:, None, :], (b * h, 8, t_pad))
     qf, kf, vf, gf = (_pad_bhtd(x, t_pad, d_pad) for x in (q, k, v, g))
 
-    common = dict(seq_len=t_pad, causal=causal, scale=scale, window=window)
-    row_spec = pl.BlockSpec((1, t_pad, d_pad), lambda bh, i: (bh, 0, 0))
-    vec_spec = pl.BlockSpec((1, 8, t_pad), lambda bh, i: (bh, 0, 0))
+    common = dict(causal=causal, scale=scale, window=window)
+    q_row_spec = pl.BlockSpec((1, bq, d_pad), lambda bh, i, j: (bh, i, 0))
+    q_vec_spec = pl.BlockSpec((1, 8, bq), lambda bh, i, j: (bh, 0, i))
+    kv_map = _kv_stream_map(causal, bq, bk, window)
+    kv_spec = pl.BlockSpec((1, bk, d_pad), kv_map)
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=bk, **common),
-        grid=(b * h, t_pad // bq),
+        functools.partial(_flash_bwd_dq_kernel, num_k=num_k, **common),
+        grid=(b * h, num_q, num_k),
         in_specs=[
-            pl.BlockSpec((1, bq, d_pad), lambda bh, i: (bh, i, 0)),
-            row_spec, row_spec,
-            # dO is per-query-row: blocked like q, not full-T.
-            pl.BlockSpec((1, bq, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, 8, bq), lambda bh, i: (bh, 0, i)),
-            pl.BlockSpec((1, 8, bq), lambda bh, i: (bh, 0, i)),
+            q_row_spec, kv_spec, kv_spec,
+            # dO is per-query-row: blocked like q.
+            q_row_spec, q_vec_spec, q_vec_spec,
         ],
-        out_specs=pl.BlockSpec((1, bq, d_pad), lambda bh, i: (bh, i, 0)),
+        out_specs=q_row_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d_pad), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32),
+                        pltpu.VMEM((bq, _LANE_W), jnp.float32),
+                        pltpu.VMEM((bq, _LANE_W), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=_SEQ_SEMANTICS),
         interpret=interp,
     )(qf, kf, vf, gf, lse, delta)
 
+    q_map = _q_stream_map(causal, bq, bk, num_q, window)
+    q_stream_spec = pl.BlockSpec((1, bq, d_pad), q_map)
+    vec_stream_spec = pl.BlockSpec(
+        (1, 8, bq), lambda bh, ki, i: (bh, 0, q_map(bh, ki, i)[1]))
+    k_blk_spec = pl.BlockSpec((1, bk, d_pad), lambda bh, ki, i: (bh, ki, 0))
+
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=bq, **common),
-        grid=(b * h, t_pad // bk),
+        functools.partial(_flash_bwd_dkv_kernel, num_q=num_q, **common),
+        grid=(b * h, num_k, num_q),
         in_specs=[
-            row_spec,
-            pl.BlockSpec((1, bk, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bk, d_pad), lambda bh, i: (bh, i, 0)),
-            row_spec, vec_spec, vec_spec,
+            q_stream_spec, k_blk_spec, k_blk_spec,
+            q_stream_spec, vec_stream_spec, vec_stream_spec,
         ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bk, d_pad), lambda bh, i: (bh, i, 0)),
-        ],
+        out_specs=[k_blk_spec, k_blk_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_pad, d_pad), k.dtype),
             jax.ShapeDtypeStruct((b * h, t_pad, d_pad), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d_pad), jnp.float32),
+            pltpu.VMEM((bk, d_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=_SEQ_SEMANTICS),
         interpret=interp,
     )(qf, kf, vf, gf, lse, delta)
 
@@ -411,21 +534,21 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # slower than XLA's fused attention.
 #
 # min_seq: crossover sequence length per compute dtype; None = never
-#   auto-select for that dtype. bf16 head-dim 64: flash wins from 2048
-#   (3.4x) and 10x at 4096; head-dim 128 crosses earlier (1024) but 2048
-#   is kept as the single safe threshold. float32 is None NOT for speed —
-#   the kernel's MXU passes accumulate at bf16-input precision (measured
+#   auto-select for that dtype. bf16 crossover 1024 (streamed-K/V kernel,
+#   r3 sweep: 0.17 vs 0.40 ms at hd 64, 0.16 vs 0.41 ms at hd 128; at 512
+#   XLA still wins ~2x). float32 is None NOT for speed — the
+#   kernel's MXU passes accumulate at bf16-input precision (measured
 #   ~8e-3 abs error on unit-scale f32 inputs vs true-f32 XLA attention,
 #   i.e. bf16-class), so auto-dispatch would silently degrade f32
 #   attention; forcing attn_impl="flash" remains available and documented.
 # block_q/block_k: fastest measured tile shape (clamped to seq at call
-#   time; 512x1024 measured ~6x over 128x128 at seq 2-4k on v5e).
+#   time).
 # max_head_dim: the kernel keeps [block, D] tiles resident in VMEM; above
 #   this, tiles spill and XLA wins regardless of seq.
 _DISPATCH_TABLE: dict[str, dict] = {
-    "TPU v5 lite": {"min_seq": {"bfloat16": 2048, "float32": None},
+    "TPU v5 lite": {"min_seq": {"bfloat16": 1024, "float32": None},
                     "block_q": 512, "block_k": 1024, "max_head_dim": 256},
-    "tpu": {"min_seq": {"bfloat16": 2048, "float32": None},
+    "tpu": {"min_seq": {"bfloat16": 1024, "float32": None},
             "block_q": 512, "block_k": 1024, "max_head_dim": 256},
 }
 
@@ -493,24 +616,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     ``interpret=None`` auto-selects interpret mode off-TPU. Default block
     sizes (``block_q``/``block_k`` = None) come from the per-platform
-    dispatch table (``dispatch_entry``; on v5e 512x1024, measured ~6x
-    faster than 128x128 at seq 2-4k: 63 vs 9 TFLOPS at seq 2048; blocks
-    clamp to the sequence length for short inputs). Beats plain XLA
-    attention from seq ~2048 up, and still compiles at seq 8192 where the
-    materialized T^2 score tensor makes XLA fail.
+    dispatch table (``dispatch_entry``; blocks clamp to the sequence length
+    for short inputs).
 
-    Single-chip sequence ceiling: the backward's dk/dv accumulators are
-    held full-T in VMEM per (batch, head) program, which exceeds the v5e's
-    16 MB scoped VMEM around T=16384 (measured: 19.5 MB requested). Longer
-    sequences on one chip need the FlashAttention-2 k-block grid for dk/dv
-    (one program per key block, looping query blocks — planned rework);
-    today the supported long-context route past 8k is sequence parallelism
-    over the ``seq`` mesh axis (ops/ring_attention.py), which shards T
-    before the kernel runs.
+    K/V stream through VMEM one block per grid step (the sequence is a grid
+    dimension, not a resident VMEM block), so per-program VMEM is O(block)
+    and the sequence ceiling is set by HBM, not VMEM — seq 32k+ compiles
+    and runs on a single v5e in both directions. Past the single-chip HBM
+    budget, the long-context route is sequence parallelism over the ``seq``
+    mesh axis (ops/ring_attention.py), which shards T before the kernel
+    runs.
 
     ``window=W`` (causal only) restricts each query to the last W keys —
     sliding-window/local attention. Both directions skip blocks entirely
-    outside the band, so compute drops from O(T^2) toward O(T*W).
+    outside the band (no DMA, no compute), so cost drops from O(T^2)
+    toward O(T*W).
 
     Differentiable via a custom VJP: the FlashAttention-2 backward kernels
     recompute score tiles from the saved logsumexp, so neither direction

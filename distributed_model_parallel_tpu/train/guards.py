@@ -98,3 +98,66 @@ class StallDetector:
 
     def step(self) -> "_Ctx":
         return self._Ctx(self)
+
+
+class GuardRunner:
+    """Config-driven guard harness the trainers wire in (off by default).
+
+    ``TrainConfig.check_finite_every=N`` turns on finiteness checking: every
+    drained metrics window is checked (those values are already on host — the
+    check is free), and every N steps the parameters are fetched and checked
+    too (a device→host sync, hence the coarser, explicit cadence).
+    ``TrainConfig.stall_budget_s=S`` arms the StallDetector around every
+    blocking drain; an overrun logs loudly but does not raise — wall-clock
+    slowness can be transport noise, while NaN is always a bug.
+    """
+
+    def __init__(self, *, check_finite_every: int = 0,
+                 stall_budget_s: float | None = None, logger=None):
+        self.every = check_finite_every
+        self.stall = (StallDetector(stall_budget_s)
+                      if stall_budget_s else None)
+        self.logger = logger
+        self._seen = 0
+        self._next_params_check = check_finite_every
+
+    @property
+    def enabled(self) -> bool:
+        return self.every > 0 or self.stall is not None
+
+    def watch(self):
+        """Context manager wrapping a blocking sync point."""
+        import contextlib
+
+        if self.stall is None:
+            return contextlib.nullcontext()
+        return self._watched()
+
+    def _watched(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            was_stalled = self.stall.stalled
+            with self.stall.step():
+                yield
+            if self.stall.stalled and not was_stalled:
+                msg = (f"guard: sync exceeded the stall budget "
+                       f"({self.stall.worst_s:.1f}s > "
+                       f"{self.stall.budget_s:.1f}s)")
+                if self.logger is not None:
+                    self.logger.log_line(msg)
+        return ctx()
+
+    def after_sync(self, host_metrics: Any, n_steps: int,
+                   params: Any = None) -> None:
+        """Run after a drain: ``host_metrics`` are the already-fetched
+        values (checked every time), ``params`` the live model params
+        (checked when the step counter crosses the N-step cadence)."""
+        if self.every <= 0:
+            return
+        check_finite(host_metrics, name="metrics")
+        self._seen += n_steps
+        if params is not None and self._seen >= self._next_params_check:
+            self._next_params_check = self._seen + self.every
+            check_finite(params, name="params")

@@ -65,6 +65,9 @@ class LMTrainConfig:
     log_name: str = "lm"
     checkpoint_dir: str = "./checkpoint"
     resume: bool = False
+    # Guards (train/guards.py:GuardRunner) — same semantics as TrainConfig.
+    check_finite_every: int = 0
+    stall_budget_s: float | None = None
 
 
 class LMTrainer:
@@ -99,6 +102,11 @@ class LMTrainer:
 
         self.preemption = PreemptionGuard()
         self.logger = RunLogger(config.log_dir, config.log_name)
+        from distributed_model_parallel_tpu.train.guards import GuardRunner
+
+        self.guards = GuardRunner(
+            check_finite_every=config.check_finite_every,
+            stall_budget_s=config.stall_budget_s, logger=self.logger)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.start_epoch = 0
         if config.resume and (self.ckpt.exists("lm")
@@ -144,7 +152,12 @@ class LMTrainer:
                     self.params, self.opt_state, loss = self._step(
                         self.params, self.opt_state, jnp.asarray(toks),
                         jnp.asarray(tgts))
-                    meter.update(float(loss))
+                    with self.guards.watch():
+                        loss_host = float(loss)     # the per-step sync point
+                    if self.guards.enabled:
+                        self.guards.after_sync({"loss": loss_host}, 1,
+                                               params=self.params)
+                    meter.update(loss_host)
                     timer.step_done()
                 if self.preemption.requested():
                     # Partial epoch: save for resume at this epoch and stop

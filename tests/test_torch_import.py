@@ -1,0 +1,213 @@
+"""Torch state_dict -> staged flax import round-trip tests.
+
+The torch twin models here are built in torch with the *same architecture*
+as the staged flax models, then their random-initialized weights are
+imported and forward outputs compared. Spatial sizes are odd (17x17) so
+XLA's SAME padding and torch's symmetric padding=1 agree at stride-2 convs
+(for even sizes torch pads (1,1) where SAME pads (0,1) — a window-alignment
+difference documented in models/torch_import.py).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_model_parallel_tpu.models.mobilenetv2 import (  # noqa: E402
+    CFG,
+    build_mobilenetv2,
+)
+from distributed_model_parallel_tpu.models.torch_import import (  # noqa: E402
+    from_torch_state_dict,
+    load_torch_checkpoint,
+    strip_prefix,
+)
+
+
+class TorchInvertedResidual(tnn.Module):
+    """Torch twin of models/mobilenetv2.InvertedResidual: expand 1x1 ->
+    depthwise 3x3 -> project 1x1, BN after each, residual iff stride 1,
+    projected shortcut when channels change. Registration order matches the
+    flax module's creation order (main path, then shortcut)."""
+
+    def __init__(self, in_ch, expansion, out_ch, stride):
+        super().__init__()
+        hidden = in_ch * expansion
+        self.expand = tnn.Conv2d(in_ch, hidden, 1, bias=False)
+        self.expand_bn = tnn.BatchNorm2d(hidden)
+        self.depthwise = tnn.Conv2d(hidden, hidden, 3, stride=stride,
+                                    padding=1, groups=hidden, bias=False)
+        self.depthwise_bn = tnn.BatchNorm2d(hidden)
+        self.project = tnn.Conv2d(hidden, out_ch, 1, bias=False)
+        self.project_bn = tnn.BatchNorm2d(out_ch)
+        self.use_res = stride == 1
+        if self.use_res and in_ch != out_ch:
+            self.shortcut = tnn.Conv2d(in_ch, out_ch, 1, bias=False)
+            self.shortcut_bn = tnn.BatchNorm2d(out_ch)
+
+    def forward(self, x):
+        y = torch.relu(self.expand_bn(self.expand(x)))
+        y = torch.relu(self.depthwise_bn(self.depthwise(y)))
+        y = self.project_bn(self.project(y))
+        if self.use_res:
+            sc = x
+            if hasattr(self, "shortcut"):
+                sc = self.shortcut_bn(self.shortcut(sc))
+            y = y + sc
+        return y
+
+
+class TorchMobileNetV2(tnn.Module):
+    """Torch twin of the 19-unit staged MobileNetV2 (stem, 17 blocks, head)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.stem = tnn.Conv2d(3, 32, 3, stride=1, padding=1, bias=False)
+        self.stem_bn = tnn.BatchNorm2d(32)
+        blocks = []
+        in_ch = 32
+        for expansion, out_ch, num_blocks, stride in CFG:
+            for b in range(num_blocks):
+                blocks.append(TorchInvertedResidual(
+                    in_ch, expansion, out_ch, stride if b == 0 else 1))
+                in_ch = out_ch
+        self.blocks = tnn.Sequential(*blocks)
+        self.head_conv = tnn.Conv2d(in_ch, 1280, 1, bias=False)
+        self.head_bn = tnn.BatchNorm2d(1280)
+        self.linear = tnn.Linear(1280, num_classes)
+
+    def forward(self, x):
+        x = torch.relu(self.stem_bn(self.stem(x)))
+        x = self.blocks(x)
+        x = torch.relu(self.head_bn(self.head_conv(x)))
+        x = x.mean(dim=(2, 3))
+        return self.linear(x)
+
+
+def _randomize_bn_stats(model):
+    """Give BN running stats non-trivial values so the import is actually
+    exercised (fresh stats are mean 0 / var 1 on both sides)."""
+    gen = torch.Generator().manual_seed(7)
+    for mod in model.modules():
+        if isinstance(mod, tnn.BatchNorm2d):
+            mod.running_mean.copy_(
+                torch.randn(mod.running_mean.shape, generator=gen) * 0.1)
+            mod.running_var.copy_(
+                1.0 + 0.2 * torch.rand(mod.running_var.shape, generator=gen))
+            mod.weight.data.copy_(
+                1.0 + 0.1 * torch.randn(mod.weight.shape, generator=gen))
+            mod.bias.data.copy_(
+                0.1 * torch.randn(mod.bias.shape, generator=gen))
+
+
+def test_mobilenetv2_round_trip_forward_parity():
+    tmodel = TorchMobileNetV2()
+    with torch.no_grad():
+        _randomize_bn_stats(tmodel)
+    tmodel.eval()
+
+    fmodel = build_mobilenetv2(num_classes=10)
+    sample = jnp.zeros((2, 17, 17, 3), jnp.float32)
+    params, state = fmodel.init(jax.random.key(0), sample)
+    params, state = from_torch_state_dict(fmodel, params, state,
+                                          tmodel.state_dict())
+
+    x = np.random.default_rng(3).normal(size=(2, 17, 17, 3)).astype(np.float32)
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    f_out, _ = fmodel.apply(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(f_out), t_out, atol=2e-4, rtol=2e-3)
+
+
+def test_nobn_variant_imports_conv_biases():
+    class TorchStemHead(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(3, 8, 3, padding=1, bias=True)
+            self.linear = tnn.Linear(8, 4)
+
+        def forward(self, x):
+            x = torch.relu(self.conv(x))
+            x = x.mean(dim=(2, 3))
+            return self.linear(x)
+
+    from distributed_model_parallel_tpu.models.layers import (
+        ClassifierHead,
+        ConvUnit,
+    )
+    from distributed_model_parallel_tpu.models.staged import StagedModel
+
+    fmodel = StagedModel(units=(
+        ConvUnit(ops=({"features": 8, "kernel": 3, "stride": 1},),
+                 bn_mode="none"),
+        ClassifierHead(num_classes=4, conv_features=None, bn_mode="none"),
+    ), name="tiny_nobn")
+    sample = jnp.zeros((2, 9, 9, 3), jnp.float32)
+    params, state = fmodel.init(jax.random.key(0), sample)
+
+    tmodel = TorchStemHead().eval()
+    params, state = from_torch_state_dict(fmodel, params, state,
+                                          tmodel.state_dict())
+    x = np.random.default_rng(0).normal(size=(2, 9, 9, 3)).astype(np.float32)
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    f_out, _ = fmodel.apply(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(f_out), t_out, atol=1e-5, rtol=1e-4)
+
+
+def test_architecture_mismatch_raises():
+    fmodel = build_mobilenetv2(num_classes=10)
+    sample = jnp.zeros((1, 17, 17, 3), jnp.float32)
+    params, state = fmodel.init(jax.random.key(0), sample)
+
+    class Tiny(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(3, 4, 3)
+
+    with pytest.raises(ValueError, match="count mismatch"):
+        from_torch_state_dict(fmodel, params, state, Tiny().state_dict())
+
+
+def test_shape_mismatch_raises_with_names():
+    from distributed_model_parallel_tpu.models.layers import ConvUnit
+    from distributed_model_parallel_tpu.models.staged import StagedModel
+
+    fmodel = StagedModel(units=(
+        ConvUnit(ops=({"features": 8, "kernel": 3},), bn_mode="none"),
+    ))
+    sample = jnp.zeros((1, 9, 9, 3), jnp.float32)
+    params, state = fmodel.init(jax.random.key(0), sample)
+    wrong = tnn.Conv2d(3, 16, 3)  # 16 out-channels, flax expects 8
+    sd = {"conv.weight": wrong.weight, "conv.bias": wrong.bias}
+    with pytest.raises(ValueError, match="shape mismatch"):
+        from_torch_state_dict(fmodel, params, state, sd)
+
+
+def test_load_reference_format_checkpoint(tmp_path):
+    """The reference's resume format: {'net': DataParallel state_dict,
+    'acc': ..., 'epoch': ...} (reference data_parallel.py:84-87)."""
+    tmodel = tnn.Sequential(tnn.Conv2d(3, 4, 3, bias=False))
+    wrapped = {"net": {f"module.{k}": v
+                       for k, v in tmodel.state_dict().items()},
+               "acc": 91.2, "epoch": 34}
+    path = tmp_path / "ckpt.pth"
+    torch.save(wrapped, path)
+
+    sd = load_torch_checkpoint(str(path))
+    sd = strip_prefix(sd)
+    assert list(sd) == ["0.weight"]
+    np.testing.assert_array_equal(np.asarray(sd["0.weight"]),
+                                  tmodel.state_dict()["0.weight"].numpy())
+
+
+def test_bare_state_dict_checkpoint(tmp_path):
+    tmodel = tnn.Sequential(tnn.Conv2d(3, 4, 3, bias=False))
+    path = tmp_path / "bare.pth"
+    torch.save(tmodel.state_dict(), path)
+    sd = load_torch_checkpoint(str(path))
+    assert list(sd) == ["0.weight"]
