@@ -55,6 +55,7 @@ def bench_lm() -> None:
         compiled_flops,
         fetch,
         fetch_overhead,
+        lm_model_flops,
         peak_flops_per_chip,
     )
 
@@ -92,25 +93,25 @@ def bench_lm() -> None:
     fetch(loss)
     dt = max(1e-9, time.perf_counter() - t0 - t_fetch) / steps
 
-    # MFU counts MODEL FLOPs: a remat program re-executes forward work in
-    # the backward, and crediting that recompute would inflate the number
-    # (that would be HFU). Cost-analyze the same step compiled WITHOUT
-    # remat (compile only — never executed, so the non-remat activation
-    # memory is irrelevant).
-    import dataclasses as _dc
-
-    from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
-        make_spmd_train_step,
-    )
-
-    step_no_remat = make_spmd_train_step(
-        _dc.replace(cfg.model, remat=False), t.spec, t.tx,
-        num_microbatches=cfg.num_microbatches)
-    flops = compiled_flops(step_no_remat, t.params, t.opt_state, toks, tgts)
+    # MFU counts MODEL FLOPs analytically (utils/profiling.lm_model_flops).
+    # XLA cost analysis is structurally unable to count this program: the
+    # decoder stacks its L blocks in a lax.scan whose body cost analysis
+    # counts ONCE (verified on v5e: an 8-iteration scanned matmul reports
+    # 1 body), and the pallas flash-attention kernels are custom calls
+    # with no registered cost, so every score/value matmul counts zero.
+    # Rounds 1-2 published the cost-analysis number (0.11 at seq 8k) —
+    # that undercounted ~4.4x; the step was already running at ~0.49.
+    # The analytic count excludes remat/FA2-recompute (MFU, not HFU).
+    flops = lm_model_flops(cfg.model, batch, seq)
+    ca = compiled_flops(t._step, t.params, t.opt_state, toks, tgts)
+    _log(f"model flops/step: {flops / 1e12:.2f} TF analytic "
+         f"({(ca or 0) / 1e12:.2f} TF by cost analysis — lower bound only, "
+         f"scan bodies counted once, pallas kernels zero)")
     peak = peak_flops_per_chip()
-    # Per-device cost-analysis FLOPs over per-device peak (see the MFU
-    # normalization note in bench_cnn).
-    mfu = (round(flops / dt / peak, 4)
+    # The analytic count covers the GLOBAL batch (unlike cost_analysis,
+    # which reports the per-device partitioned module), so normalize by
+    # the fleet's peak: per-chip FLOPs over per-chip peak.
+    mfu = (round(flops / n_chips / dt / peak, 4)
            if flops and peak else None)
     tokens_per_s_per_chip = batch * seq / dt / n_chips
     print(json.dumps({
@@ -223,23 +224,29 @@ def main() -> None:
     vs_baseline = (round(
         samples_per_sec_per_chip / BASELINE_SAMPLES_PER_SEC_PER_GPU, 3)
         if model_name == "mobilenetv2" and batch == 512 else None)
-    # MFU: cost-analysis FLOPs of one dispatched program (steps_per_dispatch
-    # full train steps) normalized to per-step, over the chip's peak.
+    # MFU: cost-analysis FLOPs of ONE train step over the chip's peak.
+    # Must be the loop-free single-step program (_train_step): the scanned
+    # _multi_step's loop body is counted once by cost analysis regardless
+    # of trip count (verified on v5e), so analyzing it and dividing by
+    # steps_per_dispatch understated MFU 10x in rounds 1-2. The CNN step
+    # (convs + BN + SGD, no scan, no pallas) is exactly what cost
+    # analysis counts correctly.
     from distributed_model_parallel_tpu.utils.profiling import (
         compiled_flops,
         peak_flops_per_chip,
     )
 
     rng, sub = jax.random.split(rng)
-    idx = jnp.asarray(idx_rng.integers(
-        0, n, (steps_per_dispatch, batch)).astype(np.int64))
-    flops = compiled_flops(trainer._multi_step, trainer.state, sub,
-                           trainer._dev_images, trainer._dev_labels, idx)
+    img_shape = trainer.train_ds.images.shape[1:]
+    flops = compiled_flops(
+        trainer._train_step, trainer.state, sub,
+        trainer._dev_images[:batch].reshape(batch, *img_shape),
+        trainer._dev_labels[:batch])
     peak = peak_flops_per_chip()
     # compiled.cost_analysis() reports the per-device partitioned HLO
     # module, so normalize by one chip's peak: per-device FLOPs over
     # per-device peak IS the fleet MFU under SPMD (ADVICE r2).
-    mfu = (round(flops / steps_per_dispatch / dt / peak, 4)
+    mfu = (round(flops / dt / peak, 4)
            if flops and peak else None)
     print(json.dumps({
         "metric": f"{model_name}_cifar10_bs{batch}_train_samples_per_sec_per_chip",

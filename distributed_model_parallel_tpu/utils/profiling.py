@@ -65,7 +65,21 @@ def peak_flops_per_chip(device=None) -> float | None:
 
 def compiled_flops(jitted: Callable, *args) -> float | None:
     """Total FLOPs of the compiled program for ``jitted(*args)`` via XLA's
-    cost analysis (client-side on the HLO — no execution, no donation)."""
+    cost analysis (client-side on the HLO — no execution, no donation).
+
+    Two blind spots make this unusable as an MFU numerator for programs
+    that contain loops or pallas kernels (both verified on v5e, see the
+    round-3 notes in bench.py):
+
+    * ``lax.scan`` / ``while`` bodies are counted ONCE, not trip-count
+      times — a stacked-blocks decoder reports 1/L of its dense math, a
+      scanned multi-step program reports 1 step.
+    * Custom calls (pallas kernels) have no registered cost and
+      contribute zero — flash attention's score/value matmuls vanish.
+
+    Use it only on loop-free, kernel-free programs (e.g. the CNN single
+    train step), or as a lower-bound cross-check next to an analytic
+    count such as :func:`lm_model_flops`."""
     try:
         compiled = jitted.lower(*args).compile()
         ca = compiled.cost_analysis()
@@ -75,6 +89,48 @@ def compiled_flops(jitted: Callable, *args) -> float | None:
         return float(flops) if flops else None
     except Exception:
         return None
+
+
+def lm_model_flops(cfg, batch: int, seq: int, causal: bool = True) -> float:
+    """Analytic model FLOPs (forward + backward) of one Transformer LM
+    train step at ``batch`` sequences of ``seq`` tokens.
+
+    XLA's cost analysis cannot produce this number for the real program
+    (scan bodies counted once, pallas custom calls counted zero — see
+    :func:`compiled_flops`), so MFU uses the standard analytic count:
+
+    * dense matmuls: ``6 * N_mm * tokens`` where ``N_mm`` is the matmul
+      parameter count touched per token (q/kv/o projections, MLP or the
+      top-k routed expert slice plus router, LM head; embedding lookups
+      and elementwise work excluded) — fwd ``2N`` + bwd ``4N``.
+    * attention scores/values: fwd ``4*B*H*pairs*hd`` + bwd twice that,
+      where ``pairs`` is the number of attended (q, k) positions —
+      ``T*(T+1)/2`` causal, banded under a sliding window.
+    * backward recompute (remat or the FA2 in-kernel score rebuild) is
+      EXCLUDED: that work is implementation overhead, not model FLOPs
+      (this is MFU, not HFU).
+    """
+    d, hd = cfg.d_model, cfg.head_dim
+    H, kv = cfg.n_heads, cfg.kv_heads
+    L, f, V = cfg.n_layers, cfg.d_ff, cfg.vocab_size
+    attn_proj = d * H * hd + d * kv * 2 * hd + H * hd * d
+    if cfg.moe_experts:
+        mlp = cfg.moe_top_k * 2 * d * f + d * cfg.moe_experts
+    else:
+        mlp = 2 * d * f
+    n_mm = L * (attn_proj + mlp) + d * V
+    tokens = batch * seq
+    dense = 6 * n_mm * tokens
+    if cfg.attn_window is not None:
+        w = min(cfg.attn_window, seq)
+        # query i attends keys (i-w, i]: min(i+1, w) positions
+        pairs = seq * w - w * (w - 1) // 2
+    elif causal:
+        pairs = seq * (seq + 1) // 2
+    else:
+        pairs = seq * seq
+    attn = 12 * batch * H * pairs * hd * L
+    return float(dense + attn)
 
 
 @contextlib.contextmanager
