@@ -17,8 +17,9 @@ visible (per-chip = total / n_chips). bfloat16 compute, float32 params.
 Env knobs: DMP_BENCH_MODEL (mobilenetv2 | resnet50 | ...), DMP_BENCH_BATCH,
 DMP_BENCH_STEPS, DMP_BENCH_SPD, and DMP_BENCH_WORKLOAD=lm for the
 long-context Transformer train step (DMP_BENCH_SEQ, default 8192;
-DMP_BENCH_REMAT=full|dots selects the block remat policy) measured in
-tokens/s/chip.
+DMP_BENCH_REMAT=full|dots selects the block remat policy;
+DMP_BENCH_LOSS_CHUNK is the chunked cross-entropy head's chunk size in
+tokens, e.g. 8192 — 0 = dense head) measured in tokens/s/chip.
 """
 
 from __future__ import annotations
@@ -69,6 +70,7 @@ def bench_lm() -> None:
             d_ff=4096, max_seq_len=seq, pos_embedding="rope",
             remat=True,
             remat_policy=os.environ.get("DMP_BENCH_REMAT", "dots"),
+            loss_chunk=int(os.environ.get("DMP_BENCH_LOSS_CHUNK", "0")),
             dtype=jnp.bfloat16),
         batch_size=batch, seq_len=seq, n_tokens=4 * batch * (seq + 1),
         log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",

@@ -93,11 +93,21 @@ class TransformerConfig:
     # wqkv); 1 = multi-query. The KV cache shrinks by n_heads/n_kv_heads —
     # the long-context decode memory lever.
     n_kv_heads: int | None = None
+    # Chunked cross-entropy head: compute logits + log-softmax in
+    # loss_chunk-token slices under jax.checkpoint so [B, T, V] never
+    # materializes (chunked_token_loss) — the long-context TRAINING memory
+    # lever on the head side (the head, not attention, is the single-chip
+    # HBM ceiling past ~32k tokens). 0 = dense head.
+    loss_chunk: int = 0
 
     def __post_init__(self):
         if self.attn_window is not None and self.attn_window < 1:
             raise ValueError(
                 f"attn_window must be >= 1, got {self.attn_window}")
+        if self.loss_chunk < 0:
+            raise ValueError(
+                f"loss_chunk must be >= 0 (0 = dense head), got "
+                f"{self.loss_chunk}")
 
     @property
     def head_dim(self) -> int:
@@ -372,11 +382,20 @@ def unembed(params: dict, x: jax.Array) -> jax.Array:
     return x @ params["head"]
 
 
+def hidden_with_aux(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+                    *, pos_offset: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Forward up to the final hidden states: [B, T] int tokens ->
+    ([B, T, d] pre-head activations, moe aux loss). Shared by the dense
+    head (``apply_with_aux``) and the chunked head (``lm_loss`` with
+    ``loss_chunk``) so the two paths cannot drift."""
+    x = embed(params, tokens, cfg, pos_offset=pos_offset)
+    return blocks_scan(params["blocks"], x, cfg)
+
+
 def apply_with_aux(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                    *, pos_offset: int = 0) -> tuple[jax.Array, jax.Array]:
     """Full forward: [B, T] int tokens -> ([B, T, V] logits, moe aux loss)."""
-    x = embed(params, tokens, cfg, pos_offset=pos_offset)
-    x, aux = blocks_scan(params["blocks"], x, cfg)
+    x, aux = hidden_with_aux(params, tokens, cfg, pos_offset=pos_offset)
     return unembed(params, x), aux
 
 
@@ -396,9 +415,48 @@ def token_loss(logits: jax.Array, targets: jax.Array, aux: jax.Array,
     return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
+def chunked_token_loss(params: dict, x: jax.Array, targets: jax.Array,
+                       aux: jax.Array, cfg: TransformerConfig,
+                       chunk: int) -> jax.Array:
+    """``token_loss`` over ``unembed(x)`` without ever materializing the
+    ``[B, T, V]`` logits tensor.
+
+    At long context the single-chip HBM ceiling is the vocabulary head,
+    not attention: seq-64k x 32k-vocab logits are 4.3 GB bf16 plus f32
+    softmax temporaries (measured: the seq-64k train step wants 20.7 GB
+    on a 15.8 GB v5e with the dense head; flash attention itself is
+    O(T)). This scans the sequence in ``chunk``-token slices, computing
+    each slice's logits + log-softmax inside a ``jax.checkpoint`` region
+    so the backward rematerializes them per chunk: peak memory drops to
+    O(B * chunk * V) for one extra head forward of recompute (the same
+    FLOPs-for-HBM trade the block remat makes; the fused-linear-CE trick,
+    expressed as scan + remat instead of a custom kernel)."""
+    b, t, d = x.shape
+    if t % chunk:
+        raise ValueError(f"seq len {t} not divisible by loss_chunk={chunk}")
+    n = t // chunk
+    xs = x.reshape(b, n, chunk, d).swapaxes(0, 1)        # [n, B, c, D]
+    ts = targets.reshape(b, n, chunk).swapaxes(0, 1)     # [n, B, c]
+
+    @jax.checkpoint
+    def body(carry, xt):
+        xc, tc = xt
+        logp = jax.nn.log_softmax(unembed(params, xc).astype(jnp.float32),
+                                  axis=-1)
+        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return carry + nll.sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
+    return total / (b * t) + cfg.moe_aux_weight * aux
+
+
 def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,
             cfg: TransformerConfig) -> jax.Array:
     """Mean next-token cross-entropy (+ weighted MoE load-balance loss)."""
+    if cfg.loss_chunk:
+        x, aux = hidden_with_aux(params, tokens, cfg)
+        return chunked_token_loss(params, x, targets, aux, cfg,
+                                  cfg.loss_chunk)
     logits, aux = apply_with_aux(params, tokens, cfg)
     return token_loss(logits, targets, aux, cfg)
 

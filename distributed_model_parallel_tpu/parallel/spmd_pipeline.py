@@ -117,6 +117,9 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
     def loss_fn(params, tokens, targets):
         x = tfm.embed(params, tokens, cfg)
         x, aux = pipeline_blocks(params["blocks"], x)
+        if cfg.loss_chunk:
+            return tfm.chunked_token_loss(params, x, targets, aux, cfg,
+                                          cfg.loss_chunk)
         logits = tfm.unembed(params, x)
         return tfm.token_loss(logits, targets, aux, cfg)
 

@@ -55,6 +55,11 @@ def parse_args():
                    help="with --remat: 'dots' saves matmul outputs and "
                         "recomputes only elementwise ops (less recompute, "
                         "slightly more HBM)")
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="chunked cross-entropy head: compute logits in "
+                        "N-token slices so [B, T, vocab] never "
+                        "materializes — the head-side long-context memory "
+                        "lever (0 = dense head)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--microbatches", type=int, default=1)
     p.add_argument("--lr", type=float, default=0.1)
@@ -95,6 +100,7 @@ def main():
             n_kv_heads=args.kv_heads,
             attn_window=args.attn_window,
             remat=args.remat, remat_policy=args.remat_policy,
+            loss_chunk=args.loss_chunk,
             attn_impl="flash" if args.attn_window is not None else "auto"),
         mesh=MeshConfig(data=args.dp, stage=args.pp, model=args.tp,
                         seq=args.sp, expert=args.ep),

@@ -1,0 +1,137 @@
+"""Numeric anchors for the model zoo: exact parameter counts.
+
+The shape/split tests in test_zoo.py would pass with a transposed spec or
+a wrong cfg constant; these tests pin each architecture's parameter count
+against a closed-form count computed HERE from the paper's layer
+progression (channels, repeats, strides written out explicitly — not read
+from models/zoo.py), using the framework's stated conventions: convs carry
+no bias under BN (bias appears when a conv is bare, e.g. pre-act stems),
+BatchNorm contributes scale+bias (2C; running stats live in batch_stats,
+not params), depthwise convs hold k*k*C weights, the classifier head is
+global-pool + Dense(num_classes) with bias.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_model_parallel_tpu.config import ModelConfig
+from distributed_model_parallel_tpu.models import get_model
+
+
+def n_params(name: str) -> int:
+    model = get_model(ModelConfig(name=name))
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def conv(k, cin, cout, bias=False):
+    return k * k * cin * cout + (cout if bias else 0)
+
+
+def dwconv(k, c):
+    return k * k * c
+
+
+def bn(c):
+    return 2 * c
+
+
+def dense(cin, cout):
+    return cin * cout + cout
+
+
+# ---------------------------------------------------------------------- VGG
+VGG_CHANNELS = {
+    # Simonyan & Zisserman table D/A, CIFAR variant (features only; the
+    # classifier is a single 512 -> 10 dense).
+    "vgg11": [64, 128, 256, 256, 512, 512, 512, 512],
+    "vgg16": [64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512],
+}
+
+
+@pytest.mark.parametrize("arch", sorted(VGG_CHANNELS))
+def test_vgg_param_count(arch):
+    expected, cin = 0, 3
+    for c in VGG_CHANNELS[arch]:
+        expected += conv(3, cin, c) + bn(c)
+        cin = c
+    expected += dense(512, 10)
+    assert n_params(arch) == expected
+
+
+# ----------------------------------------------------- PreActResNet / SENet
+def _preact_expected(se: bool) -> int:
+    # He et al. identity-mappings ResNet-18 layout: 3x3/64 stem, four
+    # groups of two blocks at (64, 128, 256, 512), stride 2 entering
+    # groups 2-4. Stem is a bare conv (first block's pre-BN normalizes
+    # it), so it carries a bias.
+    expected = conv(3, 3, 64, bias=True)
+    cin = 64
+    for feats, stride0 in ((64, 1), (128, 2), (256, 2), (512, 2)):
+        for b in range(2):
+            stride = stride0 if b == 0 else 1
+            expected += bn(cin)                          # pre-activation BN
+            expected += conv(3, cin, feats) + bn(feats)  # conv0 + bn0
+            expected += conv(3, feats, feats)            # conv1
+            if stride != 1 or cin != feats:
+                expected += conv(1, cin, feats)          # projection shortcut
+            if se:                                       # squeeze-excite 1/16
+                sq = feats // 16
+                expected += conv(1, feats, sq, bias=True)
+                expected += conv(1, sq, feats, bias=True)
+            cin = feats
+    return expected + dense(512, 10)
+
+
+def test_preactresnet18_param_count():
+    assert n_params("preactresnet18") == _preact_expected(se=False)
+
+
+def test_senet18_param_count():
+    assert n_params("senet18") == _preact_expected(se=True)
+
+
+# ---------------------------------------------------------------- MobileNetV1
+def test_mobilenetv1_param_count():
+    # Howard et al. table 1 (CIFAR stride layout): 32-ch stem, 13
+    # depthwise-separable layers.
+    cfg = [64, (128, 2), 128, (256, 2), 256, (512, 2),
+           512, 512, 512, 512, 512, (1024, 2), 1024]
+    expected = conv(3, 3, 32) + bn(32)
+    cin = 32
+    for entry in cfg:
+        feats = entry[0] if isinstance(entry, tuple) else entry
+        expected += dwconv(3, cin) + bn(cin)         # depthwise 3x3
+        expected += conv(1, cin, feats) + bn(feats)  # pointwise
+        cin = feats
+    expected += dense(1024, 10)
+    assert n_params("mobilenetv1") == expected
+
+
+# ---------------------------------------------------------------- MobileNetV2
+def test_mobilenetv2_param_count():
+    # Sandler et al. table 2 (CIFAR variant: stride-1 stem, first
+    # bottleneck t=1): (t, c, n, s) rows, 1280-ch head conv. Two
+    # reference-architecture quirks are part of the capability spec
+    # (reference model/mobilenetv2.py): the 1x1 expand conv exists even
+    # at t=1, and a stride-1 block whose channel count changes gets a
+    # projection shortcut (1x1 conv + BN) — the paper uses identity
+    # shortcuts only.
+    rows = [(1, 16, 1, 1), (6, 24, 2, 1), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+    expected = conv(3, 3, 32) + bn(32)
+    cin = 32
+    for t, c, n, s in rows:
+        for b in range(n):
+            stride = s if b == 0 else 1
+            hidden = cin * t
+            expected += conv(1, cin, hidden) + bn(hidden)      # expand
+            expected += dwconv(3, hidden) + bn(hidden)         # depthwise
+            expected += conv(1, hidden, c) + bn(c)             # project
+            if stride == 1 and cin != c:                       # ref shortcut
+                expected += conv(1, cin, c) + bn(c)
+            cin = c
+    expected += conv(1, 320, 1280) + bn(1280)                  # head conv
+    expected += dense(1280, 10)
+    assert n_params("mobilenetv2") == expected
