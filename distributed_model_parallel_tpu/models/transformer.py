@@ -47,15 +47,15 @@ class TransformerConfig:
     tp_axis: str | None = None     # tensor parallel: heads/ffn sharded
     sp_axis: str | None = None     # sequence parallel: ring attention
     sp_impl: str = "ring"          # "ring" | "ulysses"
-    # Attention kernel for the non-sequence-parallel path: "auto" uses the
-    # pallas flash kernel on TPU for sequences >= 2048, where its forward is
-    # 3-10x faster than XLA (benchmarks/run_sweep.py). Training uses the
+    # Attention kernel for the non-sequence-parallel path: "auto" consults
+    # the measured per-platform dispatch table
+    # (ops/pallas_attention._DISPATCH_TABLE — v5e crossover: seq 1024 for
+    # both bf16 and f32 with the streamed-K/V kernels). Training uses the
     # FlashAttention-2 backward kernels (score tiles recomputed from the
     # saved logsumexp), so neither direction materializes [T, T] in HBM;
-    # fwd+bwd measures 2.3-3.3x faster than the XLA-recompute backward on
-    # v5e (1.7/5.4/18.5 ms at seq 2k/4k/8k, B4 H8 D64 bf16 — 52 TFLOPS at
-    # 8k, benchmarks/grad_sweep.json; plain XLA cannot compile 8k at all).
-    # "xla" / "flash" force one implementation.
+    # fwd+bwd reaches 97 TFLOPS at seq 8k head-dim 128 bf16
+    # (benchmarks/grad_sweep_r3_hd128.json; plain XLA cannot compile 8k
+    # at all). "xla" / "flash" force one implementation.
     attn_impl: str = "auto"
     # Sliding-window (local) attention: each token attends the last W
     # positions. Training runs on the flash kernels' banded block-skipping

@@ -536,19 +536,26 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # min_seq: crossover sequence length per compute dtype; None = never
 #   auto-select for that dtype. bf16 crossover 1024 (streamed-K/V kernel,
 #   r3 sweep: 0.17 vs 0.40 ms at hd 64, 0.16 vs 0.41 ms at hd 128; at 512
-#   XLA still wins ~2x). float32 is None NOT for speed — the
-#   kernel's MXU passes accumulate at bf16-input precision (measured
-#   ~8e-3 abs error on unit-scale f32 inputs vs true-f32 XLA attention,
-#   i.e. bf16-class), so auto-dispatch would silently degrade f32
-#   attention; forcing attn_impl="flash" remains available and documented.
+#   XLA still wins ~2x). float32 crossover 1024 too (r3 f32 sweeps,
+#   dispatch_sweep_r3_f32.json / grad_sweep_r3_f32.json: fwd+bwd flash
+#   wins 3.3x at 1024 and 4.5x at 4096, XLA wins at 512; XLA f32 cannot
+#   run seq 8k at all). Precision footing is equal, not degraded: at
+#   jax's DEFAULT matmul precision XLA's f32 attention also runs
+#   single-pass MXU dots — measured max-abs error vs a float64 reference
+#   on unit-scale inputs is 1.1e-2 (XLA f32) vs 7.6e-3 (flash f32), the
+#   same bf16-pass class. Callers raising precision globally (e.g.
+#   jax.default_matmul_precision('float32')) get true-f32 dots only from
+#   XLA — the kernel does not consult that context — so should_use_flash
+#   declines f32 auto-dispatch whenever the precision config is raised
+#   (_matmul_precision_raised).
 # block_q/block_k: fastest measured tile shape (clamped to seq at call
 #   time).
 # max_head_dim: the kernel keeps [block, D] tiles resident in VMEM; above
 #   this, tiles spill and XLA wins regardless of seq.
 _DISPATCH_TABLE: dict[str, dict] = {
-    "TPU v5 lite": {"min_seq": {"bfloat16": 1024, "float32": None},
+    "TPU v5 lite": {"min_seq": {"bfloat16": 1024, "float32": 1024},
                     "block_q": 512, "block_k": 1024, "max_head_dim": 256},
-    "tpu": {"min_seq": {"bfloat16": 1024, "float32": None},
+    "tpu": {"min_seq": {"bfloat16": 1024, "float32": 1024},
             "block_q": 512, "block_k": 1024, "max_head_dim": 256},
 }
 
@@ -573,6 +580,15 @@ def default_blocks(device=None) -> tuple[int, int]:
     itself clamps them to the actual sequence length)."""
     entry = dispatch_entry(device) or _DISPATCH_TABLE["tpu"]
     return entry["block_q"], entry["block_k"]
+
+
+def _matmul_precision_raised() -> bool:
+    """True when jax_default_matmul_precision is set above DEFAULT (e.g.
+    'float32'/'highest'/'high'/'tensorfloat32') — the caller explicitly
+    asked for more-than-single-pass MXU dots."""
+    prec = jax.config.jax_default_matmul_precision
+    return prec is not None and str(prec).lower() not in ("default", "fastest",
+                                                          "bfloat16")
 
 
 def should_use_flash(t: int, *, causal: bool = True, impl: str = "auto",
@@ -600,6 +616,14 @@ def should_use_flash(t: int, *, causal: bool = True, impl: str = "auto",
     # Unlisted dtypes (e.g. float64 under x64) stay on XLA: the kernel
     # computes at bf16-input precision, so only dtypes with an explicit
     # measured entry may auto-select it.
+    if dtype_name == "float32" and _matmul_precision_raised():
+        # The f32 crossover was measured at jax's DEFAULT matmul precision,
+        # where XLA's attention runs the same single-pass MXU dots as the
+        # kernel. A caller who raised jax_default_matmul_precision asked
+        # for true-f32 dots — which only XLA honors (the kernel does not
+        # consult the precision context) — so auto must not route them to
+        # the kernel's lower-precision math.
+        return False
     min_seq = entry["min_seq"].get(dtype_name)
     if min_seq is None:
         return False

@@ -108,3 +108,14 @@ def auto_boundaries(model: StagedModel, sample_shape: Sequence[int],
     """Measure unit costs and return the minimax stage boundaries."""
     return cost_balanced_boundaries(
         unit_costs(model, sample_shape, train=train), num_stages)
+
+
+def microbatch_rows(batch_size: int, num_microbatches: int,
+                    data_shards: int = 1) -> int:
+    """Rows of ONE microbatch as a pipeline stage sees it — the batch shape
+    ``auto_boundaries`` should profile at. The single home for this
+    arithmetic: the single-controller runner feeds the whole global batch
+    through one replica (``data_shards=1``); the SPMD pipeline splits it
+    over the ``data`` axis first."""
+    return max(1, batch_size // (max(1, data_shards)
+                                 * max(1, num_microbatches)))

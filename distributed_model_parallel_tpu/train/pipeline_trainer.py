@@ -82,11 +82,12 @@ class PipelineTrainer:
             # and the equal-unit-count default.
             from distributed_model_parallel_tpu.parallel.auto_partition import (
                 auto_boundaries,
+                microbatch_rows,
             )
 
             n_chunks = len(devices) * max(1, config.virtual_stages)
-            micro = max(1, config.data.batch_size // max(
-                1, config.num_microbatches))
+            micro = microbatch_rows(config.data.batch_size,
+                                    config.num_microbatches)
             boundaries = auto_boundaries(
                 model, (micro,) + in_shape, n_chunks)
         self.runner = PipelineRunner(

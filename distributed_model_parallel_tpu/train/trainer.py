@@ -345,6 +345,73 @@ class Trainer:
                                   self._repl, idx_sh),
                     out_shardings=(self._state_sh, self._repl),
                     donate_argnums=(0,))
+        elif config.strategy == "spmd_pipeline":
+            # Single-program GPipe over the `stage` mesh axis for staged
+            # CNNs (parallel/spmd_cnn_pipeline.py) — the multi-host-capable
+            # counterpart of PipelineTrainer's single-controller runtime,
+            # driven by this harness because its step has the same
+            # (state, rng, images, labels) -> (state, metrics) contract as
+            # the GSPMD step. Params stay replicated (each device computes
+            # only its own stage), so eval rides the ordinary batch-sharded
+            # GSPMD forward.
+            from distributed_model_parallel_tpu.parallel.spmd_cnn_pipeline import (
+                make_spmd_cnn_train_step,
+            )
+
+            if config.device_resident_data:
+                raise ValueError(
+                    "device_resident_data is only supported with "
+                    "strategy='gspmd'")
+            if ema is not None:
+                raise ValueError(
+                    "ema_decay is supported on the gspmd/fsdp strategies")
+            if self.spec.num_stages < 2:
+                raise ValueError(
+                    "strategy='spmd_pipeline' needs mesh.stage >= 2 "
+                    "(use 'gspmd' for pure data parallelism)")
+            boundaries = config.stage_boundaries
+            if boundaries is None and config.auto_partition:
+                from distributed_model_parallel_tpu.parallel.auto_partition import (
+                    auto_boundaries,
+                    microbatch_rows,
+                )
+
+                micro = microbatch_rows(config.data.batch_size,
+                                        config.num_microbatches,
+                                        self.spec.num_data)
+                boundaries = auto_boundaries(
+                    self.model,
+                    (micro, in_hw, in_hw, train_ds.images.shape[3]),
+                    self.spec.num_stages)
+            self._state_sh = self._repl
+            state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                               model_state=model_state,
+                               opt_state=self.tx.init(params))
+            self.state = jax.device_put(state, self._state_sh)
+            # masked dispatch on CPU: conv backward inside lax.switch loses
+            # intra-op threading on the XLA CPU backend (~35x slower —
+            # spmd_cnn_pipeline.py); TPU keeps the switch default.
+            dispatch = ("masked" if jax.devices()[0].platform == "cpu"
+                        else "switch")
+            self._train_step = jax.jit(
+                make_spmd_cnn_train_step(
+                    self.model, self.spec, self.tx,
+                    sample_shape=(2, in_hw, in_hw,
+                                  train_ds.images.shape[3]),
+                    num_microbatches=config.num_microbatches,
+                    boundaries=boundaries,
+                    bn_momentum=config.model.bn_momentum,
+                    augment=config.data.augment,
+                    stage_dispatch=dispatch, **kw),
+                in_shardings=(self._state_sh, self._repl, self._batch_sh,
+                              self._batch_sh),
+                out_shardings=(self._state_sh, self._repl),
+                donate_argnums=(0,))
+            self._eval_step = jax.jit(
+                make_eval_step(self.model, use_ema=False, **kw),
+                in_shardings=(self._state_sh, self._batch_sh,
+                              self._batch_sh),
+                out_shardings=self._repl)
         else:
             raise KeyError(f"unknown strategy {config.strategy!r}")
 

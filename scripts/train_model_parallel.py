@@ -23,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from scripts._cpu_devices import force_cpu_devices
 
-force_cpu_devices((("--stages", "--world-size"),))
+force_cpu_devices((("--stages", "--world-size"), "--dp"))
 
 from distributed_model_parallel_tpu.config import (
     DataConfig,
@@ -66,6 +66,16 @@ def parse_args():
                         "on-device (224 = the reference finetune recipe)")
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--log-name", default=None)
+    p.add_argument("--engine", default="runner", choices=["runner", "spmd"],
+                   help="'runner' = single-controller PipelineRunner (one "
+                        "program per stage, schedules incl. 1F1B/virtual "
+                        "stages); 'spmd' = single-program shard_map+ppermute "
+                        "pipeline over a data x stage mesh "
+                        "(parallel/spmd_cnn_pipeline.py) — the multi-host "
+                        "path; --dp sets its data-parallel width")
+    p.add_argument("--dp", default=1, type=int,
+                   help="data-axis width for --engine spmd (total devices "
+                        "= dp * stages)")
     return p.parse_args()
 
 
@@ -87,9 +97,10 @@ def main():
             learning_rate=args.lr, momentum=args.momentum,
             weight_decay=args.wd,
             warmup_steps=args.warmup_epochs * steps_per_epoch),
-        mesh=MeshConfig(data=1, stage=args.stages),
+        mesh=MeshConfig(data=args.dp, stage=args.stages),
         epochs=args.epochs,
         resume=args.resume,
+        strategy=("spmd_pipeline" if args.engine == "spmd" else "gspmd"),
         num_microbatches=args.microbatches,
         stage_boundaries=boundaries,
         auto_partition=args.auto_partition,
@@ -97,6 +108,21 @@ def main():
         virtual_stages=args.virtual_stages,
         log_name=args.log_name or f"{args.batch_size}",
     )
+    if args.engine == "runner" and args.dp != 1:
+        raise SystemExit(
+            "--dp is an --engine spmd knob; the single-controller runner "
+            "pipelines over stages only (PipelineTrainer ignores the data "
+            "axis — refusing to silently drop your requested data "
+            "parallelism)")
+    if args.engine == "spmd":
+        if args.schedule != "gpipe" or args.virtual_stages != 1:
+            raise SystemExit(
+                "--engine spmd implements the GPipe schedule only "
+                "(1F1B/virtual stages are runner-engine schedules)")
+        from distributed_model_parallel_tpu.train.trainer import Trainer
+
+        Trainer(config).fit()
+        return
     from distributed_model_parallel_tpu.train.pipeline_trainer import (
         PipelineTrainer,
     )

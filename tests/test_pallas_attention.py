@@ -306,13 +306,22 @@ def test_dispatch_table_heuristic():
     # forced impls ignore everything else
     assert should_use_flash(64, impl="flash", device=cpu)
     assert not should_use_flash(1 << 20, impl="xla", device=v5e)
-    # per-dtype rules (v5e row: bf16 crossover 1024 with the streamed-K/V
-    # kernel; f32 never — the kernel computes at bf16-class precision,
-    # benchmarks/dispatch_sweep.json)
+    # per-dtype rules (v5e row: crossover 1024 for both bf16 and f32 —
+    # the f32 rows measured in dispatch_sweep_r3_f32.json /
+    # grad_sweep_r3_f32.json; at jax's DEFAULT matmul precision XLA's f32
+    # attention runs the same single-pass MXU dots as the kernel, so the
+    # dispatch is apples-to-apples on precision)
     assert should_use_flash(1024, dtype=jnp.bfloat16, device=v5e)
     assert not should_use_flash(512, dtype=jnp.bfloat16, device=v5e)
-    assert not should_use_flash(2048, dtype=jnp.float32, device=v5e)
-    assert not should_use_flash(1 << 16, dtype=jnp.float32, device=v5e)
+    assert should_use_flash(2048, dtype=jnp.float32, device=v5e)
+    assert not should_use_flash(512, dtype=jnp.float32, device=v5e)
+    # ...but a raised matmul-precision context means the caller wants
+    # true-f32 dots, which only XLA honors — auto declines the kernel
+    with jax.default_matmul_precision("float32"):
+        assert not should_use_flash(2048, dtype=jnp.float32, device=v5e)
+        assert should_use_flash(2048, dtype=jnp.bfloat16, device=v5e)
+    # unlisted dtypes (e.g. float64) never auto-select
+    assert not should_use_flash(1 << 16, dtype=jnp.float64, device=v5e)
     # head-dim cap: VMEM tiles spill above the table's max_head_dim
     assert not should_use_flash(8192, head_dim=512, device=v5e)
     assert should_use_flash(8192, head_dim=256, device=v5e)

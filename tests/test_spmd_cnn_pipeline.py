@@ -163,6 +163,34 @@ def test_dp_x_pp_trains(batch):
         assert np.isfinite(leaf).all()
 
 
+def test_trainer_spmd_pipeline_strategy(tmp_path):
+    """strategy='spmd_pipeline' drives the full Trainer harness (epochs,
+    eval, checkpointing) over a data x stage mesh and trains."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from tests.conftest import tiny_train_config
+
+    cfg = tiny_train_config(
+        tmp_path, strategy="spmd_pipeline",
+        mesh=MeshConfig(data=2, stage=4), num_microbatches=2, epochs=2)
+    history = Trainer(cfg).fit()
+    assert len(history) == 2
+    assert history[-1]["loss_train"] < history[0]["loss_train"] + 0.1
+    assert np.isfinite(history[-1]["loss_train"])
+
+
+def test_trainer_spmd_pipeline_rejects_bad_configs(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from tests.conftest import tiny_train_config
+
+    with pytest.raises(ValueError, match="mesh.stage"):
+        Trainer(tiny_train_config(tmp_path, strategy="spmd_pipeline",
+                                  mesh=MeshConfig(data=8)))
+    with pytest.raises(ValueError, match="device_resident_data"):
+        Trainer(tiny_train_config(tmp_path, strategy="spmd_pipeline",
+                                  mesh=MeshConfig(data=2, stage=4),
+                                  device_resident_data=True))
+
+
 def test_dp_bn_stat_pooling_matches_big_batch():
     """_pool_bn_over_axis reproduces the big-batch EMA update from
     per-shard EMA'd states (law of total variance across equal shards)."""
