@@ -290,9 +290,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Re-shards [B, T/n, H, Dh] -> [B, T, H/n, Dh], runs full softmax attention
     over the complete sequence for the local head subset, then re-shards back.
     Requires H % axis_size == 0. ``impl`` is the flash-vs-XLA selector
-    (``should_use_flash``): "auto" consults the measured dispatch table;
-    "flash" forces the pallas kernel (the escape hatch for dtypes the table
-    excludes, e.g. f32 long-context where XLA cannot materialize [T, T]).
+    (``should_use_flash``): "auto" consults the measured dispatch table
+    (bf16 and f32 both auto-select at their measured crossover, and a
+    raised matmul-precision context auto-declines the kernel); "flash"
+    forces the pallas kernel for dtypes/regimes the table excludes.
     """
     n = jax.lax.axis_size(axis_name)
     if q.shape[2] % n:

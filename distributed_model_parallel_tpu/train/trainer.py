@@ -369,6 +369,12 @@ class Trainer:
                 raise ValueError(
                     "strategy='spmd_pipeline' needs mesh.stage >= 2 "
                     "(use 'gspmd' for pure data parallelism)")
+            if config.pipeline_schedule != "gpipe" or config.virtual_stages != 1:
+                raise ValueError(
+                    "strategy='spmd_pipeline' implements the GPipe "
+                    "schedule only — 1F1B and virtual stages are "
+                    "single-controller PipelineRunner schedules (no "
+                    "silent ignores)")
             boundaries = config.stage_boundaries
             if boundaries is None and config.auto_partition:
                 from distributed_model_parallel_tpu.parallel.auto_partition import (

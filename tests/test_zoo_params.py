@@ -109,6 +109,76 @@ def test_mobilenetv1_param_count():
     assert n_params("mobilenetv1") == expected
 
 
+# ----------------------------------------------------------------- GoogLeNet
+def test_googlenet_param_count():
+    # Szegedy et al. table 1, inception 3a..5b (CIFAR variant: 192-ch 3x3
+    # stem, no stem pooling stack, the 5x5 branch realized as two 3x3
+    # convs as in BN-Inception).
+    specs = [(64, 96, 128, 16, 32, 32), (128, 128, 192, 32, 96, 64),
+             (192, 96, 208, 16, 48, 64), (160, 112, 224, 24, 64, 64),
+             (128, 128, 256, 24, 64, 64), (112, 144, 288, 32, 64, 64),
+             (256, 160, 320, 32, 128, 128), (256, 160, 320, 32, 128, 128),
+             (384, 192, 384, 48, 128, 128)]
+    expected = conv(3, 3, 192) + bn(192)
+    cin = 192
+    for n1, n3r, n3, n5r, n5, npool in specs:
+        expected += conv(1, cin, n1) + bn(n1)              # 1x1 branch
+        expected += conv(1, cin, n3r) + bn(n3r)            # 3x3 branch
+        expected += conv(3, n3r, n3) + bn(n3)
+        expected += conv(1, cin, n5r) + bn(n5r)            # double-3x3 branch
+        expected += conv(3, n5r, n5) + bn(n5)
+        expected += conv(3, n5, n5) + bn(n5)
+        expected += conv(1, cin, npool) + bn(npool)        # pool branch
+        cin = n1 + n3 + n5 + npool
+    expected += dense(1024, 10)
+    assert n_params("googlenet") == expected
+
+
+# --------------------------------------------------------------- DenseNet-121
+def test_densenet121_param_count():
+    # Huang et al.: growth 32, blocks (6, 12, 24, 16), bottleneck
+    # BN->1x1(4k)->BN->3x3(k), 0.5-compression transitions, final BN.
+    # CIFAR stem: a bare 3x3 conv to 2*growth (the first bottleneck's BN
+    # normalizes it, so the conv carries a bias).
+    growth = 32
+    expected = conv(3, 3, 2 * growth, bias=True)
+    c = 2 * growth
+    for i, n_layers in enumerate((6, 12, 24, 16)):
+        for _ in range(n_layers):
+            expected += bn(c) + conv(1, c, 4 * growth)
+            expected += bn(4 * growth) + conv(3, 4 * growth, growth)
+            c += growth
+        if i < 3:                                    # transition
+            expected += bn(c) + conv(1, c, c // 2)
+            c //= 2
+    expected += bn(c) + dense(c, 10)
+    assert n_params("densenet121") == expected
+
+
+# --------------------------------------------------------- ResNeXt-29 (2x64d)
+def test_resnext29_2x64d_param_count():
+    # Xie et al. CIFAR template: 3 groups x 3 blocks, cardinality 2, base
+    # width 64 (doubling per group), bottleneck 1x1 -> grouped 3x3 -> 1x1
+    # with expansion 2 and a projected (conv+BN) shortcut on shape change.
+    card = 2
+    expected = conv(3, 3, 64) + bn(64)
+    cin, width = 64, 64
+    for g in range(3):
+        gw = card * width
+        out = 2 * gw
+        for b in range(3):
+            stride = 2 if g > 0 and b == 0 else 1
+            expected += conv(1, cin, gw) + bn(gw)
+            expected += 9 * (gw // card) * gw + bn(gw)     # grouped 3x3
+            expected += conv(1, gw, out) + bn(out)
+            if stride != 1 or cin != out:
+                expected += conv(1, cin, out) + bn(out)
+            cin = out
+        width *= 2
+    expected += dense(cin, 10)
+    assert n_params("resnext29_2x64d") == expected
+
+
 # ---------------------------------------------------------------- MobileNetV2
 def test_mobilenetv2_param_count():
     # Sandler et al. table 2 (CIFAR variant: stride-1 stem, first
@@ -135,3 +205,157 @@ def test_mobilenetv2_param_count():
     expected += conv(1, 320, 1280) + bn(1280)                  # head conv
     expected += dense(1280, 10)
     assert n_params("mobilenetv2") == expected
+
+
+# -------------------------------------------------------------------- DPN-92
+def test_dpn92_param_count():
+    # Chen et al. DPN-92: 32-group 3x3 bottlenecks, per-stage
+    # (width, out_planes, blocks, dense_depth); residual add on the first
+    # out_planes channels, dense concat of dense_depth new ones, projected
+    # shortcut on each stage's first block. CIFAR stem: 3x3/64 stride 1.
+    cfg = [(96, 256, 3, 16, 1), (192, 512, 4, 32, 2),
+           (384, 1024, 20, 24, 2), (768, 2048, 3, 128, 2)]
+    expected = conv(3, 3, 64) + bn(64)
+    cin = 64
+    for w, d, blocks, dd, _s in cfg:
+        for b in range(blocks):
+            expected += conv(1, cin, w) + bn(w)
+            expected += 9 * (w // 32) * w + bn(w)          # 32-group 3x3
+            expected += conv(1, w, d + dd) + bn(d + dd)
+            if b == 0:                                     # projection
+                expected += conv(1, cin, d + dd) + bn(d + dd)
+            cin = d + (b + 2) * dd
+    expected += dense(cin, 10)
+    assert n_params("dpn92") == expected
+
+
+# --------------------------------------------------------- ShuffleNet (g=2)
+def test_shufflenetg2_param_count():
+    # Zhang et al. ShuffleNet, groups=2, CIFAR stage widths (200, 400,
+    # 800) x (4, 8, 4) blocks; stride-2 first block per stage concatenates
+    # the avg-pooled shortcut (its conv path emits features - cin); stage
+    # 1's first 1x1 is ungrouped; mid channels = out/4 rounded down to a
+    # multiple of the group count.
+    expected = conv(3, 3, 24) + bn(24)
+    cin, g = 24, 2
+    for s, (feats, blocks) in enumerate(zip((200, 400, 800), (4, 8, 4))):
+        for b in range(blocks):
+            stride = 2 if b == 0 else 1
+            out = feats - cin if stride == 2 else feats
+            mid = max(g, out // 4)
+            mid -= mid % g
+            g_in = 1 if (s == 0 and b == 0) else g
+            expected += (cin // g_in) * mid + bn(mid)      # grouped 1x1
+            expected += dwconv(3, mid) + bn(mid)
+            expected += (mid // g) * out + bn(out)         # grouped 1x1
+            cin = feats
+    expected += dense(800, 10)
+    assert n_params("shufflenetg2") == expected
+
+
+# ------------------------------------------------------------- ShuffleNetV2
+def test_shufflenetv2_param_count():
+    # Ma et al. ShuffleNetV2 1x: stages (116, 232, 464) x (4, 8, 4); basic
+    # blocks split channels in half and transform the right path (1x1 ->
+    # dw 3x3 -> 1x1); downsampling blocks transform both paths; 1024-ch
+    # head conv before the classifier.
+    expected = conv(3, 3, 24) + bn(24)
+    cin = 24
+    for feats, blocks in zip((116, 232, 464), (4, 8, 4)):
+        for b in range(blocks):
+            if b == 0:                                     # downsample
+                f = feats // 2
+                expected += dwconv(3, cin) + bn(cin)       # left dw
+                expected += conv(1, cin, f) + bn(f)        # left 1x1
+                expected += conv(1, cin, f) + bn(f)        # right 1x1
+                expected += dwconv(3, f) + bn(f)
+                expected += conv(1, f, feats - f) + bn(feats - f)
+            else:
+                half = cin // 2
+                f = feats - half
+                expected += conv(1, half, f) + bn(f)
+                expected += dwconv(3, f) + bn(f)
+                expected += conv(1, f, f) + bn(f)
+            cin = feats
+    expected += conv(1, 464, 1024) + bn(1024) + dense(1024, 10)
+    assert n_params("shufflenetv2") == expected
+
+
+# ---------------------------------------------------------- EfficientNet-B0
+def test_efficientnetb0_param_count():
+    # Tan & Le B0 rows (t, c, n, k, s); squeeze-excite ratio 0.25 of the
+    # BLOCK INPUT channels (the reference implementation's convention),
+    # SE convs carry biases; 32-ch stem; no separate head conv (CIFAR
+    # variant classifies off the last block's 320 channels).
+    cfg = [(1, 16, 1, 3, 1), (6, 24, 2, 3, 2), (6, 40, 2, 5, 2),
+           (6, 80, 3, 3, 2), (6, 112, 3, 5, 1), (6, 192, 4, 5, 2),
+           (6, 320, 1, 3, 1)]
+    expected = conv(3, 3, 32) + bn(32)
+    cin = 32
+    for t, c, n, k, _s in cfg:
+        for _ in range(n):
+            hidden = cin * t
+            if t != 1:
+                expected += conv(1, cin, hidden) + bn(hidden)
+            expected += dwconv(k, hidden) + bn(hidden)
+            sq = max(1, int(cin * 0.25))
+            expected += conv(1, hidden, sq, bias=True)
+            expected += conv(1, sq, hidden, bias=True)
+            expected += conv(1, hidden, c) + bn(c)
+            cin = c
+    expected += dense(320, 10)
+    assert n_params("efficientnetb0") == expected
+
+
+# ---------------------------------------------------------- RegNetX-200MF
+def test_regnetx_200mf_param_count():
+    # Radosavovic et al. X-200MF: widths (24, 56, 152, 368), depths
+    # (1, 1, 4, 7), group width 8, bottleneck ratio 1, projected shortcut
+    # on shape change. CIFAR stem 3x3/64.
+    cfg = [(24, 1, 1), (56, 1, 1), (152, 4, 2), (368, 7, 2)]
+    expected = conv(3, 3, 64) + bn(64)
+    cin = 64
+    for w, depth, s in cfg:
+        for b in range(depth):
+            stride = s if b == 0 else 1
+            expected += conv(1, cin, w) + bn(w)
+            expected += 9 * 8 * w + bn(w)          # grouped 3x3, gw=8
+            expected += conv(1, w, w) + bn(w)
+            if stride != 1 or cin != w:
+                expected += conv(1, cin, w) + bn(w)
+            cin = w
+    expected += dense(368, 10)
+    assert n_params("regnetx_200mf") == expected
+
+
+# ---------------------------------------------------------------- SimpleDLA
+def _dla_basic(cin, f, stride):
+    p = conv(3, cin, f) + bn(f) + conv(3, f, f) + bn(f)
+    if stride != 1 or cin != f:
+        p += conv(1, cin, f) + bn(f)
+    return p
+
+
+def _dla_tree(cin, f, stride, level):
+    if level == 1:
+        left = _dla_basic(cin, f, stride)
+        right = _dla_basic(f, f, 1)
+    else:
+        left = _dla_tree(cin, f, stride, level - 1)
+        right = _dla_tree(f, f, 1, level - 1)
+    return left + right + conv(1, 2 * f, f) + bn(f)        # root
+
+
+def test_simpledla_param_count():
+    # Yu et al. deep layer aggregation, the simplified CIFAR variant:
+    # three conv stems (16, 16, 32), trees (64 L1, 128 L2, 256 L2,
+    # 512 L1), roots aggregate left+right via a 1x1 conv.
+    expected = conv(3, 3, 16) + bn(16)
+    expected += conv(3, 16, 16) + bn(16)
+    expected += conv(3, 16, 32) + bn(32)
+    expected += _dla_tree(32, 64, 1, 1)
+    expected += _dla_tree(64, 128, 2, 2)
+    expected += _dla_tree(128, 256, 2, 2)
+    expected += _dla_tree(256, 512, 2, 1)
+    expected += dense(512, 10)
+    assert n_params("simpledla") == expected
