@@ -103,15 +103,11 @@ def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
         check_vma=False)
 
 
-def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
-                         tx: optax.GradientTransformation,
-                         num_microbatches: int = 1) -> Callable:
-    """One fully-jitted SPMD training step over the whole mesh.
-
-    Covers dp (batch sharding + XLA grad allreduce), pp (shard_map pipeline),
-    tp (Megatron psums), sp (ring attention) in one program — the
-    ``dryrun_multichip`` contract.
-    """
+def _make_loss_fn(cfg: tfm.TransformerConfig, spec: MeshSpec,
+                  num_microbatches: int) -> Callable:
+    """loss_fn(params, tokens, targets) -> scalar, through the shard_map
+    pipeline and the dense or chunked head — the single definition the
+    train step and the eval loss both jit."""
     pipeline_blocks = make_pipeline_apply(cfg, spec, num_microbatches)
 
     def loss_fn(params, tokens, targets):
@@ -122,6 +118,20 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
                                           cfg.loss_chunk)
         logits = tfm.unembed(params, x)
         return tfm.token_loss(logits, targets, aux, cfg)
+
+    return loss_fn
+
+
+def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
+                         tx: optax.GradientTransformation,
+                         num_microbatches: int = 1) -> Callable:
+    """One fully-jitted SPMD training step over the whole mesh.
+
+    Covers dp (batch sharding + XLA grad allreduce), pp (shard_map pipeline),
+    tp (Megatron psums), sp (ring attention) in one program — the
+    ``dryrun_multichip`` contract.
+    """
+    loss_fn = _make_loss_fn(cfg, spec, num_microbatches)
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
@@ -145,6 +155,27 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
         in_shardings=(p_sh, repl, tok_sh, tok_sh),
         out_shardings=(p_sh, repl, repl),
         donate_argnums=(0, 1))
+
+
+def make_spmd_eval_loss(cfg: tfm.TransformerConfig, spec: MeshSpec,
+                        num_microbatches: int = 1) -> Callable:
+    """Forward-only loss over the same dp/pp/tp/sp program as the train
+    step: ``eval_loss(params, tokens, targets) -> loss``. Shares the train
+    step's loss_fn (``_make_loss_fn``) so the two can never diverge."""
+    loss_fn = _make_loss_fn(cfg, spec, num_microbatches)
+
+    pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
+                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,
+                         learned_pos=cfg.pos_embedding == "learned",
+                         gqa=cfg.gqa,
+                         shard_kv=kv_heads_shardable(cfg, spec))
+    p_sh = jax.tree.map(lambda ps: NamedSharding(spec.mesh, ps), pspecs,
+                        is_leaf=lambda x: isinstance(x, P))
+    seq = spec.seq_axis if cfg.sp_axis else None
+    tok_sh = NamedSharding(spec.mesh, P(spec.data_axis, seq))
+    repl = NamedSharding(spec.mesh, P())
+    return jax.jit(loss_fn, in_shardings=(p_sh, tok_sh, tok_sh),
+                   out_shardings=repl)
 
 
 def shard_params(params: dict, cfg: tfm.TransformerConfig,

@@ -61,6 +61,13 @@ class LMTrainConfig:
     epochs: int = 1
     n_tokens: int = 200_000
     seed: int = 0
+    # Held-out evaluation (the reference evals every epoch,
+    # data_parallel.py:160-172): the stream's trailing ``eval_fraction``
+    # never appears in training batches; ``eval_batches`` fixed batches
+    # from it are scored each ``eval_every`` epochs (0 disables eval).
+    eval_fraction: float = 0.1
+    eval_batches: int = 8
+    eval_every: int = 1
     log_dir: str = "./log"
     log_name: str = "lm"
     checkpoint_dir: str = "./checkpoint"
@@ -95,6 +102,33 @@ class LMTrainer:
 
         self.tokens = make_token_stream(cfg.vocab_size, config.n_tokens,
                                         config.seed)
+        # Train/eval split: training samples only from the head of the
+        # stream; eval scores fixed batches from the held-out tail.
+        self._n_train = int(len(self.tokens) * (1.0 - config.eval_fraction))
+        min_train = config.seq_len + 2
+        if not (0.0 <= config.eval_fraction < 1.0):
+            raise ValueError(
+                f"eval_fraction must be in [0, 1), got {config.eval_fraction}")
+        if self._n_train < min_train:
+            raise ValueError(
+                f"eval_fraction={config.eval_fraction} leaves only "
+                f"{self._n_train} training tokens (< seq_len + 2)")
+        self._eval_loss = None
+        if config.eval_batches > 0 and config.eval_fraction > 0.0:
+            # The held-out tail must fit at least one eval window, or
+            # evaluate() would die mid-fit on an opaque rng bound error.
+            if len(self.tokens) - config.seq_len - 1 <= self._n_train:
+                raise ValueError(
+                    f"eval tail ({len(self.tokens) - self._n_train} tokens, "
+                    f"eval_fraction={config.eval_fraction}) cannot fit one "
+                    f"seq_len={config.seq_len} eval window; raise "
+                    f"eval_fraction/n_tokens or set eval_batches=0")
+            from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+                make_spmd_eval_loss,
+            )
+
+            self._eval_loss = make_spmd_eval_loss(
+                cfg, self.spec, num_microbatches=config.num_microbatches)
         self._rng = np.random.default_rng(config.seed + 1)
         from distributed_model_parallel_tpu.train.preemption import (
             PreemptionGuard,
@@ -116,10 +150,34 @@ class LMTrainer:
     # ------------------------------------------------------------------ data
     def sample_batch(self) -> tuple[np.ndarray, np.ndarray]:
         b, t = self.config.batch_size, self.config.seq_len
-        starts = self._rng.integers(0, len(self.tokens) - t - 1, size=b)
+        starts = self._rng.integers(0, self._n_train - t - 1, size=b)
         idx = starts[:, None] + np.arange(t + 1)[None]
         chunk = self.tokens[idx]
         return chunk[:, :-1], chunk[:, 1:]
+
+    def eval_batches(self):
+        """Deterministic held-out batches from the stream's tail (same
+        batches every epoch, so loss_val curves are comparable)."""
+        b, t = self.config.batch_size, self.config.seq_len
+        rng = np.random.default_rng(self.config.seed + 2)
+        lo, hi = self._n_train, len(self.tokens) - t - 1
+        for _ in range(self.config.eval_batches):
+            starts = rng.integers(lo, hi, size=b)
+            idx = starts[:, None] + np.arange(t + 1)[None]
+            chunk = self.tokens[idx]
+            yield chunk[:, :-1], chunk[:, 1:]
+
+    def evaluate(self) -> float:
+        """Mean held-out loss over the fixed eval batches."""
+        if self._eval_loss is None:
+            raise ValueError("eval disabled (eval_batches=0 or "
+                             "eval_fraction=0)")
+        total, n = 0.0, 0
+        for toks, tgts in self.eval_batches():
+            total += float(self._eval_loss(self.params, jnp.asarray(toks),
+                                           jnp.asarray(tgts)))
+            n += 1
+        return total / max(1, n)
 
     # ----------------------------------------------------------- checkpoint
     def _ckpt_tree(self):
@@ -171,7 +229,17 @@ class LMTrainer:
                                           self._ckpt_tree(), "lm-preempt",
                                           self.logger, epoch)
                     break
+                from distributed_model_parallel_tpu.train.trainer import (
+                    eval_now,
+                )
+
+                loss_val = (self.evaluate()
+                            if self._eval_loss is not None
+                            and eval_now(epoch, epochs,
+                                         self.config.eval_every)
+                            else None)
                 record = dict(epoch=epoch, loss_train=meter.avg,
+                              loss_val=loss_val,
                               time_per_batch=timer.step.avg,
                               time_load_per_batch=timer.data.avg,
                               tokens_per_s=self.config.batch_size
