@@ -73,6 +73,9 @@ def bench_lm() -> None:
             loss_chunk=int(os.environ.get("DMP_BENCH_LOSS_CHUNK", "0")),
             dtype=jnp.bfloat16),
         batch_size=batch, seq_len=seq, n_tokens=4 * batch * (seq + 1),
+        # A throughput bench needs no held-out eval, and at small batch the
+        # default 10% tail cannot fit one seq_len eval window (ADVICE r3).
+        eval_batches=0,
         log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",
     )
     t = LMTrainer(cfg)

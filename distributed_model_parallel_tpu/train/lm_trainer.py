@@ -65,8 +65,12 @@ class LMTrainConfig:
     # data_parallel.py:160-172): the stream's trailing ``eval_fraction``
     # never appears in training batches; ``eval_batches`` fixed batches
     # from it are scored each ``eval_every`` epochs (0 disables eval).
+    # ``eval_batches=None`` means auto: 8 when the held-out tail fits at
+    # least one seq_len eval window, otherwise eval is disabled with a
+    # warning. An explicit integer that cannot fit still raises — only
+    # the auto default degrades silently.
     eval_fraction: float = 0.1
-    eval_batches: int = 8
+    eval_batches: int | None = None
     eval_every: int = 1
     log_dir: str = "./log"
     log_name: str = "lm"
@@ -114,10 +118,31 @@ class LMTrainer:
                 f"eval_fraction={config.eval_fraction} leaves only "
                 f"{self._n_train} training tokens (< seq_len + 2)")
         self._eval_loss = None
-        if config.eval_batches > 0 and config.eval_fraction > 0.0:
+        tail_fits = len(self.tokens) - config.seq_len - 1 > self._n_train
+        if config.eval_batches is None:
+            # Auto: eval when the tail fits a window, warn-and-skip when it
+            # doesn't (long-context configs where 0.1*n_tokens < seq_len+1
+            # must not become hard startup failures — ADVICE r3).
+            self._n_eval_batches = 8 if tail_fits else 0
+            if not tail_fits and config.eval_fraction > 0.0:
+                import warnings
+
+                warnings.warn(
+                    f"held-out tail ({len(self.tokens) - self._n_train} "
+                    f"tokens, eval_fraction={config.eval_fraction}) cannot "
+                    f"fit one seq_len={config.seq_len} eval window; "
+                    f"disabling eval (set eval_batches explicitly to make "
+                    f"this an error)", stacklevel=2)
+                # Nothing will ever read the carved-out tail — give it back
+                # to training rather than silently dropping 10% of the
+                # stream.
+                self._n_train = len(self.tokens)
+        else:
+            self._n_eval_batches = config.eval_batches
+        if self._n_eval_batches > 0 and config.eval_fraction > 0.0:
             # The held-out tail must fit at least one eval window, or
             # evaluate() would die mid-fit on an opaque rng bound error.
-            if len(self.tokens) - config.seq_len - 1 <= self._n_train:
+            if not tail_fits:
                 raise ValueError(
                     f"eval tail ({len(self.tokens) - self._n_train} tokens, "
                     f"eval_fraction={config.eval_fraction}) cannot fit one "
@@ -161,7 +186,7 @@ class LMTrainer:
         b, t = self.config.batch_size, self.config.seq_len
         rng = np.random.default_rng(self.config.seed + 2)
         lo, hi = self._n_train, len(self.tokens) - t - 1
-        for _ in range(self.config.eval_batches):
+        for _ in range(self._n_eval_batches):
             starts = rng.integers(lo, hi, size=b)
             idx = starts[:, None] + np.arange(t + 1)[None]
             chunk = self.tokens[idx]

@@ -84,3 +84,27 @@ def test_lm_eval_disabled(tmp_path):
                        steps_per_epoch=2))
     history = t.fit()
     assert history[0]["loss_val"] is None
+
+
+def test_lm_eval_auto_degrades_when_tail_too_short(tmp_path):
+    """Auto eval (eval_batches=None): a stream whose 10% tail cannot fit
+    one seq_len window warns and disables eval instead of raising at
+    construction (ADVICE r3 — long-context configs must keep working);
+    an explicit eval_batches that cannot fit still raises."""
+    import warnings
+
+    import pytest
+
+    # seq_len 32 with n_tokens 320: tail = 32 tokens < seq_len+1 window.
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        t = LMTrainer(_cfg(tmp_path, n_tokens=320, steps_per_epoch=1))
+    assert t._n_eval_batches == 0
+    assert any("eval window" in str(w.message) for w in rec)
+    # the unusable tail is reclaimed for training, not silently dropped
+    assert t._n_train == len(t.tokens)
+    with pytest.raises(ValueError, match="eval window"):
+        LMTrainer(_cfg(tmp_path, n_tokens=320, eval_batches=4))
+    # Auto with a long enough tail keeps eval on.
+    t2 = LMTrainer(_cfg(tmp_path))
+    assert t2._n_eval_batches == 8 and t2._eval_loss is not None
