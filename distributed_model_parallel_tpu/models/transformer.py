@@ -415,11 +415,10 @@ def token_loss(logits: jax.Array, targets: jax.Array, aux: jax.Array,
     return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
-def chunked_token_loss(params: dict, x: jax.Array, targets: jax.Array,
-                       aux: jax.Array, cfg: TransformerConfig,
-                       chunk: int) -> jax.Array:
-    """``token_loss`` over ``unembed(x)`` without ever materializing the
-    ``[B, T, V]`` logits tensor.
+def chunked_nll_sum(params: dict, x: jax.Array, targets: jax.Array,
+                    chunk: int) -> jax.Array:
+    """SUM of next-token NLL over ``unembed(x)`` without ever materializing
+    the ``[B, T, V]`` logits tensor.
 
     At long context the single-chip HBM ceiling is the vocabulary head,
     not attention: seq-64k x 32k-vocab logits are 4.3 GB bf16 plus f32
@@ -430,7 +429,12 @@ def chunked_token_loss(params: dict, x: jax.Array, targets: jax.Array,
     so the backward rematerializes them per chunk: peak memory drops to
     O(B * chunk * V) for one extra head forward of recompute (the same
     FLOPs-for-HBM trade the block remat makes; the fused-linear-CE trick,
-    expressed as scan + remat instead of a custom kernel)."""
+    expressed as scan + remat instead of a custom kernel).
+
+    Sum units so callers pick their own normalization: the dense-head-
+    equivalent mean loss (``chunked_token_loss``) and the SPMD 1F1B head
+    (``parallel/spmd_pipeline``, which accumulates sums across microbatches
+    and shards) share this one definition."""
     b, t, d = x.shape
     if t % chunk:
         raise ValueError(f"seq len {t} not divisible by loss_chunk={chunk}")
@@ -447,7 +451,17 @@ def chunked_token_loss(params: dict, x: jax.Array, targets: jax.Array,
         return carry + nll.sum(), None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
-    return total / (b * t) + cfg.moe_aux_weight * aux
+    return total
+
+
+def chunked_token_loss(params: dict, x: jax.Array, targets: jax.Array,
+                       aux: jax.Array, cfg: TransformerConfig,
+                       chunk: int) -> jax.Array:
+    """``token_loss`` over ``unembed(x)`` via ``chunked_nll_sum`` — the
+    [B, T, V] logits never materialize (see that docstring)."""
+    b, t, _ = x.shape
+    return (chunked_nll_sum(params, x, targets, chunk) / (b * t)
+            + cfg.moe_aux_weight * aux)
 
 
 def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,

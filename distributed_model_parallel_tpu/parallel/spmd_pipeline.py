@@ -103,6 +103,337 @@ def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
         check_vma=False)
 
 
+def _flat_axis_names(*entries) -> list[str]:
+    """Flatten axis-name entries (str | tuple | None) into a list."""
+    out: list[str] = []
+    for e in entries:
+        if e is None:
+            continue
+        if isinstance(e, (tuple, list)):
+            out.extend(e)
+        else:
+            out.append(e)
+    return out
+
+
+def _spec_axes(ps: P) -> set[str]:
+    """Mesh axis names a PartitionSpec shards over."""
+    out: set[str] = set()
+    for entry in ps:
+        out.update(_flat_axis_names(entry))
+    return out
+
+
+def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
+                            num_microbatches: int) -> Callable:
+    """Hand-scheduled 1F1B: ``(params, tokens, targets) -> (loss, grads)``
+    as ONE shard_map program over the full mesh.
+
+    Why not whole-program autodiff (the GPipe path): under
+    ``jax.value_and_grad`` the backward runs only after every forward tick,
+    so all M microbatches' residuals are live at the peak — the most
+    memory-hungry schedule is the only one AD can produce. Here forward and
+    backward ticks interleave explicitly (the 1F1B order: microbatch m's
+    backward starts the moment its loss exists, S-1 ticks after injection),
+    so at most ``2S-1`` stage inputs are stashed per device instead of M.
+    Backward recomputes each stage forward from its stashed input
+    (activation stashing + recompute, the standard 1F1B memory/FLOPs
+    trade; with ``cfg.remat`` the GPipe path recomputes too, making the
+    FLOPs identical and the memory strictly better for M > 2S-1).
+
+    Schedule (lockstep SPMD): global tick ``T`` runs forward tick ``T``
+    (stage s computes microbatch ``T - s``) and backward tick ``T - (S-1)``
+    (stage s re-derives microbatch ``T-(S-1) - (S-1-s)``), so the head loss
+    computed at the last stage on tick T seeds that same tick's backward.
+    ``M + 2S - 2`` ticks total. The M steady-state ticks — one full
+    forward slot, head loss, and backward slot each, nothing masked-idle —
+    run as a ``lax.scan``, which bounds peak memory *by construction*:
+    the loop carry (stash ring + chain states + grad accumulators) plus
+    ONE tick's transients, regardless of M. (An earlier draft unrolled the
+    ticks and relied on ``optimization_barrier`` to keep XLA from hoisting
+    every forward ahead of the backwards; XLA:CPU strips the barriers
+    after layout assignment and the GPipe memory profile silently
+    returned — the scan makes the liveness structural instead.) The S-1
+    warmup (forward-only) and S-1 drain (backward-only) ticks unroll
+    outside the scan.
+
+    Gradient correctness under ``check_vma=False`` (verified against the
+    autodiff GPipe step by tests/test_spmd_1f1b.py): the transpose of an
+    in-body ``psum`` re-psums the cotangent, so a *replicated* cotangent
+    entering the chain is inflated by the axis size exactly once, while
+    chained device-varying cotangents sum correctly. Scaling the head
+    cotangent by ``1/(n_model * n_expert)`` turns it into per-device
+    partials; every per-stage vjp then yields exact local grads for
+    axis-sharded leaves and partial grads for replicated leaves, which one
+    final psum over each leaf's missing axes completes. The head/final-LN
+    leaves sit *above* the pipeline (replicated compute off the unscaled
+    cotangent), so they alone skip the model/expert sum.
+
+    Replaces the reference's placeholder-seed backward + blocking-P2P ring
+    (``distributed_layers.py:17-26``, ``utils.py:59-63``) at the schedule
+    level: same per-microbatch interleave PipeDream-flush runs per-process,
+    expressed as one jitted SPMD program.
+    """
+    S = spec.num_stages
+    M = num_microbatches
+    mesh = spec.mesh
+    stage_axis = spec.stage_axis
+    all_axes = tuple(mesh.axis_names)
+    data_axes = _flat_axis_names(spec.data_axis)
+    seq_axes = [spec.seq_axis] if cfg.sp_axis else []
+    n_model = mesh.shape[spec.model_axis]
+    n_expert = mesh.shape[spec.expert_axis]
+    d_all = 1
+    for a in all_axes:
+        d_all *= mesh.shape[a]
+    # Batch-sharded mesh axes: gradient contributions differ per shard and
+    # always sum. The stage axis sums too (masked: one stage holds the real
+    # value). model/expert sum only where the leaf spec lacks them — and
+    # never for the above-pipeline head group (see docstring).
+    batch_axes = data_axes + seq_axes
+
+    pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
+                         moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,
+                         learned_pos=cfg.pos_embedding == "learned",
+                         gqa=cfg.gqa,
+                         shard_kv=kv_heads_shardable(cfg, spec))
+
+    def _reduce_axes(leaf_spec: P, above_pipeline: bool) -> tuple[str, ...]:
+        present = _spec_axes(leaf_spec)
+        axes = list(batch_axes)
+        if stage_axis not in present:     # stage-sharded leaves (blocks)
+            axes.append(stage_axis)       # own their shard — never summed
+        if not above_pipeline:
+            for a in (spec.model_axis, spec.expert_axis):
+                if a not in present:
+                    axes.append(a)
+        return tuple(a for a in axes if mesh.shape[a] > 1)
+
+    # Stash ring: stage s's input written at forward tick t is re-read at
+    # global tick t + 2(S-1) - 2s, so 2S-1 slots guarantee no collision
+    # (max live span, at stage 0). Never more slots than forward ticks.
+    K = min(2 * S - 1, M + S - 1)
+
+    def _head_nll_sum(head_p: dict, x: jax.Array,
+                      targets: jax.Array) -> jax.Array:
+        """Sum (not mean) of next-token NLL over the local shard, chunked
+        per cfg.loss_chunk (shares tfm.chunked_nll_sum with the GPipe
+        path's chunked_token_loss so the two heads cannot drift)."""
+        t = x.shape[1]
+        if cfg.loss_chunk:
+            if t % cfg.loss_chunk:
+                # Same loud failure as the GPipe head — a silent dense
+                # fallback would materialize the [mbs, t, V] logits the
+                # chunk knob exists to avoid. Under sequence parallelism
+                # t is the PER-SHARD length, so the chunk must divide it.
+                raise ValueError(
+                    f"local seq len {t} not divisible by "
+                    f"loss_chunk={cfg.loss_chunk} (with sequence "
+                    f"parallelism loss_chunk must divide seq_len / sp)")
+            return tfm.chunked_nll_sum(head_p, x, targets, cfg.loss_chunk)
+        logp = jax.nn.log_softmax(
+            tfm.unembed(head_p, x).astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, targets[..., None],
+                                    axis=-1)[..., 0].sum()
+
+    def _embed_local(embed_p: dict, toks: jax.Array) -> jax.Array:
+        x = embed_p["embed"][toks]
+        if cfg.pos_embedding == "learned":
+            t = toks.shape[1]
+            if cfg.sp_axis:
+                # Local slice of the position table at this shard's global
+                # offset (the GPipe path slices outside the shard_map where
+                # t is global; here it is local).
+                off = jax.lax.axis_index(spec.seq_axis) * t
+                pos = jax.lax.dynamic_slice_in_dim(embed_p["pos"], off, t)
+            else:
+                pos = embed_p["pos"][:t]
+            x = x + pos[None]
+        return x
+
+    def _blocks_fwd(blocks_local, x):
+        return tfm.blocks_scan(blocks_local, x, cfg)
+
+    def fwd_bwd(params, tokens, targets):
+        s = jax.lax.axis_index(stage_axis)
+        blocks = params["blocks"]
+        head_p = {"ln_f_scale": params["ln_f_scale"],
+                  "ln_f_bias": params["ln_f_bias"],
+                  "head": params["head"]}
+        embed_keys = (["embed", "pos"] if cfg.pos_embedding == "learned"
+                      else ["embed"])
+        embed_p = {k: params[k] for k in embed_keys}
+
+        b, t = tokens.shape
+        if b % M:
+            raise ValueError(f"local batch {b} not divisible by M={M}")
+        mbs = b // M
+        toks_mb = tokens.reshape(M, mbs, t)
+        tgts_mb = targets.reshape(M, mbs, t)
+        d = cfg.d_model
+        cot_scale = 1.0 / (n_model * n_expert)
+        n_total = mbs * M * t             # global token count (static)
+        for a in batch_axes:
+            n_total *= mesh.shape[a]
+
+        state_f = jnp.zeros((mbs, t, d), cfg.dtype)
+        state_b = jnp.zeros((mbs, t, d), cfg.dtype)
+        stash = jnp.zeros((K, mbs, t, d), cfg.dtype)
+        loss_acc = jnp.zeros((), jnp.float32)
+        aux_sum = jnp.zeros((), jnp.float32)
+        g_blocks = jax.tree.map(jnp.zeros_like, blocks)
+        g_head = jax.tree.map(jnp.zeros_like, head_p)
+        g_embed = jax.tree.map(jnp.zeros_like, embed_p)
+
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+        def mask_tree(tree, keep):
+            return jax.tree.map(lambda g: jnp.where(keep, g, 0), tree)
+
+        def fwd_slot(ft, state_f, stash, aux_sum):
+            """Forward tick ``ft`` (static int or traced scalar): stage 0
+            injects microbatch ft (masked), every stage stashes its input
+            and advances its blocks. Returns the POST-block state (the fwd
+            ppermute happens at the caller, after the head slot reads it)."""
+            idx = jnp.clip(jnp.asarray(ft), 0, M - 1)
+            toks_i = jax.lax.dynamic_index_in_dim(toks_mb, idx, 0,
+                                                  keepdims=False)
+            inject = jnp.logical_and(jnp.asarray(ft) < M, s == 0)
+            state_f = jnp.where(
+                inject, _embed_local(embed_p, toks_i).astype(cfg.dtype),
+                state_f)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, state_f, jnp.mod(jnp.asarray(ft), K), 0)
+            state_f, aux = _blocks_fwd(blocks, state_f)
+            real_f = jnp.logical_and(jnp.asarray(ft) - s >= 0,
+                                     jnp.asarray(ft) - s < M)
+            aux_sum = aux_sum + jnp.where(real_f, aux, 0.0)
+            return state_f, stash, aux_sum
+
+        def bwd_slot(bt, dy, state_b, stash, g_blocks, g_embed):
+            """Backward tick ``bt``: stage s re-derives microbatch
+            bt - (S-1-s) from its stash slot and pulls the cotangent
+            through its blocks (and, at stage 0, into the embedding).
+            ``dy`` is the head cotangent seeding stage S-1 (None on drain
+            ticks, where the chain state carries everything)."""
+            cot_in = state_b
+            if dy is not None:
+                cot_in = jnp.where(s == S - 1, dy, cot_in)
+            real_b = jnp.logical_and(jnp.asarray(bt) - (S - 1 - s) >= 0,
+                                     jnp.asarray(bt) - (S - 1 - s) < M)
+            slot = jnp.mod(jnp.asarray(bt) + 2 * s - (S - 1), K)
+            x_in = jax.lax.dynamic_index_in_dim(stash, slot, axis=0,
+                                                keepdims=False)
+            _, stage_vjp = jax.vjp(_blocks_fwd, blocks, x_in)
+            # All grads are accumulated in SUM units and divided by
+            # n_total once at the end, so the aux cotangent (whose true
+            # scale is w / (M * d_all)) pre-multiplies by n_total.
+            aux_cot = jnp.where(
+                real_b, cfg.moe_aux_weight * n_total / (M * d_all), 0.0)
+            g_b, dx = stage_vjp((cot_in, aux_cot.astype(jnp.float32)))
+            g_blocks = jax.tree.map(
+                jnp.add, g_blocks, mask_tree(g_b, real_b))
+
+            # Stage 0 finished a microbatch's block backward: fold its
+            # cotangent into the embedding (recomputed vjp — a gather).
+            m0 = jnp.asarray(bt) - (S - 1)
+            toks_0 = jax.lax.dynamic_index_in_dim(
+                toks_mb, jnp.clip(m0, 0, M - 1), 0, keepdims=False)
+            _, emb_vjp = jax.vjp(
+                lambda ep: _embed_local(ep, toks_0).astype(cfg.dtype),
+                embed_p)
+            g_e, = emb_vjp(dx)
+            g_embed = jax.tree.map(
+                jnp.add, g_embed,
+                mask_tree(g_e, jnp.logical_and(m0 >= 0, s == 0)))
+
+            state_b = dx.astype(cfg.dtype)
+            if S > 1:
+                state_b = jax.lax.ppermute(state_b, stage_axis, perm_bwd)
+            return state_b, g_blocks, g_embed
+
+        # ---- warmup: forward-only ticks 0 .. S-2 (unrolled; S-1 ticks).
+        for ft in range(S - 1):
+            state_f, stash, aux_sum = fwd_slot(ft, state_f, stash, aux_sum)
+            if S > 1:
+                state_f = jax.lax.ppermute(state_f, stage_axis, perm_fwd)
+
+        # ---- steady state: M ticks, each a full forward slot + head loss
+        # + backward slot. A lax.scan so one tick's transients are the
+        # whole transient footprint (see docstring).
+        def steady_tick(carry, i):
+            (state_f, state_b, stash, loss_acc, aux_sum, g_blocks, g_head,
+             g_embed) = carry
+            ft = i + (S - 1)              # fwd tick; emit index = bwd tick = i
+            state_f, stash, aux_sum = fwd_slot(ft, state_f, stash, aux_sum)
+
+            # head slot: stage S-1 just finished microbatch i.
+            tgt_i = jax.lax.dynamic_index_in_dim(tgts_mb, i, 0,
+                                                 keepdims=False)
+            nll, head_vjp = jax.vjp(
+                lambda hp, x: _head_nll_sum(hp, x, tgt_i), head_p, state_f)
+            is_last = s == S - 1
+            loss_acc = loss_acc + jnp.where(is_last, nll, 0.0)
+            g_h, dy = head_vjp(jnp.ones((), jnp.float32))
+            g_head = jax.tree.map(jnp.add, g_head, mask_tree(g_h, is_last))
+            dy = jnp.where(is_last, dy * cot_scale,
+                           jnp.zeros_like(dy)).astype(cfg.dtype)
+
+            state_b, g_blocks, g_embed = bwd_slot(
+                i, dy, state_b, stash, g_blocks, g_embed)
+            if S > 1:
+                state_f = jax.lax.ppermute(state_f, stage_axis, perm_fwd)
+            return (state_f, state_b, stash, loss_acc, aux_sum, g_blocks,
+                    g_head, g_embed), None
+
+        carry = (state_f, state_b, stash, loss_acc, aux_sum, g_blocks,
+                 g_head, g_embed)
+        carry, _ = jax.lax.scan(steady_tick, carry, jnp.arange(M))
+        (state_f, state_b, stash, loss_acc, aux_sum, g_blocks, g_head,
+         g_embed) = carry
+
+        # ---- drain: backward-only ticks bt = M .. M+S-2 (unrolled).
+        for bt in range(M, M + S - 1):
+            state_b, g_blocks, g_embed = bwd_slot(
+                bt, None, state_b, stash, g_blocks, g_embed)
+
+        # ---- reductions: complete each leaf's partial grads over the mesh
+        # axes its spec does not shard (docstring), and assemble the loss.
+        def reduce_leaf(g, ps, above):
+            axes = _reduce_axes(ps, above)
+            return jax.lax.psum(g, axes) if axes else g
+
+        grads = {"blocks": jax.tree.map(
+            lambda g, ps: reduce_leaf(g, ps, False), g_blocks,
+            pspecs["blocks"], is_leaf=lambda x: isinstance(x, P))}
+        grads.update({k: reduce_leaf(v, pspecs[k], True)
+                      for k, v in g_head.items()})
+        grads.update({k: reduce_leaf(v, pspecs[k], False)
+                      for k, v in g_embed.items()})
+        scale = 1.0 / n_total             # sum units -> mean-loss units
+        grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+        loss_axes = tuple(a for a in batch_axes + [stage_axis]
+                          if mesh.shape[a] > 1)
+        loss = (jax.lax.psum(loss_acc, loss_axes) if loss_axes
+                else loss_acc) / n_total
+        aux_all = (jax.lax.psum(aux_sum, tuple(
+            a for a in all_axes if mesh.shape[a] > 1))
+            if any(mesh.shape[a] > 1 for a in all_axes) else aux_sum)
+        loss = loss + cfg.moe_aux_weight * aux_all / (M * d_all)
+        return loss, grads
+
+    seq = spec.seq_axis if cfg.sp_axis else None
+    x_spec = P(spec.data_axis, seq)
+    grad_specs = {k: v for k, v in pspecs.items()}
+    return jax.shard_map(
+        fwd_bwd, mesh=mesh,
+        in_specs=(pspecs, x_spec, x_spec),
+        out_specs=(P(), grad_specs),
+        check_vma=False)
+
+
 def _make_loss_fn(cfg: tfm.TransformerConfig, spec: MeshSpec,
                   num_microbatches: int) -> Callable:
     """loss_fn(params, tokens, targets) -> scalar, through the shard_map
@@ -124,20 +455,41 @@ def _make_loss_fn(cfg: tfm.TransformerConfig, spec: MeshSpec,
 
 def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
                          tx: optax.GradientTransformation,
-                         num_microbatches: int = 1) -> Callable:
+                         num_microbatches: int = 1,
+                         schedule: str = "gpipe") -> Callable:
     """One fully-jitted SPMD training step over the whole mesh.
 
     Covers dp (batch sharding + XLA grad allreduce), pp (shard_map pipeline),
     tp (Megatron psums), sp (ring attention) in one program — the
     ``dryrun_multichip`` contract.
-    """
-    loss_fn = _make_loss_fn(cfg, spec, num_microbatches)
 
-    def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    ``schedule`` picks how the pipeline's backward is produced: ``"gpipe"``
+    differentiates the forward tick loop whole-program (all M microbatches'
+    residuals live at peak), ``"1f1b"`` hand-interleaves forward and
+    backward ticks (``make_1f1b_loss_and_grad`` — at most 2S-1 stashed
+    stage inputs per device). Loss and grads agree to float tolerance
+    (tests/test_spmd_1f1b.py); memory and recompute differ.
+    """
+    if schedule == "1f1b":
+        loss_and_grad = make_1f1b_loss_and_grad(cfg, spec, num_microbatches)
+
+        def step(params, opt_state, tokens, targets):
+            loss, grads = loss_and_grad(params, tokens, targets)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+    elif schedule == "gpipe":
+        loss_fn = _make_loss_fn(cfg, spec, num_microbatches)
+
+        def step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                      targets)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+    else:
+        raise ValueError(f"unknown spmd pipeline schedule {schedule!r}; "
+                         f"known: gpipe, 1f1b")
 
     pspecs = param_specs(spec.stage_axis, cfg.tp_axis,
                          moe=bool(cfg.moe_experts), ep_axis=cfg.ep_axis,

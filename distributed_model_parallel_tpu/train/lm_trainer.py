@@ -57,6 +57,11 @@ class LMTrainConfig:
     batch_size: int = 8
     seq_len: int = 128
     num_microbatches: int = 1
+    # SPMD pipeline schedule: "gpipe" (whole-program AD; all M microbatches'
+    # residuals live at peak) or "1f1b" (hand-interleaved backward; peak
+    # activation memory bounded by the stage count, not M —
+    # parallel/spmd_pipeline.make_1f1b_loss_and_grad).
+    pipeline_schedule: str = "gpipe"
     steps_per_epoch: int = 50
     epochs: int = 1
     n_tokens: int = 200_000
@@ -97,7 +102,8 @@ class LMTrainer:
                                  config.epochs)
         self._step = make_spmd_train_step(
             cfg, self.spec, self.tx,
-            num_microbatches=config.num_microbatches)
+            num_microbatches=config.num_microbatches,
+            schedule=config.pipeline_schedule)
 
         host_params = tfm.init_params(jax.random.key(config.seed), cfg)
         self.opt_state = jax.device_put(

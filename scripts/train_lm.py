@@ -62,6 +62,12 @@ def parse_args():
                         "lever (0 = dense head)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--microbatches", type=int, default=1)
+    p.add_argument("--schedule", default="gpipe", choices=["gpipe", "1f1b"],
+                   help="SPMD pipeline schedule: gpipe holds all "
+                        "microbatches' activations through the backward; "
+                        "1f1b interleaves forward/backward so peak "
+                        "activation memory is bounded by the stage count "
+                        "(benchmarks/pipeline_memory.json)")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--epochs", type=int, default=1)
@@ -108,6 +114,7 @@ def main():
                                   warmup_steps=10),
         batch_size=args.batch_size, seq_len=args.seq_len,
         num_microbatches=args.microbatches,
+        pipeline_schedule=args.schedule,
         steps_per_epoch=args.steps, epochs=args.epochs, resume=args.resume,
     )
     LMTrainer(config).fit()
