@@ -26,6 +26,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from distributed_model_parallel_tpu.ops.ring_attention import (
     full_attention,
@@ -475,45 +476,59 @@ def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,
     return token_loss(logits, targets, aux, cfg)
 
 
-def _decode_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
-                  pos: jax.Array, cfg: TransformerConfig):
-    """One block for ONE token position with a KV cache.
+def _cached_block(bp: dict, kc: jax.Array, vc: jax.Array, x: jax.Array,
+                  positions: jax.Array, cfg: TransformerConfig, *,
+                  tp_axis: str | None = None):
+    """One block for C contiguous token positions with a KV cache.
 
-    x: [B, 1, d]; kc/vc: [B, T_total, Hkv, Dh] (this layer's cache — kv
-    heads only, the GQA memory win). Returns (x, kc, vc) with the caches
-    updated at ``pos``. Masking is by position index, so shapes stay
-    static under scan (no data-dependent slicing).
+    x: [B, C, d]; positions: [C] absolute positions (contiguous);
+    kc/vc: [B, T_total, Hkv, Dh] (this layer's cache — kv heads only, the
+    GQA memory win; Hkv is the LOCAL head count under tensor parallelism).
+    Returns (x, kc, vc) with the caches updated at ``positions``. Masking
+    is by position index, so shapes stay static under scan (no data-
+    dependent slicing). C=1 is the decode step; C=chunk is chunked
+    prefill (scores peak at O(C * T_total) instead of O(T0^2)).
+
+    ``tp_axis`` enables the Megatron psums (wo and the dense FFN) when the
+    block runs inside a shard_map with head-sharded weights — the decode
+    counterpart of ``block_apply``'s training-path psums.
     """
-    b = x.shape[0]
+    b, c = x.shape[:2]
     total = kc.shape[1]
 
     h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-    q, k, v = _qkv_proj(bp, h, cfg)      # q:[B,1,H,Dh] kv:[B,1,Hkv,Dh]
+    q, k, v = _qkv_proj(bp, h, cfg)      # q:[B,C,H,Dh] kv:[B,C,Hkv,Dh]
     if cfg.pos_embedding == "rope":
         # The cache holds *rotated* keys (prefill rotates too), so one
         # rotation at insert time makes scores relative-position correct.
-        positions = jnp.reshape(pos, (1,))
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
-    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                      (0, positions[0], 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                      (0, positions[0], 0, 0))
     # Grouped scores: query head h attends kv head h // G (G=1 for MHA),
     # matching _repeat_kv's head mapping in the training path.
     hkv = kc.shape[2]
-    qg = q.reshape(b, 1, hkv, q.shape[2] // hkv, cfg.head_dim)
+    qg = q.reshape(b, c, hkv, q.shape[2] // hkv, cfg.head_dim)
     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc) * (cfg.head_dim ** -0.5)
     # Same (pos - W, pos] band predicate as the training kernels
-    # (ops/pallas_attention.band_keep; pure causal when attn_window=None).
+    # (ops/pallas_attention.band_keep; pure causal when attn_window=None) —
+    # it also masks the cache's not-yet-written tail (key pos > query pos).
     from distributed_model_parallel_tpu.ops.pallas_attention import band_keep
 
-    keep = band_keep(pos, jnp.arange(total), cfg.attn_window)
-    s = jnp.where(keep[None, None, None, None, :], s, -jnp.inf)
+    keep = band_keep(positions[:, None], jnp.arange(total)[None, :],
+                     cfg.attn_window)                  # [C, total]
+    s = jnp.where(keep[None, None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vc)         # [B,1,Hkv,G,Dh]
-    x = x + o.reshape(b, 1, -1) @ bp["wo"]
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vc)         # [B,C,Hkv,G,Dh]
+    o = o.reshape(b, c, -1) @ bp["wo"]
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
 
     h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-    h, _ = _ffn(bp, h, cfg, tp_axis=None, ep_axis=None)
+    h, _ = _ffn(bp, h, cfg, tp_axis=tp_axis, ep_axis=None)
     return x + h, kc, vc
 
 
@@ -544,7 +559,8 @@ def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
 def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
              steps: int, *, rng: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
-             top_p: float | None = None) -> jax.Array:
+             top_p: float | None = None, tp_axis: str | None = None,
+             prefill_chunk: int | None = None) -> jax.Array:
     """Autoregressive decoding with a per-layer KV cache.
 
     prompt: [B, T0] int32 -> [B, T0 + steps]. Greedy when temperature == 0,
@@ -553,8 +569,15 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     set reaching cumulative probability p) — both static-shape jittable.
     The whole decode is one jittable ``lax.scan`` over positions (static
     shapes; cache updated via dynamic_update_slice), the TPU-native
-    replacement for a Python token-by-token loop. Single-program only — no
-    mesh axes are consulted (run it on replicated params).
+    replacement for a Python token-by-token loop.
+
+    ``tp_axis`` runs the cached blocks tensor-parallel: call inside a
+    shard_map whose block weights are head-sharded over that axis (the
+    training layout — ``generate_sharded`` wraps this) and the KV cache
+    holds only the local heads while wo/FFN psums complete each block.
+    ``prefill_chunk`` processes the prompt in C-token slices against the
+    growing cache instead of one [T0, T0]-score batched forward: same
+    FLOPs, peak attention memory O(C * T_total) — the long-prompt lever.
 
     The reference has no inference path at all; this rounds out the LM
     tooling the flagship model needs.
@@ -573,6 +596,13 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
         raise ValueError(f"top_k must be in [1, {cfg.vocab_size}], got {top_k}")
     if top_p is not None and not (0.0 < top_p <= 1.0):
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if prefill_chunk is not None:
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        if t0 % prefill_chunk:
+            raise ValueError(f"prompt length {t0} not divisible by "
+                             f"prefill_chunk={prefill_chunk}")
     if rng is None:
         rng = jax.random.key(0)
 
@@ -586,46 +616,90 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
             return jax.random.categorical(sub, logits).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # -- Prefill: one batched forward over the whole prompt fills every
-    # layer's KV cache at once (O(1) forwards, not O(t0) sequential steps).
-    x = embed(params, prompt, cfg)
-
-    def prefill_layer(x, bp):
-        h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
-        q, k, v = _qkv_proj(bp, h, cfg)    # kv carry Hkv heads
-        if cfg.pos_embedding == "rope":
-            positions = jnp.arange(t0)
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
-        # Cache the Hkv-head k/v; attention itself runs on broadcast heads.
-        kr, vr = _repeat_kv(k, q), _repeat_kv(v, q)
-        if cfg.attn_window is None:
-            o = full_attention(q, kr, vr, causal=True)
-        else:
-            # Banded prefill: the shared band predicate keeps this, the
-            # cached decode, and the training kernels on one definition.
-            # Prompts are short, so the explicit mask is fine here.
-            from distributed_model_parallel_tpu.ops.pallas_attention import (
-                band_keep,
-            )
-
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * (cfg.head_dim ** -0.5)
-            posa = jnp.arange(t0)
-            keep = band_keep(posa[:, None], posa[None, :], cfg.attn_window)
-            s = jnp.where(keep[None, None], s, -jnp.inf)
-            o = jnp.einsum("bhqk,bkhd->bqhd",
-                           jax.nn.softmax(s, axis=-1).astype(q.dtype), vr)
-        x = x + o.reshape(b, t0, -1) @ bp["wo"]
-        h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
-        h, _ = _ffn(bp, h, cfg, tp_axis=None, ep_axis=None)
-        return x + h, (k.astype(cfg.dtype), v.astype(cfg.dtype))
-
-    x, (ks, vs) = jax.lax.scan(prefill_layer, x, params["blocks"])
-    pad = [(0, 0), (0, 0), (0, total - t0), (0, 0), (0, 0)]
-    cache_k = jnp.pad(ks, pad)               # [L, B, total, Hkv, Dh]
-    cache_v = jnp.pad(vs, pad)
     rng, sub = jax.random.split(rng)
-    tok0 = sample(unembed(params, x)[:, -1], sub)   # token at position t0
+    if prefill_chunk is not None:
+        # -- Chunked prefill: run each C-token slice of the prompt through
+        # every layer's cached block (intra-slice causality and the band
+        # come from the shared position mask), writing the cache as it
+        # goes. The batched path's [T0, T0] score tensor never exists.
+        hkv = (params["blocks"]["wkv"].shape[2] if cfg.gqa
+               else params["blocks"]["wqkv"].shape[2])   # LOCAL kv heads
+        cache_k = jnp.zeros((cfg.n_layers, b, total, hkv, cfg.head_dim),
+                            cfg.dtype)
+        cache_v = jnp.zeros_like(cache_k)
+        n_chunks = t0 // prefill_chunk
+        toks_c = prompt.reshape(b, n_chunks, prefill_chunk).swapaxes(0, 1)
+
+        def chunk_step(carry, xs):
+            cache_k, cache_v = carry
+            toks, j = xs
+            positions = j * prefill_chunk + jnp.arange(prefill_chunk)
+            x = params["embed"][toks]
+            if cfg.pos_embedding == "learned":
+                x = x + jax.lax.dynamic_slice_in_dim(
+                    params["pos"], j * prefill_chunk, prefill_chunk)[None]
+
+            def layer(x, xs2):
+                bp, kc, vc = xs2
+                x, kc, vc = _cached_block(bp, kc, vc, x, positions, cfg,
+                                          tp_axis=tp_axis)
+                return x, (kc, vc)
+
+            x, (cache_k, cache_v) = jax.lax.scan(
+                layer, x, (params["blocks"], cache_k, cache_v))
+            return (cache_k, cache_v), unembed(params, x[:, -1:])[:, 0]
+
+        (cache_k, cache_v), chunk_logits = jax.lax.scan(
+            chunk_step, (cache_k, cache_v),
+            (toks_c, jnp.arange(n_chunks)))
+        tok0 = sample(chunk_logits[-1], sub)     # token at position t0
+    else:
+        # -- Batched prefill: one forward over the whole prompt fills every
+        # layer's KV cache at once (O(1) forwards, not O(t0) steps).
+        x = embed(params, prompt, cfg)
+
+        def prefill_layer(x, bp):
+            h = layer_norm(x, bp["ln1_scale"], bp["ln1_bias"])
+            q, k, v = _qkv_proj(bp, h, cfg)    # kv carry Hkv heads
+            if cfg.pos_embedding == "rope":
+                positions = jnp.arange(t0)
+                q = apply_rope(q, positions, cfg.rope_theta)
+                k = apply_rope(k, positions, cfg.rope_theta)
+            # Cache Hkv-head k/v; attention runs on broadcast heads.
+            kr, vr = _repeat_kv(k, q), _repeat_kv(v, q)
+            if cfg.attn_window is None:
+                o = full_attention(q, kr, vr, causal=True)
+            else:
+                # Banded prefill: the shared band predicate keeps this,
+                # the cached decode, and the training kernels on one
+                # definition. Prompts are short, so the explicit mask is
+                # fine here.
+                from distributed_model_parallel_tpu.ops.pallas_attention import (
+                    band_keep,
+                )
+
+                s = (jnp.einsum("bqhd,bkhd->bhqk", q, kr)
+                     * (cfg.head_dim ** -0.5))
+                posa = jnp.arange(t0)
+                keep = band_keep(posa[:, None], posa[None, :],
+                                 cfg.attn_window)
+                s = jnp.where(keep[None, None], s, -jnp.inf)
+                o = jnp.einsum("bhqk,bkhd->bqhd",
+                               jax.nn.softmax(s, axis=-1).astype(q.dtype),
+                               vr)
+            o = o.reshape(b, t0, -1) @ bp["wo"]
+            if tp_axis is not None:
+                o = jax.lax.psum(o, tp_axis)
+            x = x + o
+            h = layer_norm(x, bp["ln2_scale"], bp["ln2_bias"])
+            h, _ = _ffn(bp, h, cfg, tp_axis=tp_axis, ep_axis=None)
+            return x + h, (k.astype(cfg.dtype), v.astype(cfg.dtype))
+
+        x, (ks, vs) = jax.lax.scan(prefill_layer, x, params["blocks"])
+        pad = [(0, 0), (0, 0), (0, total - t0), (0, 0), (0, 0)]
+        cache_k = jnp.pad(ks, pad)               # [L, B, total, Hkv, Dh]
+        cache_v = jnp.pad(vs, pad)
+        tok0 = sample(unembed(params, x)[:, -1], sub)  # token at position t0
 
     # -- Decode: one cached step per new position.
     def forward_one(cache_k, cache_v, tok, pos):
@@ -635,7 +709,9 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
 
         def layer(x, xs):
             bp, kc, vc = xs
-            x, kc, vc = _decode_block(bp, kc, vc, x, pos, cfg)
+            x, kc, vc = _cached_block(bp, kc, vc, x,
+                                      jnp.reshape(pos, (1,)), cfg,
+                                      tp_axis=tp_axis)
             return x, (kc, vc)
 
         x, (cache_k, cache_v) = jax.lax.scan(
@@ -654,6 +730,62 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     _, toks = jax.lax.scan(
         body, (cache_k, cache_v, tok0, rng), jnp.arange(t0, total - 1))
     return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
+
+
+def generate_sharded(params: dict, cfg: TransformerConfig, prompt: jax.Array,
+                     steps: int, spec, *, rng: jax.Array | None = None,
+                     temperature: float = 0.0, top_k: int | None = None,
+                     top_p: float | None = None,
+                     prefill_chunk: int | None = None) -> jax.Array:
+    """``generate`` under a device mesh: batch over ``data``, heads over
+    ``model`` (tensor-parallel KV cache — each device caches only its local
+    kv heads; wo/FFN psums complete each block, exactly the training
+    layout from ``parallel/tensor_parallel.block_specs``).
+
+    Greedy decoding is token-identical to replicated ``generate``
+    (tests/test_generate_sharded.py). Sampled decoding draws the same
+    per-device stream, which matches replicated sampling only when the
+    batch is unsharded — the psum'd logits are bit-identical across the
+    model axis, so any divergence is the per-row rng split, not numerics.
+
+    A model trained tp-sharded no longer has to be gathered onto one
+    device to decode (the r3 gap: a 256k-token model the framework could
+    train but not serve sharded).
+    """
+    from jax.sharding import NamedSharding
+
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        kv_heads_shardable,
+        param_specs,
+    )
+
+    if cfg.moe_experts and cfg.ep_axis:
+        raise ValueError("expert-parallel decode is not implemented; "
+                         "decode with experts replicated (ep_axis=None)")
+    # Decode ignores the pipeline axis: blocks stay layer-stacked on every
+    # device (stage_axis=None), sharded over model only.
+    pspecs = param_specs(None, cfg.tp_axis,
+                         moe=bool(cfg.moe_experts), ep_axis=None,
+                         learned_pos=cfg.pos_embedding == "learned",
+                         gqa=cfg.gqa,
+                         shard_kv=kv_heads_shardable(cfg, spec))
+    params = jax.tree.map(
+        lambda x, ps: jax.device_put(x, NamedSharding(spec.mesh, ps)),
+        params, pspecs, is_leaf=lambda x: isinstance(x, P))
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def body(params, prompt, rng):
+        return generate(params, cfg, prompt, steps, rng=rng,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        tp_axis=cfg.tp_axis, prefill_chunk=prefill_chunk)
+
+    fn = jax.shard_map(
+        body, mesh=spec.mesh,
+        in_specs=(pspecs, P(spec.data_axis), P()),
+        out_specs=P(spec.data_axis),
+        check_vma=False)
+    return fn(params, prompt, rng)
 
 
 def build_transformer(model_config) -> "TransformerConfig":

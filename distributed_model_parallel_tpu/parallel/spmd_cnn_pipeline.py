@@ -250,6 +250,244 @@ def make_cnn_pipeline_apply(model: StagedModel, spec: MeshSpec, *,
     return pipeline
 
 
+def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
+                          sample_shape: Sequence[int],
+                          num_microbatches: int = 1,
+                          boundaries: Sequence[int] | None = None,
+                          bn_momentum: float = 0.9,
+                          init_params=None, init_state=None,
+                          stage_dispatch: str = "switch",
+                          dtype=jnp.float32) -> Callable:
+    """Hand-scheduled 1F1B for the heterogeneous CNN pipeline:
+    ``fwd_bwd(params, state, x, labels) -> (loss, logits, new_state, grads)``
+    as one shard_map program.
+
+    Same schedule as the Transformer's ``make_1f1b_loss_and_grad``
+    (parallel/spmd_pipeline.py — warmup / lax.scan steady state / drain,
+    stash ring of 2S-1 padded boundary buffers, backward recomputed from
+    the stash), transplanted onto this module's heterogeneous machinery:
+    stage-indexed ``lax.switch`` dispatch, padded flat activation hops,
+    and per-tick BN state collection with the GPipe path's exact pooling.
+    The memory story is the flat-in-M scan carry instead of GPipe's
+    all-M-microbatches residual liveness (benchmarks/pipeline_memory.json).
+
+    Gradient bookkeeping is simpler than the Transformer's: params are
+    replicated and the branches contain no collectives, so per-device
+    grads are plain partials — masked by each tick's reality and summed
+    over (data, stage) at the end. Meshes with model/seq/expert axes > 1
+    are rejected (no CNN strategy uses them; their replicated compute
+    would double-count under that sum).
+    """
+    S = spec.num_stages
+    M = num_microbatches
+    stage_axis = spec.stage_axis
+    mesh = spec.mesh
+    for ax in (spec.model_axis, spec.seq_axis, spec.expert_axis):
+        if mesh.shape[ax] > 1:
+            raise ValueError(
+                f"cnn 1f1b supports data x stage meshes only; axis "
+                f"{ax!r} has size {mesh.shape[ax]}")
+    slices = stage_slices(model.num_units, S, boundaries)
+    owner = [s for s, (lo, hi) in enumerate(slices) for _ in range(lo, hi)]
+    if stage_dispatch not in ("switch", "masked"):
+        raise ValueError(f"unknown stage_dispatch {stage_dispatch!r}; "
+                         f"expected 'switch' or 'masked'")
+    if init_params is None or init_state is None:
+        init_params, init_state = model.init(
+            jax.random.key(0), jnp.zeros((1, *sample_shape[1:]), dtype))
+    K = min(2 * S - 1, M + S - 1)
+
+    def _flat(entry):
+        return list(entry) if isinstance(entry, (tuple, list)) else [entry]
+
+    data_axes = [a for a in _flat(spec.data_axis) if mesh.shape[a] > 1]
+    reduce_axes = tuple(data_axes + ([stage_axis] if S > 1 else []))
+    n_data = spec.num_data          # covers the dcn x ici split
+
+    def fwd_bwd(params, state, x, labels):
+        b_local = x.shape[0] // spec.num_data
+        if b_local % M:
+            raise ValueError(f"per-shard batch {b_local} not divisible by "
+                             f"num_microbatches={M}")
+        mbs = b_local // M
+        shapes = boundary_shapes(model, init_params, init_state, mbs,
+                                 x.shape[1:], slices)
+        feat_sizes = [math.prod(sh[1:]) for sh in shapes]
+        max_feat = max(feat_sizes)
+        out_shape = shapes[-1]
+        b_global = b_local * n_data
+
+        def pack(y):
+            flat = y.reshape(mbs, -1).astype(dtype)
+            return jnp.zeros((mbs, max_feat), dtype).at[
+                :, :flat.shape[1]].set(flat)
+
+        def make_branch(si):
+            lo, hi = slices[si]
+
+            def branch(params, buf):
+                xin = buf[:, :feat_sizes[si]].reshape(shapes[si])
+                y, new_sub = model.apply_range(params, state, xin, lo, hi,
+                                               train=True)
+                full = tuple(new_sub[i - lo] if lo <= i < hi else state[i]
+                             for i in range(model.num_units))
+                return pack(y), full
+
+            return branch
+
+        def stage_fn(params, state, x_local, lab_local):
+            s = jax.lax.axis_index(stage_axis)
+            branches = [make_branch(si) for si in range(S)]
+            mb = x_local.reshape(M, mbs, *x_local.shape[1:])
+            lab_mb = lab_local.reshape(M, mbs)
+            perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+            perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+            def dispatch(params_, buf):
+                if stage_dispatch == "switch":
+                    return jax.lax.switch(s, branches, params_, buf)
+                outs = [br(params_, buf) for br in branches]
+                sel = lambda *leaves: jax.lax.select_n(s, *leaves)
+                return (sel(*[o[0] for o in outs]),
+                        jax.tree.map(sel, *[o[1] for o in outs]))
+
+            def buf_only(params_, buf):
+                return dispatch(params_, buf)[0]
+
+            def fwd_slot(ft, state_f, stash):
+                idx = jnp.clip(jnp.asarray(ft), 0, M - 1)
+                xmb = jax.lax.dynamic_index_in_dim(mb, idx, 0,
+                                                   keepdims=False)
+                inject = jnp.logical_and(jnp.asarray(ft) < M, s == 0)
+                state_f = jnp.where(inject, pack(xmb), state_f)
+                stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, state_f, jnp.mod(jnp.asarray(ft), K), 0)
+                state_f, tick_state = dispatch(params, state_f)
+                return state_f, stash, tick_state
+
+            def bwd_slot(bt, dy, state_b, stash, g_params):
+                cot_in = state_b
+                if dy is not None:
+                    cot_in = jnp.where(s == S - 1, dy, cot_in)
+                real_b = jnp.logical_and(
+                    jnp.asarray(bt) - (S - 1 - s) >= 0,
+                    jnp.asarray(bt) - (S - 1 - s) < M)
+                slot = jnp.mod(jnp.asarray(bt) + 2 * s - (S - 1), K)
+                x_in = jax.lax.dynamic_index_in_dim(stash, slot, axis=0,
+                                                    keepdims=False)
+                _, stage_vjp = jax.vjp(buf_only, params, x_in)
+                g_p, dbuf = stage_vjp(cot_in)
+                g_params = jax.tree.map(
+                    lambda g, d: g + jnp.where(real_b, d, 0),
+                    g_params, g_p)
+                state_b = dbuf
+                if S > 1:
+                    state_b = jax.lax.ppermute(state_b, stage_axis,
+                                               perm_bwd)
+                return state_b, g_params
+
+            state_f = jnp.zeros((mbs, max_feat), dtype)
+            state_b = jnp.zeros((mbs, max_feat), dtype)
+            stash = jnp.zeros((K, mbs, max_feat), dtype)
+            loss_acc = jnp.zeros((), jnp.float32)
+            g_params = jax.tree.map(jnp.zeros_like, params)
+
+            warm_states = []
+            for ft in range(S - 1):
+                state_f, stash, tick_state = fwd_slot(ft, state_f, stash)
+                warm_states.append(tick_state)
+                if S > 1:
+                    state_f = jax.lax.ppermute(state_f, stage_axis,
+                                               perm_fwd)
+
+            def steady_tick(carry, i):
+                state_f, state_b, stash, loss_acc, g_params = carry
+                state_f, stash, tick_state = fwd_slot(i + (S - 1), state_f,
+                                                      stash)
+                lab_i = jax.lax.dynamic_index_in_dim(lab_mb, i, 0,
+                                                     keepdims=False)
+
+                def head(buf):
+                    logits = buf[:, :feat_sizes[-1]].reshape(out_shape)
+                    nll = optax.softmax_cross_entropy_with_integer_labels(
+                        logits.astype(jnp.float32), lab_i).sum()
+                    return nll, logits
+
+                nll, head_vjp, logits_i = jax.vjp(head, state_f,
+                                                  has_aux=True)
+                is_last = s == S - 1
+                loss_acc = loss_acc + jnp.where(is_last, nll, 0.0)
+                dbuf, = head_vjp(jnp.ones((), jnp.float32))
+                dy = jnp.where(is_last, dbuf, jnp.zeros_like(dbuf))
+                state_b, g_params = bwd_slot(i, dy, state_b, stash,
+                                             g_params)
+                if S > 1:
+                    state_f = jax.lax.ppermute(state_f, stage_axis,
+                                               perm_fwd)
+                return ((state_f, state_b, stash, loss_acc, g_params),
+                        (tick_state, logits_i))
+
+            carry = (state_f, state_b, stash, loss_acc, g_params)
+            carry, (steady_states, logits_all) = jax.lax.scan(
+                steady_tick, carry, jnp.arange(M))
+            state_f, state_b, stash, loss_acc, g_params = carry
+
+            for bt in range(M, M + S - 1):
+                state_b, g_params = bwd_slot(bt, None, state_b, stash,
+                                             g_params)
+
+            # BN pooling — identical to the GPipe path: stack all M+S-1
+            # tick states in tick order, keep stage s's real window
+            # [s, s+M), pool microbatch-wise, keep each unit's pooled
+            # state from its owning stage.
+            if warm_states:
+                warm_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                          *warm_states)
+                stacked = jax.tree.map(
+                    lambda w, st: jnp.concatenate([w, st], axis=0),
+                    warm_stack, steady_states)
+            else:
+                stacked = steady_states
+            mine = jax.tree.map(
+                lambda leaf: jnp.take(leaf, s + jnp.arange(M), axis=0),
+                stacked)
+            micro = [jax.tree.map(lambda leaf, m=m: leaf[m], mine)
+                     for m in range(M)]
+            merged = merge_microbatch_bn_states(micro, momentum=bn_momentum)
+            new_state = tuple(
+                jax.tree.map(
+                    lambda new, old, si=i: jax.lax.psum(
+                        jnp.where(s == owner[si], new,
+                                  jnp.zeros_like(new)), stage_axis),
+                    merged[i], state[i])
+                for i in range(model.num_units))
+            if spec.num_data > 1:
+                new_state = _pool_bn_over_axis(new_state, spec.data_axis,
+                                               bn_momentum)
+
+            # logits: [M, mbs, C] per tick, real only on the last stage.
+            logits_out = jax.lax.psum(
+                jnp.where(s == S - 1, logits_all,
+                          jnp.zeros_like(logits_all)), stage_axis)
+            logits_out = logits_out.reshape(b_local, *out_shape[1:])
+
+            loss = (jax.lax.psum(loss_acc, reduce_axes) if reduce_axes
+                    else loss_acc) / b_global
+            grads = jax.tree.map(
+                lambda g: ((jax.lax.psum(g, reduce_axes) if reduce_axes
+                            else g) / b_global).astype(g.dtype), g_params)
+            return loss, logits_out, new_state, grads
+
+        x_spec = P(spec.data_axis)
+        return jax.shard_map(
+            stage_fn, mesh=mesh,
+            in_specs=(P(), P(), x_spec, x_spec),
+            out_specs=(P(), x_spec, P(), P()),
+            check_vma=False)(params, state, x, labels)
+
+    return fwd_bwd
+
+
 def make_spmd_cnn_train_step(model: StagedModel, spec: MeshSpec,
                              tx: optax.GradientTransformation, *,
                              sample_shape: Sequence[int], mean, std,
@@ -259,6 +497,7 @@ def make_spmd_cnn_train_step(model: StagedModel, spec: MeshSpec,
                              augment: bool = True,
                              resize_to: int | None = None,
                              stage_dispatch: str = "switch",
+                             schedule: str = "gpipe",
                              dtype=jnp.float32) -> Callable:
     """One SPMD training step for a staged CNN pipelined over ``stage``.
 
@@ -284,23 +523,43 @@ def make_spmd_cnn_train_step(model: StagedModel, spec: MeshSpec,
         cross_entropy,
     )
 
-    pipeline = make_cnn_pipeline_apply(
-        model, spec, sample_shape=sample_shape,
-        num_microbatches=num_microbatches, boundaries=boundaries,
-        bn_momentum=bn_momentum, stage_dispatch=stage_dispatch, dtype=dtype)
+    if schedule == "1f1b":
+        fwd_bwd = make_cnn_1f1b_fwd_bwd(
+            model, spec, sample_shape=sample_shape,
+            num_microbatches=num_microbatches, boundaries=boundaries,
+            bn_momentum=bn_momentum, stage_dispatch=stage_dispatch,
+            dtype=dtype)
 
-    def loss_fn(params, model_state, images, labels):
-        logits, new_state = pipeline(params, model_state, images)
-        return cross_entropy(logits, labels), (logits, new_state)
+        def loss_and_grad(params, model_state, images, labels):
+            loss, logits, new_state, grads = fwd_bwd(params, model_state,
+                                                     images, labels)
+            return loss, logits, new_state, grads
+    elif schedule == "gpipe":
+        pipeline = make_cnn_pipeline_apply(
+            model, spec, sample_shape=sample_shape,
+            num_microbatches=num_microbatches, boundaries=boundaries,
+            bn_momentum=bn_momentum, stage_dispatch=stage_dispatch,
+            dtype=dtype)
+
+        def loss_fn(params, model_state, images, labels):
+            logits, new_state = pipeline(params, model_state, images)
+            return cross_entropy(logits, labels), (logits, new_state)
+
+        def loss_and_grad(params, model_state, images, labels):
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, model_state, images, labels)
+            return loss, logits, new_state, grads
+    else:
+        raise ValueError(f"unknown spmd cnn pipeline schedule {schedule!r}; "
+                         f"known: gpipe, 1f1b")
 
     def step(state: TrainState, rng: jax.Array, images_u8, labels):
         if resize_to is not None:
             images_u8 = resize_batch(images_u8, resize_to)
         images_u8 = augment_batch(rng, images_u8) if augment else images_u8
         images = normalize(images_u8, mean, std, dtype)
-        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, state.model_state, images,
-                                   labels)
+        loss, logits, new_model_state, grads = loss_and_grad(
+            state.params, state.model_state, images, labels)
         updates, new_opt_state = tx.update(grads, state.opt_state,
                                            state.params)
         new_params = optax.apply_updates(state.params, updates)

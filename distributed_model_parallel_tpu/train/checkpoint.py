@@ -111,8 +111,16 @@ class Checkpointer:
             raise KeyError(f"checkpoint {path} has no keys {missing}; "
                            f"available: {sorted(tree)}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        # Explicit per-leaf restore args carrying the TARGET's shardings:
+        # without them PyTreeRestore falls back to the sharding file
+        # written at save time, which breaks the moment the restoring
+        # process has a different topology (e.g. a checkpoint trained on
+        # an 8-device mesh restored for single-device inference —
+        # scripts/generate.py's whole use case).
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
         return ocp.PyTreeCheckpointer().restore(
             path, args=ocp.args.PyTreeRestore(item=abstract,
+                                              restore_args=restore_args,
                                               partial_restore=True))
 
     def exists(self, name: str = "ckpt") -> bool:

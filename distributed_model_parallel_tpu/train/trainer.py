@@ -369,12 +369,18 @@ class Trainer:
                 raise ValueError(
                     "strategy='spmd_pipeline' needs mesh.stage >= 2 "
                     "(use 'gspmd' for pure data parallelism)")
-            if config.pipeline_schedule != "gpipe" or config.virtual_stages != 1:
+            if config.pipeline_schedule not in ("gpipe", "1f1b"):
                 raise ValueError(
-                    "strategy='spmd_pipeline' implements the GPipe "
-                    "schedule only — 1F1B and virtual stages are "
-                    "single-controller PipelineRunner schedules (no "
-                    "silent ignores)")
+                    f"strategy='spmd_pipeline' implements the gpipe and "
+                    f"1f1b schedules, got "
+                    f"{config.pipeline_schedule!r} (interleaved is a "
+                    f"single-controller PipelineRunner schedule — no "
+                    f"silent ignores)")
+            if config.virtual_stages != 1:
+                raise ValueError(
+                    "virtual stages are a single-controller "
+                    "PipelineRunner schedule; strategy='spmd_pipeline' "
+                    "runs one stage per device (no silent ignores)")
             boundaries = config.stage_boundaries
             if boundaries is None and config.auto_partition:
                 from distributed_model_parallel_tpu.parallel.auto_partition import (
@@ -408,7 +414,8 @@ class Trainer:
                     boundaries=boundaries,
                     bn_momentum=config.model.bn_momentum,
                     augment=config.data.augment,
-                    stage_dispatch=dispatch, **kw),
+                    stage_dispatch=dispatch,
+                    schedule=config.pipeline_schedule, **kw),
                 in_shardings=(self._state_sh, self._repl, self._batch_sh,
                               self._batch_sh),
                 out_shardings=(self._state_sh, self._repl),

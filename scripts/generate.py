@@ -22,8 +22,23 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+from scripts._cpu_devices import force_cpu_devices
+
+force_cpu_devices(("--dp", "--tp"))
+
+
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dp", type=int, default=1,
+                   help="batch-shard decoding over this many devices")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel decoding: heads (and the KV "
+                        "cache) split over this many devices, the "
+                        "training layout — no gather-to-one-device")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="prefill the prompt in N-token slices against the "
+                        "growing KV cache (peak attention memory O(N*T) "
+                        "instead of O(T0^2) — the long-prompt lever)")
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
@@ -73,6 +88,7 @@ def main():
         n_layers=args.layers, d_ff=args.d_ff,
         max_seq_len=max(args.max_seq_len, 128),
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
+        tp_axis="model" if args.tp > 1 else None,
         pos_embedding="rope" if args.rope else "learned",
         n_kv_heads=args.kv_heads,
         attn_window=args.attn_window,
@@ -83,7 +99,13 @@ def main():
     if ckpt.exists("lm"):
         # Restore only the params subtree of the LM checkpoint; shape flags
         # must match the training run.
-        restored = ckpt.restore_subtree({"params": params}, "lm")
+        try:
+            restored = ckpt.restore_subtree({"params": params}, "lm")
+        except ValueError as e:
+            raise SystemExit(
+                f"checkpoint under {args.checkpoint_dir} does not match the "
+                f"model flags (--layers/--d-model/... must equal the "
+                f"training run's): {e}") from e
         params = restored["params"]
         print(f"restored LM checkpoint from {args.checkpoint_dir}",
               file=sys.stderr)
@@ -98,10 +120,25 @@ def main():
         raise SystemExit(f"prompt tokens {bad} outside vocab [0, "
                          f"{cfg.vocab_size})")
     prompt = jnp.asarray([prompt_ids], jnp.int32)
-    out = tfm.generate(params, cfg, prompt, args.gen_steps,
-                       rng=jax.random.key(args.seed + 1),
-                       temperature=args.temperature,
-                       top_k=args.top_k, top_p=args.top_p)
+    if args.dp > 1 or args.tp > 1:
+        from distributed_model_parallel_tpu.config import MeshConfig
+        from distributed_model_parallel_tpu.mesh import make_mesh
+
+        if args.dp > 1:
+            prompt = jnp.tile(prompt, (args.dp, 1))  # one row per replica
+        spec = make_mesh(MeshConfig(data=args.dp, model=args.tp))
+        out = tfm.generate_sharded(
+            params, cfg, prompt, args.gen_steps, spec,
+            rng=jax.random.key(args.seed + 1),
+            temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p,
+            prefill_chunk=args.prefill_chunk)
+    else:
+        out = tfm.generate(params, cfg, prompt, args.gen_steps,
+                           rng=jax.random.key(args.seed + 1),
+                           temperature=args.temperature,
+                           top_k=args.top_k, top_p=args.top_p,
+                           prefill_chunk=args.prefill_chunk)
     print(",".join(str(int(t)) for t in out[0]))
 
 

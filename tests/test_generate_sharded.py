@@ -1,0 +1,114 @@
+"""Sharded decoding: TP KV cache + chunked prefill vs replicated generate.
+
+The r3 gap this closes: ``generate`` was single-program only
+("no mesh axes are consulted"), so a model trained tp-sharded had to be
+gathered onto one device to decode. ``generate_sharded`` runs the cached
+blocks under a data x model mesh with the KV cache holding only local
+heads; greedy output must be token-identical to the replicated path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.config import MeshConfig
+from distributed_model_parallel_tpu.mesh import make_mesh
+from distributed_model_parallel_tpu.models import transformer as tfm
+
+V, B, T0, STEPS = 64, 4, 16, 12
+
+
+def _cfg(**kw):
+    kw.setdefault("vocab_size", V)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 3)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_seq_len", 64)
+    return tfm.TransformerConfig(**kw)
+
+
+def _prompt(seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, V, (B, T0)), jnp.int32)
+
+
+@pytest.mark.parametrize("cfg_kw,mesh_kw", [
+    (dict(tp_axis="model"), dict(model=4)),
+    (dict(tp_axis="model"), dict(data=2, model=2)),
+    (dict(tp_axis="model", pos_embedding="rope"), dict(model=2)),
+    (dict(tp_axis="model", n_kv_heads=2), dict(model=2)),
+    (dict(tp_axis="model", n_kv_heads=1), dict(model=4)),  # MQA: kv replicated
+    (dict(tp_axis="model", attn_window=8, attn_impl="flash"),
+     dict(model=2)),
+])
+def test_greedy_token_identical(cfg_kw, mesh_kw):
+    cfg = _cfg(**cfg_kw)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = _prompt()
+    ref = tfm.generate(params, cfg, prompt, STEPS)
+    spec = make_mesh(MeshConfig(**mesh_kw))
+    out = tfm.generate_sharded(params, cfg, prompt, STEPS, spec)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_data_only_mesh():
+    cfg = _cfg()
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = _prompt()
+    ref = tfm.generate(params, cfg, prompt, STEPS)
+    out = tfm.generate_sharded(params, cfg, prompt, STEPS,
+                               make_mesh(MeshConfig(data=4)))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    {}, dict(pos_embedding="rope"), dict(n_kv_heads=2),
+    dict(attn_window=6, attn_impl="flash"),
+])
+def test_chunked_prefill_matches_batched(cfg_kw):
+    """Chunked prefill (C-token slices against the growing cache) must be
+    token-identical to the one-shot batched prefill."""
+    cfg = _cfg(**cfg_kw)
+    params = tfm.init_params(jax.random.key(1), cfg)
+    prompt = _prompt(1)
+    ref = tfm.generate(params, cfg, prompt, STEPS)
+    for chunk in (4, 8, 16):
+        out = tfm.generate(params, cfg, prompt, STEPS, prefill_chunk=chunk)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                      err_msg=f"chunk={chunk}")
+
+
+def test_chunked_prefill_sharded():
+    """TP + chunked prefill composed."""
+    cfg = _cfg(tp_axis="model", pos_embedding="rope")
+    params = tfm.init_params(jax.random.key(2), cfg)
+    prompt = _prompt(2)
+    ref = tfm.generate(params, cfg, prompt, STEPS)
+    out = tfm.generate_sharded(params, cfg, prompt, STEPS,
+                               make_mesh(MeshConfig(data=2, model=2)),
+                               prefill_chunk=8)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_chunk_must_divide_prompt():
+    cfg = _cfg()
+    params = tfm.init_params(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        tfm.generate(params, cfg, _prompt(), 2, prefill_chunk=5)
+
+
+def test_sampled_decoding_runs_sharded():
+    """Temperature sampling under the mesh stays in-vocab and finite (exact
+    stream parity with replicated sampling is only guaranteed unsharded —
+    see generate_sharded docstring)."""
+    cfg = _cfg(tp_axis="model")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    out = tfm.generate_sharded(params, cfg, _prompt(), STEPS,
+                               make_mesh(MeshConfig(model=2)),
+                               rng=jax.random.key(7), temperature=1.0,
+                               top_k=8)
+    toks = np.asarray(out)
+    assert toks.shape == (B, T0 + STEPS)
+    assert (toks >= 0).all() and (toks < V).all()

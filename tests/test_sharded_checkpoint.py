@@ -56,3 +56,26 @@ def test_restore_subtree_partial(tmp_path):
         ckpt.restore_subtree({"nope": jnp.zeros(2)}, "lm")
     with pytest.raises(FileNotFoundError):
         ckpt.restore_subtree({"params": jnp.zeros(2)}, "absent")
+
+
+def test_restore_subtree_honors_target_sharding(tmp_path, mesh8):
+    """restore_subtree must restore into the TARGET's shardings, not the
+    sharding file written at save time: a checkpoint trained on an N-device
+    mesh restored for single-device inference (scripts/generate.py) hits
+    exactly this — the saved mesh's devices need not exist at restore time,
+    so falling back to the file is a crash, not a default."""
+    sh = NamedSharding(mesh8.mesh, P("data"))
+    tree = {"params": {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                           sh)},
+            "opt_state": {"m": jnp.ones(3)}}
+    ckpt = Checkpointer(str(tmp_path / "ck4"))
+    ckpt.save(tree, "lm")
+
+    # Target: same array, replicated on one device — a different layout
+    # than the file records.
+    one_dev = jax.sharding.SingleDeviceSharding(jax.devices()[1])
+    target = {"params": {"w": jax.device_put(jnp.zeros((8, 8)), one_dev)}}
+    out = ckpt.restore_subtree(target, "lm")
+    assert out["params"]["w"].sharding == one_dev
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(64.0).reshape(8, 8))
