@@ -74,9 +74,9 @@ def run_row(name: str, row: dict, steps: int) -> dict:
     t0 = time.perf_counter()
     for _ in range(steps):
         toks, tgts = t.sample_batch()
-        t.params, t.opt_state, loss = t._step(
+        t.params, t.opt_state, step_m = t._step(
             t.params, t.opt_state, jnp.asarray(toks), jnp.asarray(tgts))
-        losses.append(float(loss))
+        losses.append(float(step_m["loss"]))
     dt = time.perf_counter() - t0
     tail = losses[-20:]
     rec = dict(row=name, mesh=row["mesh"],

@@ -124,6 +124,12 @@ class DataConfig:
     synthetic_eval_size: int = 512
     prefetch: int = 2                       # host-thread prefetch depth (0 = off)
     use_native: bool = False                # C++ row-gather batch assembly
+    # File-backed datasets (ImageFolder / CUB): True streams pixels from
+    # disk per batch (host memory = the path list), False decodes the
+    # whole split up front, None auto-picks by decoded size
+    # (registry.LAZY_AUTO_BYTES) — the reference's torchvision loaders
+    # are lazy the same way (dataset_collection.py:36-47).
+    lazy_decode: bool | None = None
 
 
 @dataclasses.dataclass(frozen=True)

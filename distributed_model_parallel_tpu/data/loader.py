@@ -90,7 +90,10 @@ class BatchLoader:
         n = len(self.ds)
         idx = self.epoch_indices()
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
-        if self.use_native:
+        # The native row-gather operates on materialized arrays; for a lazy
+        # (file-backed) dataset, fancy indexing IS the batch decode
+        # (LazyImageArray thread pool), so use_native does not apply.
+        if self.use_native and not getattr(self.ds, "is_lazy", False):
             from distributed_model_parallel_tpu.data import native
             for lo in range(0, stop, self.batch_size):
                 sel = self._local_slice(idx[lo:lo + self.batch_size])

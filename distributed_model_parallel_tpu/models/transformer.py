@@ -33,6 +33,11 @@ from distributed_model_parallel_tpu.ops.ring_attention import (
     ring_attention,
 )
 
+# Length of the MoE stats vector every block's aux channel carries:
+# [load-balance loss, router z-loss, drop rate] (ops/moe._route). Dense
+# blocks carry zeros so the channel is shape-uniform across models.
+AUX_STATS = 3
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -82,6 +87,10 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01   # load-balance loss weight in lm_loss
+    # Router z-loss weight (ST-MoE): penalizes squared logsumexp of the
+    # router logits so they don't drift large (which makes routing
+    # saturate and bf16 logits overflow). 0 = off.
+    moe_z_weight: float = 0.0
     ep_axis: str | None = None
     # Positional encoding: "learned" (additive table, the default) or
     # "rope" (rotary: q/k rotated per position inside attention — relative
@@ -333,7 +342,7 @@ def _ffn(bp: dict, h: jax.Array, cfg: TransformerConfig, *,
     if tp_axis is not None:
         y = jax.lax.psum(y, tp_axis)
     y = y + bp["b2"]                         # bias added once, post-psum
-    return y, jnp.zeros((), jnp.float32)
+    return y, jnp.zeros((AUX_STATS,), jnp.float32)
 
 
 def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig
@@ -357,7 +366,7 @@ def blocks_scan(blocks: dict, x: jax.Array, cfg: TransformerConfig
         return carry, aux
 
     out, auxes = jax.lax.scan(body, x, blocks)
-    return out, jnp.mean(auxes)
+    return out, jnp.mean(auxes, axis=0)       # [AUX_STATS], mean over layers
 
 
 def embed(params: dict, tokens: jax.Array, cfg: TransformerConfig,
@@ -406,14 +415,22 @@ def apply(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     return apply_with_aux(params, tokens, cfg, pos_offset=pos_offset)[0]
 
 
+def aux_loss(aux: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Weighted scalar loss contribution of the [AUX_STATS] stats vector:
+    balance and z are loss terms with their own weights; drop rate is a
+    metric only (zero-gradient by construction)."""
+    return (cfg.moe_aux_weight * aux[0]
+            + cfg.moe_z_weight * aux[1])
+
+
 def token_loss(logits: jax.Array, targets: jax.Array, aux: jax.Array,
                cfg: TransformerConfig) -> jax.Array:
-    """Mean next-token cross-entropy + weighted MoE load-balance loss.
+    """Mean next-token cross-entropy + weighted MoE auxiliary losses.
     The single shared loss for the single-device and SPMD-pipeline paths
     (their parity is what tests compare)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    return jnp.mean(nll) + aux_loss(aux, cfg)
 
 
 def chunked_nll_sum(params: dict, x: jax.Array, targets: jax.Array,
@@ -462,7 +479,7 @@ def chunked_token_loss(params: dict, x: jax.Array, targets: jax.Array,
     [B, T, V] logits never materialize (see that docstring)."""
     b, t, _ = x.shape
     return (chunked_nll_sum(params, x, targets, chunk) / (b * t)
-            + cfg.moe_aux_weight * aux)
+            + aux_loss(aux, cfg))
 
 
 def lm_loss(params: dict, tokens: jax.Array, targets: jax.Array,

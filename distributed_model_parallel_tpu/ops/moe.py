@@ -54,9 +54,21 @@ def _route(router, x, cfg: MoEConfig):
     """Top-k routing with per-expert capacity.
 
     Returns (dispatch [N, E, C] one-hot, combine [N, E, C] weighted,
-    aux_loss) for N flattened tokens. Choice j's queue positions are offset
-    by all earlier choices' assignments (GShard ordering), so a token's
-    second choice never collides with first-choice traffic.
+    stats [3] f32) for N flattened tokens, where stats is
+
+    * ``[0]`` load-balance loss (Switch/GShard first-choice form),
+    * ``[1]`` router z-loss — mean squared logsumexp of the router
+      logits, the logit-drift regularizer (ST-MoE); weighted into the
+      training loss by ``TransformerConfig.moe_z_weight``,
+    * ``[2]`` drop rate — the fraction of the N*k token-choices whose
+      expert queue was already at capacity (``pos >= cap``); those
+      choices ride the residual path. A metric, not a loss term: it is
+      piecewise-constant in the params (zero gradient), and surfacing it
+      is what turns silent capacity overflow into an observable.
+
+    Choice j's queue positions are offset by all earlier choices'
+    assignments (GShard ordering), so a token's second choice never
+    collides with first-choice traffic.
     """
     n = x.shape[0]
     E = cfg.num_experts
@@ -73,6 +85,7 @@ def _route(router, x, cfg: MoEConfig):
     dispatch = jnp.zeros((n, E, cap), x.dtype)
     combine = jnp.zeros((n, E, cap), x.dtype)
     counts = jnp.zeros((E,), x.dtype)                 # queue heads per expert
+    kept = jnp.zeros((), jnp.float32)
     for j in range(k):                                # k is static (config)
         onehot = jax.nn.one_hot(experts[:, j], E)     # [N, E]
         # Position of each token within its expert's queue, past all
@@ -84,19 +97,26 @@ def _route(router, x, cfg: MoEConfig):
         dispatch = dispatch + d_j
         combine = combine + d_j * gates[:, j][:, None, None]
         counts = counts + jnp.sum(onehot, axis=0)
+        kept = kept + jnp.sum(keep).astype(jnp.float32)
 
     # Load-balancing loss over first-choice assignment fractions
     # (Switch/GShard form).
     first_choice = jax.nn.one_hot(experts[:, 0], E)
     frac_tokens = jnp.mean(first_choice, axis=0)
     frac_probs = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac_tokens * frac_probs)
-    return dispatch, combine, aux
+    balance = E * jnp.sum(frac_tokens * frac_probs)
+    z = jnp.mean(jax.nn.logsumexp(logits.astype(jnp.float32),
+                                  axis=-1) ** 2)
+    drop_rate = 1.0 - kept / (n * k)
+    stats = jnp.stack([balance.astype(jnp.float32), z,
+                       jax.lax.stop_gradient(drop_rate)])
+    return dispatch, combine, stats
 
 
 def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
             ep_axis: str | None = None) -> tuple[jax.Array, jax.Array]:
-    """MoE FFN on [B, T, d]. Returns (y, aux_loss).
+    """MoE FFN on [B, T, d]. Returns ``(y, stats)`` where stats is the
+    ``[balance_loss, z_loss, drop_rate]`` f32 vector from :func:`_route`.
 
     Without ``ep_axis``: all experts local (dense dispatch einsums).
     With ``ep_axis`` (inside shard_map): params arrive expert-sharded
@@ -129,4 +149,8 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
         expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
 
     y = jnp.einsum("nec,ecd->nd", combine, expert_out)
-    return y.reshape(b, t, d), aux
+    # The one-hot routing masks are f32 (softmax-derived), which promotes
+    # the combine einsum; cast back so a bf16 residual stream stays bf16
+    # (a f32-promoted carry breaks the blocks lax.scan under mixed
+    # precision — surfaced by the bf16 MoE bench).
+    return y.reshape(b, t, d).astype(x.dtype), aux

@@ -60,7 +60,7 @@ def make_pipeline_apply(cfg: tfm.TransformerConfig, spec: MeshSpec,
         mb = x_local.reshape(M, mbs, t, d)
         state = jnp.zeros((mbs, t, d), x_local.dtype)
         outputs = jnp.zeros((M, mbs, t, d), x_local.dtype)
-        aux_sum = jnp.zeros((), jnp.float32)
+        aux_sum = jnp.zeros((tfm.AUX_STATS,), jnp.float32)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         for tick in range(M + S - 1):           # static unroll
@@ -126,8 +126,8 @@ def _spec_axes(ps: P) -> set[str]:
 
 def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
                             num_microbatches: int) -> Callable:
-    """Hand-scheduled 1F1B: ``(params, tokens, targets) -> (loss, grads)``
-    as ONE shard_map program over the full mesh.
+    """Hand-scheduled 1F1B: ``(params, tokens, targets) ->
+    (loss, aux_stats, grads)`` as ONE shard_map program over the full mesh.
 
     Why not whole-program autodiff (the GPipe path): under
     ``jax.value_and_grad`` the backward runs only after every forward tick,
@@ -280,7 +280,7 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
         state_b = jnp.zeros((mbs, t, d), cfg.dtype)
         stash = jnp.zeros((K, mbs, t, d), cfg.dtype)
         loss_acc = jnp.zeros((), jnp.float32)
-        aux_sum = jnp.zeros((), jnp.float32)
+        aux_sum = jnp.zeros((tfm.AUX_STATS,), jnp.float32)
         g_blocks = jax.tree.map(jnp.zeros_like, blocks)
         g_head = jax.tree.map(jnp.zeros_like, head_p)
         g_embed = jax.tree.map(jnp.zeros_like, embed_p)
@@ -328,10 +328,12 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
             _, stage_vjp = jax.vjp(_blocks_fwd, blocks, x_in)
             # All grads are accumulated in SUM units and divided by
             # n_total once at the end, so the aux cotangent (whose true
-            # scale is w / (M * d_all)) pre-multiplies by n_total.
-            aux_cot = jnp.where(
-                real_b, cfg.moe_aux_weight * n_total / (M * d_all), 0.0)
-            g_b, dx = stage_vjp((cot_in, aux_cot.astype(jnp.float32)))
+            # per-stat scale is weight / (M * d_all)) pre-multiplies by
+            # n_total. Drop rate is a metric: zero cotangent.
+            aux_cot = (jnp.where(real_b, n_total / (M * d_all), 0.0)
+                       * jnp.array([cfg.moe_aux_weight, cfg.moe_z_weight,
+                                    0.0], jnp.float32))
+            g_b, dx = stage_vjp((cot_in, aux_cot))
             g_blocks = jax.tree.map(
                 jnp.add, g_blocks, mask_tree(g_b, real_b))
 
@@ -421,8 +423,9 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
         aux_all = (jax.lax.psum(aux_sum, tuple(
             a for a in all_axes if mesh.shape[a] > 1))
             if any(mesh.shape[a] > 1 for a in all_axes) else aux_sum)
-        loss = loss + cfg.moe_aux_weight * aux_all / (M * d_all)
-        return loss, grads
+        aux_mean = aux_all / (M * d_all)      # [AUX_STATS]
+        loss = loss + tfm.aux_loss(aux_mean, cfg)
+        return loss, aux_mean, grads
 
     seq = spec.seq_axis if cfg.sp_axis else None
     x_spec = P(spec.data_axis, seq)
@@ -430,15 +433,15 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
     return jax.shard_map(
         fwd_bwd, mesh=mesh,
         in_specs=(pspecs, x_spec, x_spec),
-        out_specs=(P(), grad_specs),
+        out_specs=(P(), P(), grad_specs),
         check_vma=False)
 
 
 def _make_loss_fn(cfg: tfm.TransformerConfig, spec: MeshSpec,
                   num_microbatches: int) -> Callable:
-    """loss_fn(params, tokens, targets) -> scalar, through the shard_map
-    pipeline and the dense or chunked head — the single definition the
-    train step and the eval loss both jit."""
+    """loss_fn(params, tokens, targets) -> (scalar, aux_stats[AUX_STATS]),
+    through the shard_map pipeline and the dense or chunked head — the
+    single definition the train step and the eval loss both jit."""
     pipeline_blocks = make_pipeline_apply(cfg, spec, num_microbatches)
 
     def loss_fn(params, tokens, targets):
@@ -446,9 +449,9 @@ def _make_loss_fn(cfg: tfm.TransformerConfig, spec: MeshSpec,
         x, aux = pipeline_blocks(params["blocks"], x)
         if cfg.loss_chunk:
             return tfm.chunked_token_loss(params, x, targets, aux, cfg,
-                                          cfg.loss_chunk)
+                                          cfg.loss_chunk), aux
         logits = tfm.unembed(params, x)
-        return tfm.token_loss(logits, targets, aux, cfg)
+        return tfm.token_loss(logits, targets, aux, cfg), aux
 
     return loss_fn
 
@@ -470,23 +473,32 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
     stage inputs per device). Loss and grads agree to float tolerance
     (tests/test_spmd_1f1b.py); memory and recompute differ.
     """
+    def metrics_of(loss, aux):
+        """Uniform per-step metrics: loss always; the MoE router stats
+        whenever the model routes (zeros otherwise, dropped for dense
+        models so logs stay clean)."""
+        out = {"loss": loss}
+        if cfg.moe_experts:
+            out.update(moe_balance=aux[0], moe_z=aux[1], moe_drop=aux[2])
+        return out
+
     if schedule == "1f1b":
         loss_and_grad = make_1f1b_loss_and_grad(cfg, spec, num_microbatches)
 
         def step(params, opt_state, tokens, targets):
-            loss, grads = loss_and_grad(params, tokens, targets)
+            loss, aux, grads = loss_and_grad(params, tokens, targets)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, opt_state, metrics_of(loss, aux)
     elif schedule == "gpipe":
         loss_fn = _make_loss_fn(cfg, spec, num_microbatches)
 
         def step(params, opt_state, tokens, targets):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
-                                                      targets)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, tokens, targets)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, opt_state, metrics_of(loss, aux)
     else:
         raise ValueError(f"unknown spmd pipeline schedule {schedule!r}; "
                          f"known: gpipe, 1f1b")
@@ -526,7 +538,11 @@ def make_spmd_eval_loss(cfg: tfm.TransformerConfig, spec: MeshSpec,
     seq = spec.seq_axis if cfg.sp_axis else None
     tok_sh = NamedSharding(spec.mesh, P(spec.data_axis, seq))
     repl = NamedSharding(spec.mesh, P())
-    return jax.jit(loss_fn, in_shardings=(p_sh, tok_sh, tok_sh),
+
+    def eval_loss(params, tokens, targets):
+        return loss_fn(params, tokens, targets)[0]
+
+    return jax.jit(eval_loss, in_shardings=(p_sh, tok_sh, tok_sh),
                    out_shardings=repl)
 
 

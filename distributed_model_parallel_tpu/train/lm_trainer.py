@@ -232,21 +232,25 @@ class LMTrainer:
         with self.preemption.installed():
             for epoch in range(self.start_epoch, epochs):
                 meter = AverageMeter("loss")
+                drop_meter = AverageMeter("moe_drop")
                 timer = StepTimer()
                 for _ in range(self.config.steps_per_epoch):
                     if self.preemption.requested():
                         break
                     toks, tgts = self.sample_batch()
                     timer.data_ready()
-                    self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, step_m = self._step(
                         self.params, self.opt_state, jnp.asarray(toks),
                         jnp.asarray(tgts))
                     with self.guards.watch():
-                        loss_host = float(loss)     # the per-step sync point
+                        # the per-step sync point
+                        loss_host = float(step_m["loss"])
                     if self.guards.enabled:
                         self.guards.after_sync({"loss": loss_host}, 1,
                                                params=self.params)
                     meter.update(loss_host)
+                    if "moe_drop" in step_m:
+                        drop_meter.update(float(step_m["moe_drop"]))
                     timer.step_done()
                 if self.preemption.requested():
                     # Partial epoch: save for resume at this epoch and stop
@@ -275,6 +279,11 @@ class LMTrainer:
                               time_load_per_batch=timer.data.avg,
                               tokens_per_s=self.config.batch_size
                               * self.config.seq_len / max(timer.step.avg, 1e-9))
+                if drop_meter.count:
+                    # MoE router observability: mean fraction of
+                    # token-choices dropped at capacity this epoch
+                    # (ops/moe._route — silent overflow made visible).
+                    record["moe_drop_rate"] = drop_meter.avg
                 self.logger.log_epoch(**record)
                 history.append(record)
                 self.start_epoch = epoch + 1

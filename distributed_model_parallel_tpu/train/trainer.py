@@ -330,6 +330,13 @@ class Trainer:
                 # Fast path: dataset lives on device; K steps per dispatch.
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
+                if getattr(train_ds, "is_lazy", False):
+                    raise ValueError(
+                        "device_resident_data requires materialized pixels "
+                        "but the dataset streams lazily from disk (auto "
+                        "when decoded size exceeds the in-memory cap); set "
+                        "DataConfig.lazy_decode=False to decode eagerly, "
+                        "or drop device_resident_data")
                 n = len(train_ds)
                 self._dev_images = jax.device_put(
                     train_ds.images.reshape(n, -1), self._repl)

@@ -31,6 +31,9 @@ def parse_args():
     p.add_argument("--moe-experts", type=int, default=0,
                    help="experts per block (0 = dense FFN)")
     p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-z-weight", type=float, default=0.0,
+                   help="router z-loss weight (ST-MoE logit-drift "
+                        "regularizer; 0 = off)")
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
@@ -101,6 +104,7 @@ def main():
             tp_axis="model" if args.tp > 1 else None,
             sp_axis="seq" if args.sp > 1 else None,
             moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
+            moe_z_weight=args.moe_z_weight,
             ep_axis="expert" if args.ep > 1 else None,
             pos_embedding="rope" if args.rope else "learned",
             n_kv_heads=args.kv_heads,

@@ -106,8 +106,8 @@ def test_gqa_spmd_pipeline_and_tp_match_single_device(devices):
     opt_state = jax.device_put(tx.init(host_params),
                                NamedSharding(spec.mesh, P()))
     p = shard_params(host_params, cfg, spec)
-    _, _, loss = step(p, opt_state, tokens, targets)
-    assert float(loss) == pytest.approx(want, rel=2e-5)
+    _, _, m = step(p, opt_state, tokens, targets)
+    assert float(m["loss"]) == pytest.approx(want, rel=2e-5)
 
 
 def test_mqa_with_tensor_parallelism_matches_single_device(devices):
@@ -132,8 +132,8 @@ def test_mqa_with_tensor_parallelism_matches_single_device(devices):
     opt_state = jax.device_put(tx.init(host_params),
                                NamedSharding(spec.mesh, P()))
     p = shard_params(host_params, cfg, spec)
-    _, _, loss = step(p, opt_state, tokens, targets)
-    assert float(loss) == pytest.approx(want, rel=2e-5)
+    _, _, m = step(p, opt_state, tokens, targets)
+    assert float(m["loss"]) == pytest.approx(want, rel=2e-5)
 
 
 def test_unmappable_kv_tp_combo_rejected(devices):

@@ -46,7 +46,7 @@ def test_local_moe_matches_naive(setup):
     y, aux = moe_ffn(params, x, CFG)
     np.testing.assert_allclose(np.asarray(y), _naive_top1(params, x, CFG),
                                rtol=1e-4, atol=1e-5)
-    assert float(aux) > 0
+    assert float(aux[0]) > 0
 
 
 def test_expert_parallel_matches_naive(setup):
@@ -103,7 +103,7 @@ def test_top2_matches_naive(setup):
     y, aux = moe_ffn(params, x, CFG2)
     np.testing.assert_allclose(np.asarray(y), _naive_top2(params, x, CFG2),
                                rtol=1e-4, atol=1e-5)
-    assert float(aux) > 0
+    assert float(aux[0]) > 0
 
 
 def test_top2_expert_parallel_matches_naive(setup):
@@ -168,8 +168,31 @@ def test_moe_is_differentiable(setup):
 
     def loss(p):
         y, aux = moe_ffn(p, x, CFG)
-        return jnp.sum(y ** 2) + 0.01 * aux
+        return jnp.sum(y ** 2) + 0.01 * aux[0] + 0.001 * aux[1]
 
     grads = jax.grad(loss)(params)
     assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
     assert float(jnp.abs(grads["w_in"]).sum()) > 0
+
+
+def test_route_stats_vector(setup):
+    """The aux channel is [balance, z, drop_rate]: z positive, drop rate 0
+    under loose capacity, and the exact overflow fraction when capacity is
+    tight (the r3 gap: drops were silent)."""
+    params, x = setup
+    _, aux = moe_ffn(params, x, CFG)
+    assert aux.shape == (3,)
+    assert float(aux[1]) > 0                       # z-loss = E[lse^2] > 0
+    assert float(aux[2]) == 0.0                    # nothing dropped at cf=2
+    n = x.shape[0] * x.shape[1]
+    tight = MoEConfig(num_experts=4, d_model=16, d_ff=32,
+                      capacity_factor=0.1)
+    _, aux_t = moe_ffn(params, x, tight)
+    cap = max(1, int(0.1 * n / 4))
+    assert 0.0 < float(aux_t[2]) <= 1.0
+    # kept slots cannot exceed E*cap, so drop rate >= 1 - E*cap/n
+    assert float(aux_t[2]) >= 1.0 - 4 * cap / n - 1e-6
+    # drop rate carries no gradient (metric, not loss)
+    g = jax.grad(lambda p: moe_ffn(p, x, tight)[1][2])(params)
+    assert all(float(jnp.abs(leaf).sum()) == 0.0
+               for leaf in jax.tree.leaves(g))

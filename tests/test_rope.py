@@ -118,5 +118,5 @@ def test_rope_spmd_pipeline_matches_single_device(devices):
     opt_state = jax.device_put(tx.init(host_params),
                                NamedSharding(spec.mesh, P()))
     p = shard_params(host_params, cfg, spec)
-    _, _, loss = step(p, opt_state, tokens, targets)
-    assert float(loss) == pytest.approx(want, rel=2e-5)
+    _, _, m = step(p, opt_state, tokens, targets)
+    assert float(m["loss"]) == pytest.approx(want, rel=2e-5)

@@ -61,13 +61,16 @@ def _parity(mesh_kw, cfg_kw, M, tol=2e-5):
     toks, tgts = _data()
 
     gpipe_loss_and_grad = jax.jit(jax.value_and_grad(
-        lambda p, a, b: __import__(
+        __import__(
             "distributed_model_parallel_tpu.parallel.spmd_pipeline",
-            fromlist=["_make_loss_fn"])._make_loss_fn(cfg, spec, M)(p, a, b)))
-    l_ref, g_ref = gpipe_loss_and_grad(params, toks, tgts)
+            fromlist=["_make_loss_fn"])._make_loss_fn(cfg, spec, M),
+        has_aux=True))
+    (l_ref, aux_ref), g_ref = gpipe_loss_and_grad(params, toks, tgts)
 
     f1b = jax.jit(make_1f1b_loss_and_grad(cfg, spec, M))
-    l_new, g_new = f1b(params, toks, tgts)
+    l_new, aux_new, g_new = f1b(params, toks, tgts)
+    np.testing.assert_allclose(np.asarray(aux_new), np.asarray(aux_ref),
+                               rtol=1e-4, atol=1e-6)
 
     np.testing.assert_allclose(float(l_new), float(l_ref), rtol=1e-5,
                                atol=1e-6)
@@ -145,8 +148,8 @@ def test_1f1b_train_step_reduces_loss():
                                     schedule=schedule)
         ls = []
         for _ in range(6):
-            params, opt_state, loss = step(params, opt_state, toks, tgts)
-            ls.append(float(loss))
+            params, opt_state, m = step(params, opt_state, toks, tgts)
+            ls.append(float(m["loss"]))
         losses[schedule] = ls
     assert losses["1f1b"][-1] < losses["1f1b"][0]
     np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=2e-4)

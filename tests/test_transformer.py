@@ -201,8 +201,8 @@ def test_spmd_step_with_chunked_loss(params, toks):
         step = make_spmd_train_step(cfg, spec, tx)
         p = shard_params(tfm.init_params(jax.random.key(0), cfg), cfg, spec)
         opt = jax.device_put(tx.init(p), NamedSharding(spec.mesh, P()))
-        _, _, loss = step(p, opt, t_in, t_out)
-        losses[chunk] = float(loss)
+        _, _, m = step(p, opt, t_in, t_out)
+        losses[chunk] = float(m["loss"])
     assert losses[0] == pytest.approx(losses[31], rel=1e-6)
 
 
@@ -279,8 +279,8 @@ def test_spmd_train_step_runs_and_learns(params, toks):
                        NamedSharding(spec.mesh, P()))
     losses = []
     for _ in range(6):
-        p, o, loss = step(p, o, toks[:, :-1], toks[:, 1:])
-        losses.append(float(loss))
+        p, o, m = step(p, o, toks[:, :-1], toks[:, 1:])
+        losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
 
 
@@ -320,9 +320,12 @@ def test_moe_transformer_forward_and_aux(moe_params, toks):
     logits, aux = tfm.apply_with_aux(moe_params, toks, MOE_CFG)
     assert logits.shape == (*toks.shape, MOE_CFG.vocab_size)
     assert np.isfinite(np.asarray(logits)).all()
-    # balanced routing gives aux ~1; any routing gives aux >= 1 in
-    # expectation — just require a sane positive value
-    assert 0.0 < float(aux) < 10.0
+    # aux = [balance, z, drop]: balanced routing gives balance ~1; any
+    # routing gives balance >= 1 in expectation — just require sane values
+    assert aux.shape == (tfm.AUX_STATS,)
+    assert 0.0 < float(aux[0]) < 10.0
+    assert float(aux[1]) > 0.0
+    assert 0.0 <= float(aux[2]) <= 1.0
 
 
 def test_moe_transformer_trains(moe_params, toks):
@@ -381,8 +384,8 @@ def test_moe_spmd_train_step_with_expert_axis(moe_params, toks):
     o = jax.device_put(tx.init(moe_params), NamedSharding(spec.mesh, P()))
     losses = []
     for _ in range(6):
-        p, o, loss = step(p, o, toks[:, :-1], toks[:, 1:])
-        losses.append(float(loss))
+        p, o, m = step(p, o, toks[:, :-1], toks[:, 1:])
+        losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
 
 
