@@ -57,6 +57,7 @@ class LazyImageArray:
         self.paths = list(paths)
         self.image_size = image_size
         self.num_workers = num_workers
+        self._pool = None          # created on first batch, then reused
 
     @property
     def shape(self) -> tuple[int, int, int, int]:
@@ -79,14 +80,19 @@ class LazyImageArray:
         out = np.empty((len(idx), *self.shape[1:]), np.uint8)
         if len(idx) == 0:
             return out
-        from concurrent.futures import ThreadPoolExecutor
 
         def work(j):
             out[j] = self._decode(self.paths[int(idx[j])])
 
         if self.num_workers > 1 and len(idx) > 1:
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                list(pool.map(work, range(len(idx))))
+            if self._pool is None:
+                # One persistent pool per array, reused across batches —
+                # this is the hot input path; a per-batch pool would pay
+                # thread create/join once per step.
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(self.num_workers)
+            list(self._pool.map(work, range(len(idx))))
         else:
             for j in range(len(idx)):
                 work(j)

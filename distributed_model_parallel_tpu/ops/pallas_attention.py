@@ -406,46 +406,47 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret, window=None):
     return _unpad_bthd(o, b, h, t, d), lse[:, 0, :]
 
 
-def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
-                    window=None):
-    """Pallas backward: dq/dk/dv with [T, T] never in HBM."""
+def _bwd_prep(q, k, v, o, lse, g, t_pad, d_pad):
+    """Shared backward preprocessing: delta = rowsum(dO * O) (tiny
+    elementwise pass in plain XLA; padded rows get delta 0 and g 0, so
+    they contribute nothing), lse padding for callers holding only the
+    real-T lse, and the 8-sublane tiling both vectors need for Mosaic
+    block-layout legality."""
     b, t, h, d = q.shape
-    t_pad, d_pad, bq, bk, interp = _plan(t, d, causal, block_q, block_k,
-                                         interpret)
-    scale = d ** -0.5
-    num_q, num_k = t_pad // bq, t_pad // bk
-    # delta = rowsum(dO * O) — tiny elementwise pass in plain XLA. Padded
-    # rows get delta 0 and g 0, so they contribute nothing below. Tiled to
-    # 8 sublanes like lse (Mosaic block-layout requirement).
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = delta.transpose(0, 2, 1).reshape(b * h, t)
     if t_pad != t:
         delta = jnp.pad(delta, [(0, 0), (0, t_pad - t)])
     if lse.shape[1] != t_pad:
-        # Callers holding only the real-T lse (the ring composition slices
-        # padding off): pad with 0 — padded rows have zero cotangents, so
-        # any finite lse keeps their p finite and their contributions zero.
+        # Padded rows have zero cotangents, so any finite lse keeps their
+        # p finite and their contributions zero.
         lse = jnp.pad(lse, [(0, 0), (0, t_pad - lse.shape[1])])
     delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, t_pad))
     lse = jnp.broadcast_to(lse[:, None, :], (b * h, 8, t_pad))
     qf, kf, vf, gf = (_pad_bhtd(x, t_pad, d_pad) for x in (q, k, v, g))
+    return qf, kf, vf, gf, lse, delta
 
-    common = dict(causal=causal, scale=scale, window=window)
+
+def _bwd_dq_call(qf, kf, vf, gf, lse, delta, *, bq, bk, d_pad, causal, scale,
+                 window, interp, out_dtype):
+    """The dq kernel as one pallas_call (own block shape)."""
+    bh_n, t_pad, _ = qf.shape
+    num_q, num_k = t_pad // bq, t_pad // bk
     q_row_spec = pl.BlockSpec((1, bq, d_pad), lambda bh, i, j: (bh, i, 0))
     q_vec_spec = pl.BlockSpec((1, 8, bq), lambda bh, i, j: (bh, 0, i))
     kv_map = _kv_stream_map(causal, bq, bk, window)
     kv_spec = pl.BlockSpec((1, bk, d_pad), kv_map)
-
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, num_k=num_k, **common),
-        grid=(b * h, num_q, num_k),
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, num_k=num_k, causal=causal,
+                          scale=scale, window=window),
+        grid=(bh_n, num_q, num_k),
         in_specs=[
             q_row_spec, kv_spec, kv_spec,
             # dO is per-query-row: blocked like q.
             q_row_spec, q_vec_spec, q_vec_spec,
         ],
         out_specs=q_row_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d_pad), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh_n, t_pad, d_pad), out_dtype),
         scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32),
                         pltpu.VMEM((bq, _LANE_W), jnp.float32),
                         pltpu.VMEM((bq, _LANE_W), jnp.float32)],
@@ -454,23 +455,29 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
         interpret=interp,
     )(qf, kf, vf, gf, lse, delta)
 
+
+def _bwd_dkv_call(qf, kf, vf, gf, lse, delta, *, bq, bk, d_pad, causal,
+                  scale, window, interp, k_dtype, v_dtype):
+    """The dk/dv kernel as one pallas_call (own block shape)."""
+    bh_n, t_pad, _ = qf.shape
+    num_q, num_k = t_pad // bq, t_pad // bk
     q_map = _q_stream_map(causal, bq, bk, num_q, window)
     q_stream_spec = pl.BlockSpec((1, bq, d_pad), q_map)
     vec_stream_spec = pl.BlockSpec(
         (1, 8, bq), lambda bh, ki, i: (bh, 0, q_map(bh, ki, i)[1]))
     k_blk_spec = pl.BlockSpec((1, bk, d_pad), lambda bh, ki, i: (bh, ki, 0))
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, num_q=num_q, **common),
-        grid=(b * h, num_k, num_q),
+    return pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, num_q=num_q, causal=causal,
+                          scale=scale, window=window),
+        grid=(bh_n, num_k, num_q),
         in_specs=[
             q_stream_spec, k_blk_spec, k_blk_spec,
             q_stream_spec, vec_stream_spec, vec_stream_spec,
         ],
         out_specs=[k_blk_spec, k_blk_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t_pad, d_pad), k.dtype),
-            jax.ShapeDtypeStruct((b * h, t_pad, d_pad), v.dtype),
+            jax.ShapeDtypeStruct((bh_n, t_pad, d_pad), k_dtype),
+            jax.ShapeDtypeStruct((bh_n, t_pad, d_pad), v_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d_pad), jnp.float32),
@@ -481,6 +488,43 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
         interpret=interp,
     )(qf, kf, vf, gf, lse, delta)
 
+
+def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
+                    window=None, dq_blocks: tuple[int, int] | None = None,
+                    dkv_blocks: tuple[int, int] | None = None):
+    """Pallas backward: dq/dk/dv with [T, T] never in HBM.
+
+    ``dq_blocks``/``dkv_blocks`` optionally give each backward kernel its
+    own (q block, k block) tile shape — the two kernels have opposite
+    residency (dq keeps queries resident and streams K/V; dk/dv the
+    reverse), so their best tiles differ from the forward's and from each
+    other (measured per-kernel sweep: benchmarks/kernel_profile_r4.json;
+    both prefer 1024x1024 on v5e where the forward wants 512x1024).
+    Unset, both inherit ``block_q``/``block_k``."""
+    b, t, h, d = q.shape
+    t_pad, d_pad, bq, bk, interp = _plan(t, d, causal, block_q, block_k,
+                                         interpret)
+    scale = d ** -0.5
+    qf, kf, vf, gf, lse_t, delta = _bwd_prep(q, k, v, o, lse, g, t_pad, d_pad)
+
+    def resolve(blocks):
+        if blocks is None:
+            return bq, bk
+        _, _, rq, rk, _ = _plan(t, d, causal, blocks[0], blocks[1],
+                                interpret)
+        return rq, rk
+
+    bq1, bk1 = resolve(dq_blocks)
+    dq = _bwd_dq_call(qf, kf, vf, gf, lse_t, delta, bq=bq1, bk=bk1,
+                      d_pad=d_pad, causal=causal, scale=scale, window=window,
+                      interp=interp, out_dtype=q.dtype)
+
+    bq2, bk2 = resolve(dkv_blocks)
+    dk, dv = _bwd_dkv_call(qf, kf, vf, gf, lse_t, delta, bq=bq2, bk=bk2,
+                           d_pad=d_pad, causal=causal, scale=scale,
+                           window=window, interp=interp, k_dtype=k.dtype,
+                           v_dtype=v.dtype)
+
     return (_unpad_bthd(dq, b, h, t, d), _unpad_bthd(dk, b, h, t, d),
             _unpad_bthd(dv, b, h, t, d))
 
@@ -489,13 +533,16 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret,
 # public differentiable entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window,
+           dq_blocks, dkv_blocks):
     return _flash_impl(q, k, v, causal, block_q, block_k, interpret,
                        window)[0]
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window,
+               dq_blocks, dkv_blocks):
     o, lse = _flash_impl(q, k, v, causal, block_q, block_k, interpret, window)
     if bwd_impl == "xla":
         # The XLA-recompute backward reads only (q, k, v); don't hold the
@@ -504,7 +551,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl, window):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, window, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, window,
+               dq_blocks, dkv_blocks, res, g):
     """Backward dispatch: the pallas FlashAttention-2 kernels by default
     (no [T, T] in HBM), or the XLA recompute formulation (``bwd_impl="xla"``,
     materializes scores — the pre-kernel behavior, kept as an escape hatch).
@@ -519,7 +567,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, bwd_impl, window, res, g):
             lambda q, k, v: full_attention(q, k, v, causal=causal), q, k, v)
         return vjp(g)
     return _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret, window)
+                           interpret, window, dq_blocks=dq_blocks,
+                           dkv_blocks=dkv_blocks)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -553,10 +602,17 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # max_head_dim: the kernel keeps [block, D] tiles resident in VMEM; above
 #   this, tiles spill and XLA wins regardless of seq.
 _DISPATCH_TABLE: dict[str, dict] = {
+    # bwd kernels carry their own measured tiles (dq_/dkv_block_*): both
+    # backward kernels prefer 1024x1024 on v5e where the forward's best
+    # is 512x1024 (benchmarks/kernel_profile_r4.json, seq-8k hd-128 sweep).
     "TPU v5 lite": {"min_seq": {"bfloat16": 1024, "float32": 1024},
-                    "block_q": 512, "block_k": 1024, "max_head_dim": 256},
+                    "block_q": 512, "block_k": 1024, "max_head_dim": 256,
+                    "dq_block_q": 1024, "dq_block_k": 1024,
+                    "dkv_block_q": 1024, "dkv_block_k": 1024},
     "tpu": {"min_seq": {"bfloat16": 1024, "float32": 1024},
-            "block_q": 512, "block_k": 1024, "max_head_dim": 256},
+            "block_q": 512, "block_k": 1024, "max_head_dim": 256,
+            "dq_block_q": 1024, "dq_block_k": 1024,
+            "dkv_block_q": 1024, "dkv_block_k": 1024},
 }
 
 
@@ -635,7 +691,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     block_k: int | None = None,
                     interpret: bool | None = None,
                     bwd_impl: str = "flash",
-                    window: int | None = None) -> jax.Array:
+                    window: int | None = None,
+                    dq_blocks: tuple[int, int] | None = None,
+                    dkv_blocks: tuple[int, int] | None = None) -> jax.Array:
     """[B, T, H, D] -> [B, T, H, D] causal attention, pallas-blocked.
 
     ``interpret=None`` auto-selects interpret mode off-TPU. Default block
@@ -664,10 +722,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     if bwd_impl not in ("flash", "xla"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}; known: flash, xla")
+    explicit_blocks = block_q is not None or block_k is not None
     if block_q is None or block_k is None:
         dq, dk = default_blocks()
         block_q = block_q if block_q is not None else dq
         block_k = block_k if block_k is not None else dk
+    if not explicit_blocks:
+        # Fully-defaulted callers get the measured per-kernel backward
+        # tiles; a caller who tuned block_q/block_k (VMEM pressure, a
+        # sweep) keeps control of BOTH directions — the table's backward
+        # tiles were measured at head_dim 128 and must not override an
+        # explicit choice.
+        entry = dispatch_entry() or {}
+        if dq_blocks is None and "dq_block_q" in entry:
+            dq_blocks = (entry["dq_block_q"], entry["dq_block_k"])
+        if dkv_blocks is None and "dkv_block_q" in entry:
+            dkv_blocks = (entry["dkv_block_q"], entry["dkv_block_k"])
     if window is not None:
         if not causal:
             raise ValueError("window requires causal attention")
@@ -676,4 +746,4 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if bwd_impl == "xla":
             raise ValueError("window is only supported with bwd_impl='flash'")
     return _flash(q, k, v, causal, block_q, block_k, interpret, bwd_impl,
-                  window)
+                  window, dq_blocks, dkv_blocks)
