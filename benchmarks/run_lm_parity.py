@@ -40,6 +40,13 @@ ROWS = {
                                                      sp_impl="ulysses")),
     "dp2_pp2_tp2": dict(mesh=dict(data=2, stage=2, model=2),
                         model=dict(tp_axis="model"), microbatches=2),
+    # The hand-scheduled 1F1B backward must land on the same losses as the
+    # whole-program-AD GPipe rows (same config as pp2 but schedule="1f1b").
+    "pp2_1f1b": dict(mesh=dict(stage=2), model=dict(), microbatches=2,
+                     schedule="1f1b"),
+    "dp2_pp2_tp2_1f1b": dict(mesh=dict(data=2, stage=2, model=2),
+                             model=dict(tp_axis="model"), microbatches=2,
+                             schedule="1f1b"),
 }
 
 
@@ -66,6 +73,7 @@ def run_row(name: str, row: dict, steps: int) -> dict:
                                   weight_decay=0.0),
         batch_size=8, seq_len=128,
         num_microbatches=row.get("microbatches", 1),
+        pipeline_schedule=row.get("schedule", "gpipe"),
         steps_per_epoch=steps, epochs=1, seed=0,
         log_dir="/tmp/lm_parity_log", checkpoint_dir="/tmp/lm_parity_ckpt_"
         + name)
