@@ -60,6 +60,30 @@ def _last_k_block(qi, bq, block_k):
     return ((qi + 1) * bq - 1) // block_k
 
 
+def _block_interior(qi, j, bq, bk, window):
+    """True when the (q block qi, k block j) tile lies strictly inside the
+    causal band — every key <= every query, and (windowed) every key inside
+    the window — so ``band_keep`` would be all-true and the kernels may
+    take their mask-free step. The complement of ``band_keep`` at block
+    granularity: keep the two definitions side by side so they cannot
+    drift."""
+    interior = (j + 1) * bk - 1 <= qi * bq
+    if window is not None:
+        interior = jnp.logical_and(
+            interior, j * bk > qi * bq + bq - 1 - window)
+    return interior
+
+
+def _when_banded(in_band, interior, step):
+    """Dispatch one grid step to ``step(masked: bool)``: mask-free for
+    band-interior tiles, masked for diagonal/window-edge tiles, skipped
+    outside the band. Shared by all three kernels (the fast path matters
+    because the forward is VPU-bound — kernel_profile_r4.json)."""
+    pl.when(jnp.logical_and(in_band, interior))(lambda: step(False))
+    pl.when(jnp.logical_and(in_band, jnp.logical_not(interior)))(
+        lambda: step(True))
+
+
 def _kv_stream_map(causal, bq, bk, window):
     """Index map for K/V blocks streamed over the minor grid dim. Causal
     programs clamp j into the band [start, diag] so the out-of-band steps
@@ -101,7 +125,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    def _step():
+    def _step(masked: bool):
         q = q_ref[0] * scale                               # [BQ, D]
         k = k_ref[0]                                       # [BK, D]
         v = v_ref[0]
@@ -112,7 +136,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[...]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
         keep = None
-        if causal:
+        if masked:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(
@@ -121,7 +145,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1)[:, None])     # [BQ, LW]
         p = jnp.exp(s - m_new[:, :1])
-        if causal and window is not None:
+        if masked and window is not None:
             # A row whose every key in this block is banded out while m is
             # still at the sentinel would get exp(NEG_INF - NEG_INF) = 1;
             # zero masked entries explicitly. Unreachable without a window
@@ -139,11 +163,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # Skip K blocks entirely outside the band: above the diagonal, and
         # (windowed) entirely left of the band. Their grid steps still run,
         # but fetch no new block (the index map clamps) and do no compute.
+        # Blocks strictly inside the band (every key <= every query, no
+        # window edge) take a mask-free step — the iota/compare/select VPU
+        # passes run only on diagonal-crossing blocks, which matters
+        # because the forward is VPU-bound (kernel_profile_r4.json).
         in_band = jnp.logical_and(j >= _band_start_k(qi, bq, window, bk),
                                   j <= _last_k_block(qi, bq, bk))
-        pl.when(in_band)(_step)
+        _when_banded(in_band, _block_interior(qi, j, bq, bk, window), _step)
     else:
-        _step()
+        _step(False)
 
     @pl.when(j == num_k - 1)
     def _finalize():
@@ -184,7 +212,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse_scr[...] = jnp.broadcast_to(lse_ref[0, 0][:, None], (bq, lw))
         delta_scr[...] = jnp.broadcast_to(delta_ref[0, 0][:, None], (bq, lw))
 
-    def _step():
+    def _step(masked: bool):
         q = q_ref[0]                                       # [BQ, D] (input
         do = do_ref[0]                                     # dtype for MXU)
         lse = lse_scr[:, :1]                               # [BQ, 1]
@@ -193,7 +221,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0]
         s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         p = jnp.exp(s - lse)                               # [BQ, BK] f32
-        if causal:
+        if masked:
             q_pos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(
@@ -206,9 +234,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         in_band = jnp.logical_and(j >= _band_start_k(qi, bq, window, bk),
                                   j <= _last_k_block(qi, bq, bk))
-        pl.when(in_band)(_step)
+        _when_banded(in_band, _block_interior(qi, j, bq, bk, window), _step)
     else:
-        _step()
+        _step(False)
 
     @pl.when(j == num_k - 1)
     def _finalize():
@@ -267,7 +295,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # arrive lane-major) broadcast along sublanes for free, and dk/dv land
     # sublane-major [BK, D] straight from the MXU. No lane<->sublane
     # relayout anywhere in the Q loop.
-    def _step():
+    def _step(masked: bool):
         k = k_ref[0]                                       # [BK, D] (input
         v = v_ref[0]                                       # dtype for MXU)
         q = q_ref[0]                                       # [BQ, D]
@@ -278,7 +306,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s_t = scale * jax.lax.dot_general(                 # [BK, BQ]
             k, q, contract_d, preferred_element_type=jnp.float32)
         p_t = jnp.exp(s_t - lse[None, :])                  # [BK, BQ] f32
-        if causal:
+        if masked:
             k_pos = ki * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, bq), 0)
             q_pos = i * bq + jax.lax.broadcasted_iota(
@@ -293,9 +321,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         lo, hi = _q_bounds_for_k(ki, bk, bq, num_q, causal, window)
-        pl.when(jnp.logical_and(i >= lo, i < hi))(_step)
+        in_band = jnp.logical_and(i >= lo, i < hi)
+        _when_banded(in_band, _block_interior(i, ki, bq, bk, window), _step)
     else:
-        _step()
+        _step(False)
 
     @pl.when(i == num_q - 1)
     def _finalize():
