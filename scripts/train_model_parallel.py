@@ -115,10 +115,12 @@ def main():
             "axis — refusing to silently drop your requested data "
             "parallelism)")
     if args.engine == "spmd":
-        if args.schedule != "gpipe" or args.virtual_stages != 1:
+        if args.virtual_stages != 1:
             raise SystemExit(
-                "--engine spmd implements the GPipe schedule only "
-                "(1F1B/virtual stages are runner-engine schedules)")
+                "--engine spmd runs one stage per device; virtual stages "
+                "are a runner-engine schedule (interleaving only beats "
+                "GPipe under 1F1B ordering, and the SPMD 1F1B is "
+                "single-level — see docs/ROUND4.md)")
         from distributed_model_parallel_tpu.train.trainer import Trainer
 
         Trainer(config).fit()
