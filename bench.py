@@ -47,6 +47,7 @@ def bench_lm() -> None:
     RoPE, causal LM loss, one full SPMD train step at DMP_BENCH_SEQ tokens
     (default 8192 — the sequence length PARITY.md's kernel numbers quote).
     """
+    from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.models import transformer as tfm
     from distributed_model_parallel_tpu.train.lm_trainer import (
         LMTrainConfig,
@@ -82,6 +83,14 @@ def bench_lm() -> None:
         # A throughput bench needs no held-out eval, and at small batch the
         # default 10% tail cannot fit one seq_len eval window (ADVICE r3).
         eval_batches=0,
+        # DMP_BENCH_PP/DMP_BENCH_SCHEDULE bench the pipeline schedules
+        # (gpipe | 1f1b) — meaningful with multiple chips, where the
+        # stage axis is real.
+        mesh=MeshConfig(stage=int(os.environ.get("DMP_BENCH_PP", "1")),
+                        data=n_chips
+                        // int(os.environ.get("DMP_BENCH_PP", "1"))),
+        num_microbatches=int(os.environ.get("DMP_BENCH_MICRO", "1")),
+        pipeline_schedule=os.environ.get("DMP_BENCH_SCHEDULE", "gpipe"),
         log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",
     )
     t = LMTrainer(cfg)
@@ -126,6 +135,8 @@ def bench_lm() -> None:
            if flops and peak else None)
     tokens_per_s_per_chip = batch * seq / dt / n_chips
     tag = f"moe{moe}x{cfg.model.moe_top_k}_" if moe else ""
+    if cfg.mesh.stage > 1:
+        tag += f"pp{cfg.mesh.stage}_{cfg.pipeline_schedule}_"
     out = {
         "metric": f"lm_{tag}seq{seq}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s_per_chip, 1),
