@@ -69,6 +69,14 @@ def bench_lm() -> None:
     # MoE (DMP_BENCH_MOE_TOPK, default 2) — the on-chip MoE throughput row
     # (drop rate reported alongside; VERDICT r3 weak #5).
     moe = int(os.environ.get("DMP_BENCH_MOE_EXPERTS", "0"))
+    # DMP_BENCH_PP/DMP_BENCH_MICRO/DMP_BENCH_SCHEDULE bench the pipeline
+    # schedules over a real stage axis (multi-chip rounds).
+    pp = int(os.environ.get("DMP_BENCH_PP", "1"))
+    if n_chips % pp:
+        raise SystemExit(
+            f"DMP_BENCH_PP={pp} must divide the chip count ({n_chips}); "
+            f"a partial mesh would silently under-report the per-chip "
+            f"numbers, which divide by all {n_chips} chips")
     cfg = LMTrainConfig(
         model=tfm.TransformerConfig(
             vocab_size=32_000, d_model=1024, n_heads=8, n_layers=8,
@@ -83,12 +91,7 @@ def bench_lm() -> None:
         # A throughput bench needs no held-out eval, and at small batch the
         # default 10% tail cannot fit one seq_len eval window (ADVICE r3).
         eval_batches=0,
-        # DMP_BENCH_PP/DMP_BENCH_SCHEDULE bench the pipeline schedules
-        # (gpipe | 1f1b) — meaningful with multiple chips, where the
-        # stage axis is real.
-        mesh=MeshConfig(stage=int(os.environ.get("DMP_BENCH_PP", "1")),
-                        data=n_chips
-                        // int(os.environ.get("DMP_BENCH_PP", "1"))),
+        mesh=MeshConfig(stage=pp, data=n_chips // pp),
         num_microbatches=int(os.environ.get("DMP_BENCH_MICRO", "1")),
         pipeline_schedule=os.environ.get("DMP_BENCH_SCHEDULE", "gpipe"),
         log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",
@@ -136,7 +139,10 @@ def bench_lm() -> None:
     tokens_per_s_per_chip = batch * seq / dt / n_chips
     tag = f"moe{moe}x{cfg.model.moe_top_k}_" if moe else ""
     if cfg.mesh.stage > 1:
-        tag += f"pp{cfg.mesh.stage}_{cfg.pipeline_schedule}_"
+        # Microbatch count is part of the measurement identity: the bubble
+        # fraction (S-1)/(M+S-1) moves throughput ~2x across M.
+        tag += (f"pp{cfg.mesh.stage}m{cfg.num_microbatches}_"
+                f"{cfg.pipeline_schedule}_")
     out = {
         "metric": f"lm_{tag}seq{seq}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s_per_chip, 1),
