@@ -214,12 +214,18 @@ def bench_decode() -> None:
     }))
 
 
-def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int):
+def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int,
+                    image_size: int = 32):
     """The headline CNN workload: a device-resident Trainer plus a
     ``dispatch()`` closure running ``steps_per_dispatch`` scanned train
     steps per call. Shared by this bench and the hardware profiler
     (benchmarks/run_step_profile.py), so the profiled program IS the timed
-    program by construction."""
+    program by construction.
+
+    ``image_size`` > 32 compiles the on-device resize stage in (32px
+    synthetic uint8 on the wire, bilinear upsample inside the step) and
+    switches the model to its ImageNet stride table — the reference's
+    224px finetune workload shape (``Readme.md:186-205``)."""
     from distributed_model_parallel_tpu.config import (
         DataConfig,
         MeshConfig,
@@ -230,10 +236,16 @@ def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int):
     from distributed_model_parallel_tpu.train.trainer import Trainer
 
     n_chips = len(jax.devices())
+    extra = {"input_layout": "imagenet"} if image_size != 32 else {}
     cfg = TrainConfig(
-        model=ModelConfig(name=model_name, dtype="bfloat16"),
+        model=ModelConfig(name=model_name, dtype="bfloat16", extra=extra),
         data=DataConfig(name="synthetic", batch_size=batch,
                         eval_batch_size=batch,
+                        image_size=image_size,
+                        # Generate native 32px so the on-device upsample is
+                        # actually compiled into the step (a 224px-native
+                        # dataset would make resolve_input_size skip it).
+                        synthetic_native_size=32,
                         synthetic_train_size=batch * 4,
                         synthetic_eval_size=batch),
         optimizer=OptimizerConfig(learning_rate=0.4, warmup_steps=10),
@@ -290,8 +302,11 @@ def main() -> None:
     # BASELINE.json north-star model); the headline metric stays the
     # reference's MobileNetV2 table (Readme.md:286).
     model_name = os.environ.get("DMP_BENCH_MODEL", "mobilenetv2")
+    # DMP_BENCH_IMG=224 benches the compute-bound native-resolution
+    # workload (on-device 32->224 upsample + ImageNet stride table).
+    image_size = int(os.environ.get("DMP_BENCH_IMG", "32"))
     trainer, dispatch = build_cnn_bench(model_name, batch,
-                                        steps_per_dispatch)
+                                        steps_per_dispatch, image_size)
 
     # Warmup (compile) + steady-state timing. A host fetch of the final
     # metrics is the sync point: on the remote-TPU tunnel block_until_ready
@@ -330,7 +345,8 @@ def main() -> None:
     # reference number, so the ratio is omitted rather than misquoted.
     vs_baseline = (round(
         samples_per_sec_per_chip / BASELINE_SAMPLES_PER_SEC_PER_GPU, 3)
-        if model_name == "mobilenetv2" and batch == 512 else None)
+        if model_name == "mobilenetv2" and batch == 512 and image_size == 32
+        else None)
     # MFU: cost-analysis FLOPs of ONE train step over the chip's peak.
     # Must be the loop-free single-step program (_train_step): the scanned
     # _multi_step's loop body is counted once by cost analysis regardless
@@ -375,8 +391,10 @@ def main() -> None:
     demand_gbs = round(bytes_step / dt / 1e9, 1) if bytes_step else None
     demand_frac = (round(bytes_step / dt / hbm_peak, 3)
                    if bytes_step and hbm_peak else None)
+    img_tag = "" if image_size == 32 else f"at{image_size}"
     out = {
-        "metric": f"{model_name}_cifar10_bs{batch}_train_samples_per_sec_per_chip",
+        "metric": (f"{model_name}_cifar10{img_tag}_bs{batch}"
+                   f"_train_samples_per_sec_per_chip"),
         "value": round(samples_per_sec_per_chip, 2),
         "unit": "samples/s/chip",
         "vs_baseline": vs_baseline,
@@ -386,7 +404,7 @@ def main() -> None:
     }
     # The committed hardware trace only covers the workload it profiled —
     # don't claim measured saturation for other models/batches.
-    if model_name == "mobilenetv2" and batch == 512:
+    if model_name == "mobilenetv2" and batch == 512 and image_size == 32:
         out["hbm_saturation_measured"] = "benchmarks/step_profile_r5.json"
     print(json.dumps(out))
 

@@ -122,6 +122,11 @@ class DataConfig:
     synthetic_ok: bool = True               # fall back to synthetic data offline
     synthetic_train_size: int = 2048
     synthetic_eval_size: int = 512
+    # Native resolution of GENERATED synthetic images (None = image_size).
+    # Set below image_size to exercise the on-device resize input stage the
+    # way a real small-native dataset does (CIFAR pixels upsampled to a
+    # 224px backbone, reference Readme.md:186-196).
+    synthetic_native_size: int | None = None
     prefetch: int = 2                       # host-thread prefetch depth (0 = off)
     use_native: bool = False                # C++ row-gather batch assembly
     # File-backed datasets (ImageFolder / CUB): True streams pixels from

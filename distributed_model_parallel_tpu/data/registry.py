@@ -277,7 +277,8 @@ def load_dataset(cfg) -> tuple[ArrayDataset, ArrayDataset]:
         raise FileNotFoundError(
             f"dataset {cfg.name!r} not found under {cfg.root!r} and "
             f"synthetic_ok=False")
-    return (_synthetic(cfg.synthetic_train_size, cfg.image_size, num_classes,
+    native = cfg.synthetic_native_size or cfg.image_size
+    return (_synthetic(cfg.synthetic_train_size, native, num_classes,
                        cfg.seed),
-            _synthetic(cfg.synthetic_eval_size, cfg.image_size, num_classes,
+            _synthetic(cfg.synthetic_eval_size, native, num_classes,
                        cfg.seed + 1))

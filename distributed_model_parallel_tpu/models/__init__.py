@@ -45,17 +45,29 @@ def get_model(config: ModelConfig, *, axis_name: str | None = None) -> StagedMod
     only consulted when ``config.batchnorm == "sync"``.
     """
     name = config.name
+    # extra={"input_layout": "imagenet"} selects native-resolution stride
+    # tables (224px finetune workload). Only mobilenetv2/resnet have them;
+    # every other family REJECTS a non-default layout rather than silently
+    # running its CIFAR strides under an "imagenet" label.
+    extra = dict(config.extra)
+    layout = extra.pop("input_layout", "cifar")
+    if layout != "cifar" and name not in (
+            "mobilenetv2", "mobilenetv2_nobn",
+            "resnet18", "resnet34", "resnet50"):
+        raise ValueError(
+            f"model {name!r} has no input_layout={layout!r} variant "
+            f"(only mobilenetv2/resnet18/34/50 do)")
     if name in ("mobilenetv2", "mobilenetv2_nobn"):
         kw = _cnn_kwargs(config, axis_name)
         if name.endswith("_nobn"):
             kw["bn_mode"] = "none"
-        return build_mobilenetv2(**kw)
+        return build_mobilenetv2(**kw, input_layout=layout)
     if name in ("resnet18", "resnet34", "resnet50"):
-        return build_resnet(name, **_cnn_kwargs(config, axis_name))
+        return build_resnet(name, **_cnn_kwargs(config, axis_name),
+                            input_layout=layout)
     if name == "tinycnn":
         from distributed_model_parallel_tpu.models.tinycnn import build_tinycnn
-        return build_tinycnn(**_cnn_kwargs(config, axis_name),
-                             **dict(config.extra))
+        return build_tinycnn(**_cnn_kwargs(config, axis_name), **extra)
     if name == "transformer":
         from distributed_model_parallel_tpu.models.transformer import build_transformer
         return build_transformer(config)

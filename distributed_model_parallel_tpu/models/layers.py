@@ -44,7 +44,9 @@ class ConvUnit(nn.Module):
 
     ``ops`` is a sequence of dicts with keys: features, kernel, stride,
     groups, act (bool), norm (bool — set False for a bare conv, e.g. the
-    pre-activation stems where the first block's BN comes first). A
+    pre-activation stems where the first block's BN comes first), and
+    maxpool (int — stride of a trailing 3x3 SAME max-pool, e.g. the
+    ImageNet ResNet stem's pool; 0/absent = none). A
     ``feature_group_count == features`` conv is a depthwise conv
     (MXU-friendly form of the reference's ``groups=planes`` depthwise,
     ``model/mobilenetv2.py:19``).
@@ -78,6 +80,9 @@ class ConvUnit(nn.Module):
                           axis_name=self.axis_name, name=f"bn{i}")(x, train)
             if op.get("act", True):
                 x = self.activation(x)
+            if op.get("maxpool"):
+                s = op["maxpool"]
+                x = nn.max_pool(x, (3, 3), strides=(s, s), padding="SAME")
         return x
 
 

@@ -41,6 +41,20 @@ CFG = (
     (6, 320, 1, 1),
 )
 
+# Standard ImageNet strides (torchvision mobilenet_v2) — the architecture
+# the reference's 224px finetune recipe runs (``Readme.md:186-205``): stem
+# stride 2 and stride 2 in the second group, so 224px inputs reach the head
+# as 7x7 maps instead of the CIFAR variant's 28x28.
+CFG_IMAGENET = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
 
 class InvertedResidual(nn.Module):
     """Expand 1x1 → depthwise 3x3 → project 1x1, residual iff stride == 1."""
@@ -53,6 +67,12 @@ class InvertedResidual(nn.Module):
     bn_epsilon: float = 1e-5
     dtype: Any = jnp.float32
     axis_name: str | None = None
+    # "reference": the CIFAR block (unconditional expand conv; projected
+    # 1x1+BN shortcut when channel counts differ at stride 1,
+    # ``model/mobilenetv2.py:26-36``). "torchvision": the ImageNet block
+    # (no expand conv at expansion 1; residual ONLY iff stride==1 and
+    # in_features==features — no projection branch exists).
+    style: str = "reference"
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -65,10 +85,13 @@ class InvertedResidual(nn.Module):
                          epsilon=self.bn_epsilon, dtype=self.dtype,
                          axis_name=self.axis_name, name=name)
 
-        y = nn.Conv(hidden, (1, 1), use_bias=use_bias, dtype=self.dtype,
-                    name="expand")(x)
-        y = norm("expand_bn")(y, train)
-        y = nn.relu(y)
+        if self.expansion == 1 and self.style == "torchvision":
+            y = x
+        else:
+            y = nn.Conv(hidden, (1, 1), use_bias=use_bias, dtype=self.dtype,
+                        name="expand")(x)
+            y = norm("expand_bn")(y, train)
+            y = nn.relu(y)
         y = nn.Conv(hidden, (3, 3), strides=(self.stride,) * 2, padding="SAME",
                     feature_group_count=hidden, use_bias=use_bias,
                     dtype=self.dtype, name="depthwise")(y)
@@ -80,6 +103,8 @@ class InvertedResidual(nn.Module):
 
         if self.stride == 1:
             if in_features != self.features:
+                if self.style == "torchvision":
+                    return y          # no residual at all
                 x = nn.Conv(self.features, (1, 1), use_bias=use_bias,
                             dtype=self.dtype, name="shortcut")(x)
                 x = norm("shortcut_bn")(x, train)
@@ -90,19 +115,33 @@ class InvertedResidual(nn.Module):
 def build_mobilenetv2(num_classes: int = 10, *, bn_mode: str = "local",
                       bn_momentum: float = 0.9, bn_epsilon: float = 1e-5,
                       dtype: Any = jnp.float32,
-                      axis_name: str | None = None) -> StagedModel:
-    """19 units: stem, 17 inverted-residual blocks, head."""
+                      axis_name: str | None = None,
+                      input_layout: str = "cifar") -> StagedModel:
+    """19 units: stem, 17 inverted-residual blocks, head.
+
+    ``input_layout="imagenet"`` selects the standard stride table
+    (stride-2 stem, CFG_IMAGENET) for native-resolution inputs — the
+    224px finetune workload; ``"cifar"`` keeps the reference's 32px
+    adaptation (``model/mobilenetv2.py:42,51``)."""
+    if input_layout not in ("cifar", "imagenet"):
+        raise ValueError(f"unknown input_layout: {input_layout!r}")
+    imagenet = input_layout == "imagenet"
     common = dict(bn_mode=bn_mode, bn_momentum=bn_momentum,
                   bn_epsilon=bn_epsilon, dtype=dtype, axis_name=axis_name)
     units: list[nn.Module] = [
-        ConvUnit(ops=({"features": 32, "kernel": 3, "stride": 1},), **common)
+        ConvUnit(ops=({"features": 32, "kernel": 3,
+                       "stride": 2 if imagenet else 1},), **common)
     ]
-    for expansion, features, num_blocks, stride in CFG:
+    for expansion, features, num_blocks, stride in (
+            CFG_IMAGENET if imagenet else CFG):
         for b in range(num_blocks):
             units.append(InvertedResidual(
                 expansion=expansion, features=features,
-                stride=stride if b == 0 else 1, **common))
+                stride=stride if b == 0 else 1,
+                style="torchvision" if imagenet else "reference", **common))
     units.append(ClassifierHead(
         num_classes=num_classes, conv_features=1280, **common))
     name = "mobilenetv2" if bn_mode != "none" else "mobilenetv2_nobn"
+    if imagenet:
+        name += "_imagenet"
     return StagedModel(units=tuple(units), name=name)

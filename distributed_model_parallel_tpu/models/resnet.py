@@ -74,13 +74,20 @@ class ResBlock(nn.Module):
 def build_resnet(arch: str = "resnet18", num_classes: int = 10, *,
                  bn_mode: str = "local", bn_momentum: float = 0.9,
                  bn_epsilon: float = 1e-5, dtype: Any = jnp.float32,
-                 axis_name: str | None = None) -> StagedModel:
+                 axis_name: str | None = None,
+                 input_layout: str = "cifar") -> StagedModel:
+    """``input_layout="imagenet"`` = the standard stem (7x7 stride-2 conv +
+    3x3 stride-2 max-pool) for native-resolution (224px) inputs;
+    ``"cifar"`` = the 32px adaptation (3x3 stride-1 stem, no pool)."""
+    if input_layout not in ("cifar", "imagenet"):
+        raise ValueError(f"unknown input_layout: {input_layout!r}")
+    imagenet = input_layout == "imagenet"
     kind, groups = ARCH[arch]
     common = dict(bn_mode=bn_mode, bn_momentum=bn_momentum,
                   bn_epsilon=bn_epsilon, dtype=dtype, axis_name=axis_name)
-    units: list[nn.Module] = [
-        ConvUnit(ops=({"features": 64, "kernel": 3, "stride": 1},), **common)
-    ]
+    stem_op = ({"features": 64, "kernel": 7, "stride": 2, "maxpool": 2}
+               if imagenet else {"features": 64, "kernel": 3, "stride": 1})
+    units: list[nn.Module] = [ConvUnit(ops=(stem_op,), **common)]
     for g, num_blocks in enumerate(groups):
         for b in range(num_blocks):
             units.append(ResBlock(
@@ -88,4 +95,5 @@ def build_resnet(arch: str = "resnet18", num_classes: int = 10, *,
                 stride=(2 if g > 0 and b == 0 else 1), **common))
     units.append(ClassifierHead(num_classes=num_classes, conv_features=None,
                                 **common))
-    return StagedModel(units=tuple(units), name=arch)
+    name = arch + ("_imagenet" if imagenet else "")
+    return StagedModel(units=tuple(units), name=name)

@@ -89,7 +89,7 @@ def device_plane(space, index: int = 0):
 def plane_peaks(plane) -> dict:
     """Device peaks the profiler itself reports (TFLOP/s, HBM GB/s…) —
     the hardware's own numbers, preferable to our static tables."""
-    names = {k: v.name for k, v in plane.stat_metadata.items()}
+    names = _stat_names(plane)
     out = {}
     for s in plane.stats:
         key = names.get(s.metadata_id, str(s.metadata_id))

@@ -359,3 +359,41 @@ def test_simpledla_param_count():
     expected += _dla_tree(256, 512, 2, 1)
     expected += dense(512, 10)
     assert n_params("simpledla") == expected
+
+
+# ------------------------------------------------- ImageNet-layout variants
+def _n_params_imagenet(name: str, num_classes: int) -> int:
+    model = get_model(ModelConfig(name=name, num_classes=num_classes,
+                                  extra={"input_layout": "imagenet"}))
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)))
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def test_mobilenetv2_imagenet_matches_torchvision():
+    # torchvision.models.mobilenet_v2(num_classes=1000): 3,504,872 params.
+    # Pins the whole ImageNet-variant wiring: stride-2 stem, CFG_IMAGENET,
+    # no expand conv at expansion 1, no projected shortcut (residual only
+    # iff stride==1 and channels match) — the 224px finetune architecture
+    # (reference Readme.md:186-205).
+    assert _n_params_imagenet("mobilenetv2", 1000) == 3_504_872
+
+
+def test_resnet50_imagenet_matches_torchvision():
+    # torchvision.models.resnet50(num_classes=1000): 25,557,032 params.
+    # Pins the ImageNet stem (7x7 s2 conv + BN; the 3x3 s2 max-pool is
+    # parameter-free but required for the head's 7x7 maps).
+    assert _n_params_imagenet("resnet50", 1000) == 25_557_032
+
+
+def test_imagenet_layout_changes_spatial_reduction():
+    # 224px through the ImageNet layout must reach the head as 7x7 maps
+    # (stem /2, pool or group strides /16) — a stride-table mistake would
+    # change the pre-pool spatial size, which the param count cannot see.
+    model = get_model(ModelConfig(name="mobilenetv2", num_classes=10,
+                                  extra={"input_layout": "imagenet"}))
+    params, state = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)))
+    # All units except the global-pooling head: the pre-pool maps must be
+    # 7x7 (224 / 2 stem / 16 group strides).
+    y, _ = model.apply_range(params, state, jnp.zeros((1, 224, 224, 3)),
+                             0, len(model.units) - 1, train=False)
+    assert y.shape[1:3] == (7, 7), y.shape
