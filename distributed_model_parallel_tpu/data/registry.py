@@ -88,7 +88,9 @@ class LazyImageArray:
             if self._pool is None:
                 # One persistent pool per array, reused across batches —
                 # this is the hot input path; a per-batch pool would pay
-                # thread create/join once per step.
+                # thread create/join once per step. close() / __del__
+                # shuts it down (ADVICE r4: the eager decode-once path
+                # would otherwise leak idle workers per split).
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._pool = ThreadPoolExecutor(self.num_workers)
@@ -97,6 +99,15 @@ class LazyImageArray:
             for j in range(len(idx)):
                 work(j)
         return out
+
+    def close(self) -> None:
+        """Shut down the decode pool (idempotent)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        self.close()
 
     def __array__(self, *args, **kwargs):
         raise TypeError(
@@ -176,7 +187,9 @@ def _build_split(paths: list[str], labels: list[int], image_size: int,
         lazy = len(paths) * image_size * image_size * 3 > LAZY_AUTO_BYTES
     imgs = LazyImageArray(paths, image_size, num_workers=num_workers)
     if not lazy:
-        imgs = imgs[np.arange(len(paths))]     # decode once, keep pixels
+        decoded = imgs[np.arange(len(paths))]  # decode once, keep pixels
+        imgs.close()                           # don't leak the decode pool
+        imgs = decoded
     return ArrayDataset(imgs, y, num_classes, mean, std)
 
 

@@ -51,11 +51,14 @@ def get_model(config: ModelConfig, *, axis_name: str | None = None) -> StagedMod
     # running its CIFAR strides under an "imagenet" label.
     extra = dict(config.extra)
     layout = extra.pop("input_layout", "cifar")
-    if layout != "cifar" and name not in (
+    if "input_layout" in config.extra and name not in (
             "mobilenetv2", "mobilenetv2_nobn",
             "resnet18", "resnet34", "resnet50"):
+        # Reject even an explicit "cifar" for families without the knob:
+        # the transformer/embedding builders splat config.extra raw and
+        # would die on the stray key with a confusing TypeError.
         raise ValueError(
-            f"model {name!r} has no input_layout={layout!r} variant "
+            f"model {name!r} takes no input_layout "
             f"(only mobilenetv2/resnet18/34/50 do)")
     if name in ("mobilenetv2", "mobilenetv2_nobn"):
         kw = _cnn_kwargs(config, axis_name)

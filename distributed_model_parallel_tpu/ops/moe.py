@@ -5,9 +5,11 @@ framework's first-class parallelism set: top-k token routing — top-1
 (switch-style, raw gate) or top-2+ (GShard-style, gates normalized over the
 selected experts) — with static capacity, experts sharded
 one-per-device-group over the ``expert`` axis, and token exchange via
-``all_to_all`` — the TPU-native form of expert dispatch (dense einsum
-dispatch/combine against one-hot capacity masks, so everything is
-static-shaped MXU work; dropped tokens pass through on the residual path).
+``all_to_all`` — the TPU-native form of expert dispatch: static-shaped
+scatter/gather against per-choice queue-slot indices (round 5; the one-hot
+einsum masks used through round 4 cost N*E*C*d MAC per layer — orders of
+magnitude more than the experts themselves at bench shapes). Dropped
+tokens pass through on the residual path.
 
 Shapes (inside shard_map over the expert axis):
   x_local:        [B_local, T, d]   tokens on this device group
@@ -51,10 +53,18 @@ def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
 
 
 def _route(router, x, cfg: MoEConfig):
-    """Top-k routing with per-expert capacity.
+    """Top-k routing with per-expert capacity, in INDEX form.
 
-    Returns (dispatch [N, E, C] one-hot, combine [N, E, C] weighted,
-    stats [3] f32) for N flattened tokens, where stats is
+    Returns ``(experts [N,k] i32, gates [N,k], slot [N,k] i32,
+    keep [N,k] bool, cap, stats [3] f32)`` for N flattened tokens:
+    ``slot[n,j] = experts[n,j] * cap + queue position`` — each kept
+    token-choice owns a unique slot in the [E*cap] expert-queue space,
+    which is what lets dispatch/combine be gathers instead of the
+    [N, E, C] one-hot einsums this module used through round 4 (those
+    masks cost N*E*C*d MAC/layer — ~2 PFLOP at the bench shape, >100x
+    the expert FFN math itself; the index form is pure data movement).
+
+    ``stats`` is
 
     * ``[0]`` load-balance loss (Switch/GShard first-choice form),
     * ``[1]`` router z-loss — mean squared logsumexp of the router
@@ -82,22 +92,21 @@ def _route(router, x, cfg: MoEConfig):
     if k > 1 and cfg.normalize_gates:
         gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
 
-    dispatch = jnp.zeros((n, E, cap), x.dtype)
-    combine = jnp.zeros((n, E, cap), x.dtype)
-    counts = jnp.zeros((E,), x.dtype)                 # queue heads per expert
-    kept = jnp.zeros((), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)               # queue heads per expert
+    slots, keeps = [], []
     for j in range(k):                                # k is static (config)
-        onehot = jax.nn.one_hot(experts[:, j], E)     # [N, E]
+        e_j = experts[:, j]                           # [N]
+        onehot = jax.nn.one_hot(e_j, E, dtype=jnp.int32)
         # Position of each token within its expert's queue, past all
         # choice-<j traffic.
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + counts) * onehot
-        keep = (pos < cap) * onehot                   # drop overflow
-        posk = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)   # [N]
-        d_j = keep[:, :, None] * jax.nn.one_hot(posk, cap)[:, None, :]
-        dispatch = dispatch + d_j
-        combine = combine + d_j * gates[:, j][:, None, None]
+        pos_all = jnp.cumsum(onehot, axis=0) - 1 + counts       # [N, E]
+        pos = jnp.take_along_axis(pos_all, e_j[:, None], axis=1)[:, 0]
+        keep = pos < cap
+        slots.append(e_j * cap + jnp.minimum(pos, cap - 1))
+        keeps.append(keep)
         counts = counts + jnp.sum(onehot, axis=0)
-        kept = kept + jnp.sum(keep).astype(jnp.float32)
+    slot = jnp.stack(slots, axis=1)                   # [N, k]
+    keep = jnp.stack(keeps, axis=1)                   # [N, k]
 
     # Load-balancing loss over first-choice assignment fractions
     # (Switch/GShard form).
@@ -107,10 +116,10 @@ def _route(router, x, cfg: MoEConfig):
     balance = E * jnp.sum(frac_tokens * frac_probs)
     z = jnp.mean(jax.nn.logsumexp(logits.astype(jnp.float32),
                                   axis=-1) ** 2)
-    drop_rate = 1.0 - kept / (n * k)
+    drop_rate = 1.0 - jnp.sum(keep.astype(jnp.float32)) / (n * k)
     stats = jnp.stack([balance.astype(jnp.float32), z,
                        jax.lax.stop_gradient(drop_rate)])
-    return dispatch, combine, stats
+    return experts, gates, slot, keep, cap, stats
 
 
 def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
@@ -124,15 +133,24 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
     device group runs only its own experts, then results return the same way.
     """
     b, t, d = x.shape
+    n = b * t
     xf = x.reshape(-1, d)                             # [N, d]
-    dispatch, combine, aux = _route(params["router"], xf, cfg)
+    experts, gates, slot, keep, cap, aux = _route(params["router"], xf, cfg)
+    E = cfg.num_experts
 
-    # expert_in[e, c, :] = sum_n dispatch[n,e,c] * x[n]
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    # Dispatch as a scatter of token IDs into queue slots, then a gather:
+    # kept slots are unique (queue positions), so .at[].set never collides;
+    # dropped choices scatter to the out-of-bounds sentinel E*cap and are
+    # dropped; unfilled slots keep token id N -> gather the zero pad row.
+    token_ids = jnp.arange(n, dtype=jnp.int32)
+    slot_token = jnp.full((E * cap,), n, jnp.int32)
+    for j in range(cfg.top_k):
+        idx = jnp.where(keep[:, j], slot[:, j], E * cap)
+        slot_token = slot_token.at[idx].set(token_ids, mode="drop")
+    xf_pad = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
+    expert_in = xf_pad[slot_token].reshape(E, cap, d)
 
     if ep_axis is not None:
-        ep = jax.lax.axis_size(ep_axis)
-        e_local = params["w_in"].shape[0]             # E / ep
         # [E, C, d] -> exchange so this device holds its experts' tokens from
         # ALL groups (tiled: split expert axis by ep, concat source-major on
         # the capacity axis): -> [E_local, ep*C, d].
@@ -148,9 +166,15 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
         expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
 
-    y = jnp.einsum("nec,ecd->nd", combine, expert_out)
-    # The one-hot routing masks are f32 (softmax-derived), which promotes
-    # the combine einsum; cast back so a bf16 residual stream stays bf16
-    # (a f32-promoted carry breaks the blocks lax.scan under mixed
-    # precision — surfaced by the bf16 MoE bench).
+    # Combine: gather each kept choice's expert output back to its token,
+    # weighted by the (differentiable) gate. Gate gradients flow exactly as
+    # in the einsum form; the gathers transpose to scatter-adds under AD.
+    out_flat = expert_out.reshape(E * cap, d)
+    y = jnp.zeros((n, d), x.dtype)
+    for j in range(cfg.top_k):
+        w = jnp.where(keep[:, j], gates[:, j], 0).astype(x.dtype)
+        y = y + w[:, None] * out_flat[slot[:, j]]
+    # f32 expert params would promote the adds above; a bf16 residual
+    # stream must come back bf16 (a promoted carry breaks the blocks
+    # lax.scan under mixed precision).
     return y.reshape(b, t, d).astype(x.dtype), aux

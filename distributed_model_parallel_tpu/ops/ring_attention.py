@@ -208,6 +208,7 @@ def _ring_flash_bwd(axis_name, causal, res, g):
     from distributed_model_parallel_tpu.ops.pallas_attention import (
         _flash_bwd_impl,
         default_blocks,
+        dispatch_entry,
     )
 
     q, k, v, o, lse = res
@@ -215,6 +216,14 @@ def _ring_flash_bwd(axis_name, causal, res, g):
     idx = jax.lax.axis_index(axis_name)
     b, t, h, _ = q.shape
     bq, bk = default_blocks()
+    # Per-kernel measured dispatch tiles (ADVICE r4: the non-ring flash
+    # path already uses them; without this the sp-ring backward left the
+    # ~9% dq/dkv tile win on the table).
+    entry = dispatch_entry() or {}
+    dq_blocks = ((entry["dq_block_q"], entry["dq_block_k"])
+                 if "dq_block_q" in entry else None)
+    dkv_blocks = ((entry["dkv_block_q"], entry["dkv_block_k"])
+                  if "dkv_block_q" in entry else None)
     perm = [(i, (i + 1) % n) for i in range(n)]
     # _flash_bwd_impl reads lse in its residual [B*H, T_pad] layout.
     lse_flat = lse.reshape(b * h, t)
@@ -226,7 +235,8 @@ def _ring_flash_bwd(axis_name, causal, res, g):
     for hop in range(n):
         def compute(k_t=k_t, v_t=v_t, hop_causal=(causal and hop == 0)):
             dq_b, dk_b, dv_b = _flash_bwd_impl(
-                q, k_t, v_t, o, lse_flat, g, hop_causal, bq, bk, None)
+                q, k_t, v_t, o, lse_flat, g, hop_causal, bq, bk, None,
+                dq_blocks=dq_blocks, dkv_blocks=dkv_blocks)
             return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
                     dv_b.astype(jnp.float32))
 

@@ -98,6 +98,15 @@ def test_1f1b_pp_sp_ring():
             dict(sp_axis="seq", pos_embedding="rope"), M=2)
 
 
+def test_1f1b_pp_sp_learned_pos():
+    # Learned positions under sequence parallelism exercise _embed_local's
+    # per-shard dynamic_slice of the pos table — and, in the backward, its
+    # scatter-transposed gradient summed over the seq axis (ADVICE r4: the
+    # rope case above never touches that path).
+    _parity(dict(data=1, stage=2, seq=2),
+            dict(sp_axis="seq", pos_embedding="learned"), M=2)
+
+
 def test_1f1b_m_exceeds_stages():
     # More microbatches than stages: the steady-state 1F1B regime, where
     # the stash ring (2S-1 slots) actually wraps.
