@@ -189,17 +189,21 @@ def bench_decode() -> None:
     fetch(out)
     dt = max(1e-9, time.perf_counter() - t0 - t_fetch)
     toks_per_s = batch * steps / dt
-    # Per decode step every parameter is read once, and the static-shape
-    # cached attention reads the FULL padded [total]-length cache with
-    # masking (generate() allocates t0+steps up front) — not just the
-    # logically-written prefix. bf16 bytes.
+    # Per decode step every parameter is read once; the cached attention
+    # reads a BLOCK-QUANTIZED prefix of the cache (generate() decodes in
+    # 256-position read-boundary segments — round 5; through round 4 it
+    # read the full padded [total] with masking every step). bf16 bytes,
+    # k and v.
     n_params = sum(x.size for x in jax.tree.leaves(params))
     total_len = t0_len + steps
-    kv_bytes = cfg.n_layers * batch * total_len * \
+    seg = tfm.DECODE_READ_SEG            # generate()'s segment size
+    read_sum = sum(min(total_len, (p // seg + 1) * seg)
+                   for p in range(t0_len, total_len - 1))
+    read_sum += total_len          # the prefill emit counts one full read
+    kv_bytes_total = cfg.n_layers * batch * read_sum * \
         cfg.kv_heads * cfg.head_dim * 2 * 2
-    bytes_per_step = 2 * n_params + kv_bytes
     hbm_peak = peak_hbm_bytes_per_chip()
-    implied = bytes_per_step * steps / dt
+    implied = (2 * n_params * steps + kv_bytes_total) / dt
     print(json.dumps({
         "metric": f"lm_decode_bs{batch}_tokens_per_sec_per_chip",
         "value": round(toks_per_s, 1),
