@@ -94,6 +94,9 @@ def bench_lm() -> None:
         mesh=MeshConfig(stage=pp, data=n_chips // pp),
         num_microbatches=int(os.environ.get("DMP_BENCH_MICRO", "1")),
         pipeline_schedule=os.environ.get("DMP_BENCH_SCHEDULE", "gpipe"),
+        # Interleaved virtual stages (1f1b only; DMP_BENCH_VS=2 on a
+        # multi-chip stage axis).
+        virtual_stages=int(os.environ.get("DMP_BENCH_VS", "1")),
         log_dir="/tmp/dmp_bench_log", checkpoint_dir="/tmp/dmp_bench_ckpt",
     )
     t = LMTrainer(cfg)
@@ -143,6 +146,8 @@ def bench_lm() -> None:
         # fraction (S-1)/(M+S-1) moves throughput ~2x across M.
         tag += (f"pp{cfg.mesh.stage}m{cfg.num_microbatches}_"
                 f"{cfg.pipeline_schedule}_")
+        if cfg.virtual_stages > 1:
+            tag += f"v{cfg.virtual_stages}_"
     out = {
         "metric": f"lm_{tag}seq{seq}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s_per_chip, 1),

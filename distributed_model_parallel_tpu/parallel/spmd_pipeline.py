@@ -124,8 +124,50 @@ def _spec_axes(ps: P) -> set[str]:
     return out
 
 
+def _interleave_perm(n_layers: int, S: int, V: int):
+    """Canonical→storage layer permutation for interleaved placement.
+
+    Chunk ``c = v*S + s`` (layers ``[c*Lc, (c+1)*Lc)`` of the canonical
+    stack) must live on device ``s``; stage sharding splits the stacked
+    arrays into S contiguous row groups, so device s's group has to hold
+    its V chunks back to back: storage row ``s*(V*Lc) + v*Lc + j`` =
+    canonical layer ``(v*S + s)*Lc + j``."""
+    import numpy as np
+
+    lc = n_layers // (S * V)
+    perm = np.empty(n_layers, np.int64)
+    for s in range(S):
+        for v in range(V):
+            for j in range(lc):
+                perm[s * V * lc + v * lc + j] = (v * S + s) * lc + j
+    return perm
+
+
+def interleave_block_rows(blocks, n_layers: int, S: int, V: int):
+    """Reorder every stacked-blocks leaf's leading (layer) dim from
+    canonical order into the interleaved storage order
+    ``make_1f1b_loss_and_grad(virtual_stages=V)`` expects. V=1 is a no-op."""
+    if V == 1:
+        return blocks
+    perm = _interleave_perm(n_layers, S, V)
+    return jax.tree.map(lambda leaf: leaf[perm], blocks)
+
+
+def deinterleave_block_rows(blocks, n_layers: int, S: int, V: int):
+    """Inverse of :func:`interleave_block_rows` (e.g. for exporting grads
+    or checkpoints back to canonical layer order)."""
+    if V == 1:
+        return blocks
+    import numpy as np
+
+    perm = _interleave_perm(n_layers, S, V)
+    inv = np.argsort(perm)
+    return jax.tree.map(lambda leaf: leaf[inv], blocks)
+
+
 def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
-                            num_microbatches: int) -> Callable:
+                            num_microbatches: int,
+                            virtual_stages: int = 1) -> Callable:
     """Hand-scheduled 1F1B: ``(params, tokens, targets) ->
     (loss, aux_stats, grads)`` as ONE shard_map program over the full mesh.
 
@@ -173,9 +215,41 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
     (``distributed_layers.py:17-26``, ``utils.py:59-63``) at the schedule
     level: same per-microbatch interleave PipeDream-flush runs per-process,
     expressed as one jitted SPMD program.
+
+    **Interleaved virtual stages** (``virtual_stages = V > 1``, Megatron
+    placement): the model splits into ``D = V*S`` chunks, device ``s``
+    owning chunks ``s, S+s, …`` — ``params["blocks"]`` rows must arrive in
+    the interleaved storage order (:func:`interleave_block_rows`). The
+    whole schedule generalizes through one mixed-radix decomposition: at
+    forward fine tick ``ft``, device ``s`` computes ``u = ft - s`` →
+    ``(r, v, g) = (u mod S, (u//S) mod V, u // (S*V))``, i.e. chunk ``v``
+    of microbatch ``g*S + r`` (requires ``M % S == 0``, the Megatron
+    constraint). Both the within-chunk hop ``s→s+1`` and the wraparound
+    ``(S-1)→0`` (chunk v→v+1) are the SAME +1 modular ppermute — the ring
+    already wraps. The stash ring grows to ``2D-1`` slots (entry written
+    at fine tick τ is re-read 2ĉ ticks later, ĉ = chunk depth from the
+    end) and the steady state runs ``M*V`` fine ticks, each 1/V the work
+    of a V=1 tick: warmup+drain stay ``D-1`` fine ticks each, so the
+    bubble shrinks from ``(S-1)/(M+S-1)`` toward ``(S-1)/(V*M+D-1)`` of
+    the step — the Megatron interleaving payoff, with V=1 reducing to
+    exactly the schedule above.
     """
     S = spec.num_stages
+    V = virtual_stages
+    D = S * V
     M = num_microbatches
+    if V < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if V > 1:
+        if M % S:
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches divisible "
+                f"by the stage count: M={M}, S={S} (Megatron constraint "
+                f"— the microbatch groups cycle chunks in blocks of S)")
+        if cfg.n_layers % D:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must divide into D=V*S={D} "
+                f"equal chunks for interleaved placement")
     mesh = spec.mesh
     stage_axis = spec.stage_axis
     all_axes = tuple(mesh.axis_names)
@@ -209,10 +283,11 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
                     axes.append(a)
         return tuple(a for a in axes if mesh.shape[a] > 1)
 
-    # Stash ring: stage s's input written at forward tick t is re-read at
-    # global tick t + 2(S-1) - 2s, so 2S-1 slots guarantee no collision
-    # (max live span, at stage 0). Never more slots than forward ticks.
-    K = min(2 * S - 1, M + S - 1)
+    # Stash ring: the chunk input written at forward fine tick τ is re-read
+    # 2ĉ ticks later (ĉ = chunk depth from the pipeline end, max D-1), so
+    # 2D-1 slots guarantee no collision — one write per tick, each entry
+    # live < 2D-1 ticks. Never more slots than forward ticks.
+    K = min(2 * D - 1, M * V + D - 1)
 
     def _head_nll_sum(head_p: dict, x: jax.Array,
                       targets: jax.Array) -> jax.Array:
@@ -251,8 +326,22 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
             x = x + pos[None]
         return x
 
-    def _blocks_fwd(blocks_local, x):
-        return tfm.blocks_scan(blocks_local, x, cfg)
+    lc_local = cfg.n_layers // D        # layers per chunk (== local/V)
+
+    def _chunk_fwd(blocks_local, v, x):
+        """Chunk ``v``'s blocks (rows [v*lc, (v+1)*lc) of this device's
+        interleaved-layout stack). V=1: the whole local stack (no slice —
+        keeps the V=1 program byte-identical to previous rounds)."""
+        if V == 1:
+            return tfm.blocks_scan(blocks_local, x, cfg)
+        chunk = jax.tree.map(
+            lambda leaf: jax.lax.dynamic_slice_in_dim(
+                leaf, v * lc_local, lc_local, 0), blocks_local)
+        # aux is a per-layer mean over the chunk's lc layers; weight by
+        # 1/V so the V chunk executions sum to this device's per-stage
+        # mean, keeping the V=1 normalization (and cotangent) unchanged.
+        y, aux = tfm.blocks_scan(chunk, x, cfg)
+        return y, aux / V
 
     def fwd_bwd(params, tokens, targets):
         s = jax.lax.axis_index(stage_axis)
@@ -292,40 +381,59 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
             return jax.tree.map(lambda g: jnp.where(keep, g, 0), tree)
 
         def fwd_slot(ft, state_f, stash, aux_sum):
-            """Forward tick ``ft`` (static int or traced scalar): stage 0
-            injects microbatch ft (masked), every stage stashes its input
-            and advances its blocks. Returns the POST-block state (the fwd
-            ppermute happens at the caller, after the head slot reads it)."""
-            idx = jnp.clip(jnp.asarray(ft), 0, M - 1)
-            toks_i = jax.lax.dynamic_index_in_dim(toks_mb, idx, 0,
-                                                  keepdims=False)
-            inject = jnp.logical_and(jnp.asarray(ft) < M, s == 0)
+            """Forward fine tick ``ft`` (static int or traced scalar):
+            device s decodes ``u = ft - s`` into (r, v, g) — chunk v of
+            microbatch g*S+r — injects at (s==0, v==0), stashes its chunk
+            input, and advances chunk v's blocks. Returns the POST-chunk
+            state (the fwd ppermute happens at the caller, after the head
+            slot reads it). V=1 reduces to: inject iff ft<M at stage 0,
+            run the whole local stack."""
+            u = jnp.asarray(ft) - s
+            v = jnp.mod(u // S, V)
+            m = (u // D) * S + jnp.mod(u, S)
+            real_f = jnp.logical_and(u >= 0, jnp.logical_and(m >= 0, m < M))
+            toks_i = jax.lax.dynamic_index_in_dim(
+                toks_mb, jnp.clip(m, 0, M - 1), 0, keepdims=False)
+            inject = jnp.logical_and(real_f,
+                                     jnp.logical_and(s == 0, v == 0))
             state_f = jnp.where(
                 inject, _embed_local(embed_p, toks_i).astype(cfg.dtype),
                 state_f)
             stash = jax.lax.dynamic_update_index_in_dim(
                 stash, state_f, jnp.mod(jnp.asarray(ft), K), 0)
-            state_f, aux = _blocks_fwd(blocks, state_f)
-            real_f = jnp.logical_and(jnp.asarray(ft) - s >= 0,
-                                     jnp.asarray(ft) - s < M)
+            state_f, aux = _chunk_fwd(blocks, v, state_f)
             aux_sum = aux_sum + jnp.where(real_f, aux, 0.0)
             return state_f, stash, aux_sum
 
         def bwd_slot(bt, dy, state_b, stash, g_blocks, g_embed):
-            """Backward tick ``bt``: stage s re-derives microbatch
-            bt - (S-1-s) from its stash slot and pulls the cotangent
-            through its blocks (and, at stage 0, into the embedding).
-            ``dy`` is the head cotangent seeding stage S-1 (None on drain
-            ticks, where the chain state carries everything)."""
+            """Backward fine tick ``bt``: device s decodes
+            ``û = bt - (S-1-s)`` into (r, q, g) — the q-th-from-last of
+            its chunks (chunk ``v = V-1-q``) for microbatch g*S+r —
+            re-derives that chunk's input from the stash and pulls the
+            cotangent through it (and, at the pipeline head — s==0 with
+            the chunk-0 execution — into the embedding). ``dy`` is the
+            head cotangent seeding stage S-1's chunk-(V-1) executions
+            (None on drain ticks, where the chain state carries
+            everything)."""
+            u_b = jnp.asarray(bt) - (S - 1 - s)
+            q = jnp.mod(u_b // S, V)
+            v_b = V - 1 - q
+            m_b = (u_b // D) * S + jnp.mod(u_b, S)
+            real_b = jnp.logical_and(u_b >= 0,
+                                     jnp.logical_and(m_b >= 0, m_b < M))
             cot_in = state_b
             if dy is not None:
-                cot_in = jnp.where(s == S - 1, dy, cot_in)
-            real_b = jnp.logical_and(jnp.asarray(bt) - (S - 1 - s) >= 0,
-                                     jnp.asarray(bt) - (S - 1 - s) < M)
-            slot = jnp.mod(jnp.asarray(bt) + 2 * s - (S - 1), K)
+                cot_in = jnp.where(
+                    jnp.logical_and(s == S - 1, q == 0), dy, cot_in)
+            # Stash entry for this execution was written 2ĉ fine ticks
+            # before its backward runs (ĉ = q*S + S-1-s, chunk depth from
+            # the end); the write tick was bt + (D-1) - 2ĉ.
+            c_hat = q * S + (S - 1 - s)
+            slot = jnp.mod(jnp.asarray(bt) + (D - 1) - 2 * c_hat, K)
             x_in = jax.lax.dynamic_index_in_dim(stash, slot, axis=0,
                                                 keepdims=False)
-            _, stage_vjp = jax.vjp(_blocks_fwd, blocks, x_in)
+            _, stage_vjp = jax.vjp(
+                lambda bl, x: _chunk_fwd(bl, v_b, x), blocks, x_in)
             # All grads are accumulated in SUM units and divided by
             # n_total once at the end, so the aux cotangent (whose true
             # per-stat scale is weight / (M * d_all)) pre-multiplies by
@@ -337,49 +445,58 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
             g_blocks = jax.tree.map(
                 jnp.add, g_blocks, mask_tree(g_b, real_b))
 
-            # Stage 0 finished a microbatch's block backward: fold its
-            # cotangent into the embedding (recomputed vjp — a gather).
-            m0 = jnp.asarray(bt) - (S - 1)
+            # The pipeline head (device 0's chunk-0 execution, q == V-1)
+            # finished a microbatch's block backward: fold its cotangent
+            # into the embedding (recomputed vjp — a gather).
             toks_0 = jax.lax.dynamic_index_in_dim(
-                toks_mb, jnp.clip(m0, 0, M - 1), 0, keepdims=False)
+                toks_mb, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
             _, emb_vjp = jax.vjp(
                 lambda ep: _embed_local(ep, toks_0).astype(cfg.dtype),
                 embed_p)
             g_e, = emb_vjp(dx)
+            emb_real = jnp.logical_and(
+                real_b, jnp.logical_and(s == 0, q == V - 1))
             g_embed = jax.tree.map(
-                jnp.add, g_embed,
-                mask_tree(g_e, jnp.logical_and(m0 >= 0, s == 0)))
+                jnp.add, g_embed, mask_tree(g_e, emb_real))
 
             state_b = dx.astype(cfg.dtype)
             if S > 1:
                 state_b = jax.lax.ppermute(state_b, stage_axis, perm_bwd)
             return state_b, g_blocks, g_embed
 
-        # ---- warmup: forward-only ticks 0 .. S-2 (unrolled; S-1 ticks).
-        for ft in range(S - 1):
+        # ---- warmup: forward-only fine ticks 0 .. D-2 (unrolled).
+        for ft in range(D - 1):
             state_f, stash, aux_sum = fwd_slot(ft, state_f, stash, aux_sum)
             if S > 1:
                 state_f = jax.lax.ppermute(state_f, stage_axis, perm_fwd)
 
-        # ---- steady state: M ticks, each a full forward slot + head loss
-        # + backward slot. A lax.scan so one tick's transients are the
-        # whole transient footprint (see docstring).
+        # ---- steady state: M*V fine ticks, each a full forward slot +
+        # head slot + backward slot. A lax.scan so one tick's transients
+        # are the whole transient footprint (see docstring).
         def steady_tick(carry, i):
             (state_f, state_b, stash, loss_acc, aux_sum, g_blocks, g_head,
              g_embed) = carry
-            ft = i + (S - 1)              # fwd tick; emit index = bwd tick = i
+            ft = i + (D - 1)          # fwd fine tick; bwd fine tick = i
             state_f, stash, aux_sum = fwd_slot(ft, state_f, stash, aux_sum)
 
-            # head slot: stage S-1 just finished microbatch i.
-            tgt_i = jax.lax.dynamic_index_in_dim(tgts_mb, i, 0,
-                                                 keepdims=False)
+            # head slot: real when stage S-1 just ran a LAST-chunk
+            # (v == V-1) execution of a real microbatch — that microbatch's
+            # forward is complete and its loss seeds this tick's backward.
+            u_l = jnp.asarray(ft) - (S - 1)
+            m_head = (u_l // D) * S + jnp.mod(u_l, S)
+            head_real = jnp.logical_and(
+                s == S - 1,
+                jnp.logical_and(jnp.mod(u_l // S, V) == V - 1,
+                                jnp.logical_and(m_head >= 0, m_head < M)))
+            tgt_i = jax.lax.dynamic_index_in_dim(
+                tgts_mb, jnp.clip(m_head, 0, M - 1), 0, keepdims=False)
             nll, head_vjp = jax.vjp(
                 lambda hp, x: _head_nll_sum(hp, x, tgt_i), head_p, state_f)
-            is_last = s == S - 1
-            loss_acc = loss_acc + jnp.where(is_last, nll, 0.0)
+            loss_acc = loss_acc + jnp.where(head_real, nll, 0.0)
             g_h, dy = head_vjp(jnp.ones((), jnp.float32))
-            g_head = jax.tree.map(jnp.add, g_head, mask_tree(g_h, is_last))
-            dy = jnp.where(is_last, dy * cot_scale,
+            g_head = jax.tree.map(jnp.add, g_head,
+                                  mask_tree(g_h, head_real))
+            dy = jnp.where(head_real, dy * cot_scale,
                            jnp.zeros_like(dy)).astype(cfg.dtype)
 
             state_b, g_blocks, g_embed = bwd_slot(
@@ -391,12 +508,12 @@ def make_1f1b_loss_and_grad(cfg: tfm.TransformerConfig, spec: MeshSpec,
 
         carry = (state_f, state_b, stash, loss_acc, aux_sum, g_blocks,
                  g_head, g_embed)
-        carry, _ = jax.lax.scan(steady_tick, carry, jnp.arange(M))
+        carry, _ = jax.lax.scan(steady_tick, carry, jnp.arange(M * V))
         (state_f, state_b, stash, loss_acc, aux_sum, g_blocks, g_head,
          g_embed) = carry
 
-        # ---- drain: backward-only ticks bt = M .. M+S-2 (unrolled).
-        for bt in range(M, M + S - 1):
+        # ---- drain: backward-only fine ticks bt = M*V .. M*V+D-2.
+        for bt in range(M * V, M * V + D - 1):
             state_b, g_blocks, g_embed = bwd_slot(
                 bt, None, state_b, stash, g_blocks, g_embed)
 
@@ -459,7 +576,8 @@ def _make_loss_fn(cfg: tfm.TransformerConfig, spec: MeshSpec,
 def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
                          tx: optax.GradientTransformation,
                          num_microbatches: int = 1,
-                         schedule: str = "gpipe") -> Callable:
+                         schedule: str = "gpipe",
+                         virtual_stages: int = 1) -> Callable:
     """One fully-jitted SPMD training step over the whole mesh.
 
     Covers dp (batch sharding + XLA grad allreduce), pp (shard_map pipeline),
@@ -483,7 +601,12 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
         return out
 
     if schedule == "1f1b":
-        loss_and_grad = make_1f1b_loss_and_grad(cfg, spec, num_microbatches)
+        # virtual_stages > 1: params["blocks"] must be in interleaved
+        # storage order (interleave_block_rows) for the step's lifetime —
+        # optimizer state follows rows, so training in that layout is
+        # self-consistent; deinterleave only for export.
+        loss_and_grad = make_1f1b_loss_and_grad(
+            cfg, spec, num_microbatches, virtual_stages=virtual_stages)
 
         def step(params, opt_state, tokens, targets):
             loss, aux, grads = loss_and_grad(params, tokens, targets)
@@ -491,6 +614,11 @@ def make_spmd_train_step(cfg: tfm.TransformerConfig, spec: MeshSpec,
             params = optax.apply_updates(params, updates)
             return params, opt_state, metrics_of(loss, aux)
     elif schedule == "gpipe":
+        if virtual_stages != 1:
+            raise ValueError(
+                "interleaved virtual stages are a 1f1b schedule feature "
+                "(gpipe's whole-program AD would gain nothing — no "
+                "silent ignores)")
         loss_fn = _make_loss_fn(cfg, spec, num_microbatches)
 
         def step(params, opt_state, tokens, targets):

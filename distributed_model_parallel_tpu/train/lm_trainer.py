@@ -62,6 +62,10 @@ class LMTrainConfig:
     # activation memory bounded by the stage count, not M —
     # parallel/spmd_pipeline.make_1f1b_loss_and_grad).
     pipeline_schedule: str = "gpipe"
+    # Megatron interleaved virtual stages (1f1b only): device s owns V
+    # model chunks; the trainer interleaves the block rows at init so the
+    # whole run (optimizer state included) lives in storage order.
+    virtual_stages: int = 1
     steps_per_epoch: int = 50
     epochs: int = 1
     n_tokens: int = 200_000
@@ -103,9 +107,18 @@ class LMTrainer:
         self._step = make_spmd_train_step(
             cfg, self.spec, self.tx,
             num_microbatches=config.num_microbatches,
-            schedule=config.pipeline_schedule)
+            schedule=config.pipeline_schedule,
+            virtual_stages=config.virtual_stages)
 
         host_params = tfm.init_params(jax.random.key(config.seed), cfg)
+        if config.virtual_stages > 1:
+            from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+                interleave_block_rows,
+            )
+
+            host_params["blocks"] = interleave_block_rows(
+                host_params["blocks"], cfg.n_layers, self.spec.num_stages,
+                config.virtual_stages)
         self.opt_state = jax.device_put(
             self.tx.init(host_params), NamedSharding(self.spec.mesh, P()))
         self.params = shard_params(host_params, cfg, self.spec)
@@ -198,22 +211,52 @@ class LMTrainer:
             chunk = self.tokens[idx]
             yield chunk[:, :-1], chunk[:, 1:]
 
+    def _canonical_params(self):
+        """Params with blocks in canonical layer order. Under interleaved
+        virtual stages the run's working layout is the interleaved storage
+        order; the GPipe-forward eval loss composes layers in row order,
+        so it must see the canonical stack (a layer-permuted model would
+        evaluate silently wrong)."""
+        if self.config.virtual_stages == 1:
+            return self.params
+        from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+            deinterleave_block_rows,
+        )
+
+        out = dict(self.params)
+        blocks_c = deinterleave_block_rows(
+            self.params["blocks"], self.cfg.n_layers, self.spec.num_stages,
+            self.config.virtual_stages)
+        # The row gather drops the NamedSharding; pin each leaf back to its
+        # working-layout sharding (same shapes, so specs carry over).
+        out["blocks"] = jax.tree.map(
+            lambda c, o: jax.device_put(c, o.sharding),
+            blocks_c, self.params["blocks"])
+        return out
+
     def evaluate(self) -> float:
         """Mean held-out loss over the fixed eval batches."""
         if self._eval_loss is None:
             raise ValueError("eval disabled (eval_batches=0 or "
                              "eval_fraction=0)")
         total, n = 0.0, 0
+        eval_params = self._canonical_params()
         for toks, tgts in self.eval_batches():
-            total += float(self._eval_loss(self.params, jnp.asarray(toks),
+            total += float(self._eval_loss(eval_params, jnp.asarray(toks),
                                            jnp.asarray(tgts)))
             n += 1
         return total / max(1, n)
 
     # ----------------------------------------------------------- checkpoint
     def _ckpt_tree(self):
+        # virtual_stages is part of the checkpoint identity: params AND
+        # optimizer state rows live in the interleaved storage order, so a
+        # resume under a different V would restore a layer-permuted model
+        # whose shapes all match — detectable only by this marker.
         return {"params": self.params, "opt_state": self.opt_state,
-                "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
+                "epoch": jnp.asarray(self.start_epoch, jnp.int32),
+                "virtual_stages": jnp.asarray(
+                    self.config.virtual_stages, jnp.int32)}
 
     def _resume(self):
         # Prefer whichever save is newest: the end-of-epoch "lm" slot or the
@@ -221,6 +264,16 @@ class LMTrainer:
         # must never supersede a full-epoch save under versioning.
         name = self.ckpt.newest_name(("lm", "lm-preempt")) or "lm"
         restored = self.ckpt.restore(self._ckpt_tree(), name)
+        ckpt_v = int(restored.get("virtual_stages", 1))
+        if ckpt_v != self.config.virtual_stages:
+            raise ValueError(
+                f"checkpoint was written with virtual_stages={ckpt_v} "
+                f"(blocks+opt-state rows in that interleaved storage "
+                f"order) but this run has virtual_stages="
+                f"{self.config.virtual_stages}; convert the blocks with "
+                f"parallel.spmd_pipeline.deinterleave_block_rows/"
+                f"interleave_block_rows (optimizer state rows too) or "
+                f"resume with the matching V")
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.start_epoch = int(restored["epoch"])

@@ -71,6 +71,11 @@ def parse_args():
                         "1f1b interleaves forward/backward so peak "
                         "activation memory is bounded by the stage count "
                         "(benchmarks/pipeline_memory.json)")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="Megatron interleaved virtual stages for the 1f1b "
+                        "schedule (device s owns V model chunks; bubble "
+                        "shrinks ~V-fold; microbatches must divide by the "
+                        "stage count)")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--epochs", type=int, default=1)
@@ -119,6 +124,7 @@ def main():
         batch_size=args.batch_size, seq_len=args.seq_len,
         num_microbatches=args.microbatches,
         pipeline_schedule=args.schedule,
+        virtual_stages=args.virtual_stages,
         steps_per_epoch=args.steps, epochs=args.epochs, resume=args.resume,
     )
     LMTrainer(config).fit()

@@ -108,3 +108,31 @@ def test_lm_eval_auto_degrades_when_tail_too_short(tmp_path):
     # Auto with a long enough tail keeps eval on.
     t2 = LMTrainer(_cfg(tmp_path))
     assert t2._n_eval_batches == 8 and t2._eval_loss is not None
+
+
+def test_lm_interleaved_matches_v1_and_evaluates(tmp_path):
+    # Same init, same stream: the V=2 interleaved 1F1B trainer must track
+    # the V=1 1F1B trainer's loss step for step (numerics are V-invariant),
+    # and evaluate() must score the CANONICAL layer order (a permuted
+    # eval would diverge wildly from train loss — the layout-leak guard).
+    kw = dict(num_microbatches=2, pipeline_schedule="1f1b",
+              eval_batches=2, epochs=1)
+    t1 = LMTrainer(_cfg(tmp_path / "v1", **kw))
+    r1 = t1.fit()
+    t2 = LMTrainer(_cfg(tmp_path / "v2", **kw, virtual_stages=2))
+    r2 = t2.fit()
+    np.testing.assert_allclose(r1[-1]["loss_train"], r2[-1]["loss_train"],
+                               rtol=2e-4)
+    np.testing.assert_allclose(r1[-1]["loss_val"], r2[-1]["loss_val"],
+                               rtol=2e-4)
+
+
+def test_lm_interleaved_resume_v_mismatch(tmp_path):
+    import pytest
+
+    kw = dict(num_microbatches=2, pipeline_schedule="1f1b", epochs=1,
+              eval_batches=0)
+    t2 = LMTrainer(_cfg(tmp_path, **kw, virtual_stages=2))
+    t2.fit()
+    with pytest.raises(ValueError, match="virtual_stages=2"):
+        LMTrainer(_cfg(tmp_path, **kw, virtual_stages=1, resume=True))
