@@ -257,6 +257,7 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
                           bn_momentum: float = 0.9,
                           init_params=None, init_state=None,
                           stage_dispatch: str = "switch",
+                          virtual_stages: int = 1,
                           dtype=jnp.float32) -> Callable:
     """Hand-scheduled 1F1B for the heterogeneous CNN pipeline:
     ``fwd_bwd(params, state, x, labels) -> (loss, logits, new_state, grads)``
@@ -264,12 +265,21 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
 
     Same schedule as the Transformer's ``make_1f1b_loss_and_grad``
     (parallel/spmd_pipeline.py — warmup / lax.scan steady state / drain,
-    stash ring of 2S-1 padded boundary buffers, backward recomputed from
+    stash ring of padded boundary buffers, backward recomputed from
     the stash), transplanted onto this module's heterogeneous machinery:
-    stage-indexed ``lax.switch`` dispatch, padded flat activation hops,
+    chunk-indexed ``lax.switch`` dispatch, padded flat activation hops,
     and per-tick BN state collection with the GPipe path's exact pooling.
     The memory story is the flat-in-M scan carry instead of GPipe's
     all-M-microbatches residual liveness (benchmarks/pipeline_memory.json).
+
+    ``virtual_stages = V > 1`` is the Megatron interleaved placement: the
+    model splits into ``D = V*S`` chunks, device ``s`` owning chunks
+    ``s, S+s, …`` — the same mixed-radix fine-tick schedule as the
+    Transformer engine (at forward tick ``ft`` device ``s`` decodes
+    ``u = ft - s`` into (rank, chunk, group); the (S-1)->0 chunk
+    wraparound rides the same +1 ppermute ring; requires ``M % S == 0``).
+    Unlike the Transformer engine no parameter relayout is needed —
+    params are replicated, so chunk c's units are just ``slices[c]``.
 
     Gradient bookkeeping is simpler than the Transformer's: params are
     replicated and the branches contain no collectives, so per-device
@@ -279,6 +289,8 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
     would double-count under that sum).
     """
     S = spec.num_stages
+    V = virtual_stages
+    D = S * V
     M = num_microbatches
     stage_axis = spec.stage_axis
     mesh = spec.mesh
@@ -287,15 +299,22 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
             raise ValueError(
                 f"cnn 1f1b supports data x stage meshes only; axis "
                 f"{ax!r} has size {mesh.shape[ax]}")
-    slices = stage_slices(model.num_units, S, boundaries)
-    owner = [s for s, (lo, hi) in enumerate(slices) for _ in range(lo, hi)]
+    if V < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches divisible by "
+            f"the stage count: M={M}, S={S} (Megatron constraint)")
+    slices = stage_slices(model.num_units, D, boundaries)
+    # Unit -> owning chunk; the owning DEVICE is chunk % S.
+    owner = [c for c, (lo, hi) in enumerate(slices) for _ in range(lo, hi)]
     if stage_dispatch not in ("switch", "masked"):
         raise ValueError(f"unknown stage_dispatch {stage_dispatch!r}; "
                          f"expected 'switch' or 'masked'")
     if init_params is None or init_state is None:
         init_params, init_state = model.init(
             jax.random.key(0), jnp.zeros((1, *sample_shape[1:]), dtype))
-    K = min(2 * S - 1, M + S - 1)
+    K = min(2 * D - 1, M * V + D - 1)
 
     def _flat(entry):
         return list(entry) if isinstance(entry, (tuple, list)) else [entry]
@@ -337,45 +356,57 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
 
         def stage_fn(params, state, x_local, lab_local):
             s = jax.lax.axis_index(stage_axis)
-            branches = [make_branch(si) for si in range(S)]
+            branches = [make_branch(si) for si in range(D)]
             mb = x_local.reshape(M, mbs, *x_local.shape[1:])
             lab_mb = lab_local.reshape(M, mbs)
             perm_fwd = [(i, (i + 1) % S) for i in range(S)]
             perm_bwd = [(i, (i - 1) % S) for i in range(S)]
 
-            def dispatch(params_, buf):
+            def dispatch(params_, buf, c):
+                """Run chunk ``c``'s units (c = v*S + s; V=1: c = s)."""
                 if stage_dispatch == "switch":
-                    return jax.lax.switch(s, branches, params_, buf)
+                    return jax.lax.switch(c, branches, params_, buf)
                 outs = [br(params_, buf) for br in branches]
-                sel = lambda *leaves: jax.lax.select_n(s, *leaves)
+                sel = lambda *leaves: jax.lax.select_n(c, *leaves)
                 return (sel(*[o[0] for o in outs]),
                         jax.tree.map(sel, *[o[1] for o in outs]))
 
-            def buf_only(params_, buf):
-                return dispatch(params_, buf)[0]
+            def buf_only(params_, buf, c):
+                return dispatch(params_, buf, c)[0]
 
             def fwd_slot(ft, state_f, stash):
-                idx = jnp.clip(jnp.asarray(ft), 0, M - 1)
-                xmb = jax.lax.dynamic_index_in_dim(mb, idx, 0,
-                                                   keepdims=False)
-                inject = jnp.logical_and(jnp.asarray(ft) < M, s == 0)
+                u = jnp.asarray(ft) - s
+                v = jnp.mod(u // S, V)
+                m = (u // D) * S + jnp.mod(u, S)
+                real_f = jnp.logical_and(
+                    u >= 0, jnp.logical_and(m >= 0, m < M))
+                xmb = jax.lax.dynamic_index_in_dim(
+                    mb, jnp.clip(m, 0, M - 1), 0, keepdims=False)
+                inject = jnp.logical_and(
+                    real_f, jnp.logical_and(s == 0, v == 0))
                 state_f = jnp.where(inject, pack(xmb), state_f)
                 stash = jax.lax.dynamic_update_index_in_dim(
                     stash, state_f, jnp.mod(jnp.asarray(ft), K), 0)
-                state_f, tick_state = dispatch(params, state_f)
+                state_f, tick_state = dispatch(params, state_f, v * S + s)
                 return state_f, stash, tick_state
 
             def bwd_slot(bt, dy, state_b, stash, g_params):
+                u_b = jnp.asarray(bt) - (S - 1 - s)
+                q = jnp.mod(u_b // S, V)
+                m_b = (u_b // D) * S + jnp.mod(u_b, S)
+                real_b = jnp.logical_and(
+                    u_b >= 0, jnp.logical_and(m_b >= 0, m_b < M))
                 cot_in = state_b
                 if dy is not None:
-                    cot_in = jnp.where(s == S - 1, dy, cot_in)
-                real_b = jnp.logical_and(
-                    jnp.asarray(bt) - (S - 1 - s) >= 0,
-                    jnp.asarray(bt) - (S - 1 - s) < M)
-                slot = jnp.mod(jnp.asarray(bt) + 2 * s - (S - 1), K)
+                    cot_in = jnp.where(
+                        jnp.logical_and(s == S - 1, q == 0), dy, cot_in)
+                c_hat = q * S + (S - 1 - s)
+                slot = jnp.mod(jnp.asarray(bt) + (D - 1) - 2 * c_hat, K)
                 x_in = jax.lax.dynamic_index_in_dim(stash, slot, axis=0,
                                                     keepdims=False)
-                _, stage_vjp = jax.vjp(buf_only, params, x_in)
+                c_b = (V - 1 - q) * S + s
+                _, stage_vjp = jax.vjp(
+                    lambda p_, b_: buf_only(p_, b_, c_b), params, x_in)
                 g_p, dbuf = stage_vjp(cot_in)
                 g_params = jax.tree.map(
                     lambda g, d: g + jnp.where(real_b, d, 0),
@@ -393,7 +424,7 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
             g_params = jax.tree.map(jnp.zeros_like, params)
 
             warm_states = []
-            for ft in range(S - 1):
+            for ft in range(D - 1):
                 state_f, stash, tick_state = fwd_slot(ft, state_f, stash)
                 warm_states.append(tick_state)
                 if S > 1:
@@ -402,10 +433,19 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
 
             def steady_tick(carry, i):
                 state_f, state_b, stash, loss_acc, g_params = carry
-                state_f, stash, tick_state = fwd_slot(i + (S - 1), state_f,
-                                                      stash)
-                lab_i = jax.lax.dynamic_index_in_dim(lab_mb, i, 0,
-                                                     keepdims=False)
+                ft = i + (D - 1)
+                state_f, stash, tick_state = fwd_slot(ft, state_f, stash)
+                # Head: real when the last device just ran a LAST-chunk
+                # (v == V-1) execution of a real microbatch.
+                u_l = jnp.asarray(ft) - (S - 1)
+                m_head = (u_l // D) * S + jnp.mod(u_l, S)
+                head_real = jnp.logical_and(
+                    s == S - 1,
+                    jnp.logical_and(jnp.mod(u_l // S, V) == V - 1,
+                                    jnp.logical_and(m_head >= 0,
+                                                    m_head < M)))
+                lab_i = jax.lax.dynamic_index_in_dim(
+                    lab_mb, jnp.clip(m_head, 0, M - 1), 0, keepdims=False)
 
                 def head(buf):
                     logits = buf[:, :feat_sizes[-1]].reshape(out_shape)
@@ -415,31 +455,33 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
 
                 nll, head_vjp, logits_i = jax.vjp(head, state_f,
                                                   has_aux=True)
-                is_last = s == S - 1
-                loss_acc = loss_acc + jnp.where(is_last, nll, 0.0)
+                loss_acc = loss_acc + jnp.where(head_real, nll, 0.0)
                 dbuf, = head_vjp(jnp.ones((), jnp.float32))
-                dy = jnp.where(is_last, dbuf, jnp.zeros_like(dbuf))
+                dy = jnp.where(head_real, dbuf, jnp.zeros_like(dbuf))
                 state_b, g_params = bwd_slot(i, dy, state_b, stash,
                                              g_params)
                 if S > 1:
                     state_f = jax.lax.ppermute(state_f, stage_axis,
                                                perm_fwd)
                 return ((state_f, state_b, stash, loss_acc, g_params),
-                        (tick_state, logits_i))
+                        (tick_state, jnp.where(head_real, logits_i,
+                                               jnp.zeros_like(logits_i))))
 
             carry = (state_f, state_b, stash, loss_acc, g_params)
             carry, (steady_states, logits_all) = jax.lax.scan(
-                steady_tick, carry, jnp.arange(M))
+                steady_tick, carry, jnp.arange(M * V))
             state_f, state_b, stash, loss_acc, g_params = carry
 
-            for bt in range(M, M + S - 1):
+            for bt in range(M * V, M * V + D - 1):
                 state_b, g_params = bwd_slot(bt, None, state_b, stash,
                                              g_params)
 
-            # BN pooling — identical to the GPipe path: stack all M+S-1
-            # tick states in tick order, keep stage s's real window
-            # [s, s+M), pool microbatch-wise, keep each unit's pooled
-            # state from its owning stage.
+            # BN pooling — identical to the GPipe path: stack all tick
+            # states in tick order; chunk v*S+s's real executions of
+            # microbatch m land at fine tick s + (m%S) + (m//S)*S*V + v*S,
+            # so take one M-window per owned chunk, pool microbatch-wise,
+            # and keep each unit's pooled state from its owning chunk's
+            # device. V=1 reduces to the old [s, s+M) window.
             if warm_states:
                 warm_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
                                           *warm_states)
@@ -448,27 +490,36 @@ def make_cnn_1f1b_fwd_bwd(model: StagedModel, spec: MeshSpec, *,
                     warm_stack, steady_states)
             else:
                 stacked = steady_states
-            mine = jax.tree.map(
-                lambda leaf: jnp.take(leaf, s + jnp.arange(M), axis=0),
-                stacked)
-            micro = [jax.tree.map(lambda leaf, m=m: leaf[m], mine)
-                     for m in range(M)]
-            merged = merge_microbatch_bn_states(micro, momentum=bn_momentum)
+            m_off = ((jnp.arange(M) // S) * (S * V)
+                     + jnp.mod(jnp.arange(M), S))       # group stride
+            merged_per_v = []
+            for v in range(V):
+                idx_v = s + v * S + m_off               # [M] tick indices
+                mine = jax.tree.map(
+                    lambda leaf, iv=idx_v: jnp.take(leaf, iv, axis=0),
+                    stacked)
+                micro = [jax.tree.map(lambda leaf, m=m: leaf[m], mine)
+                         for m in range(M)]
+                merged_per_v.append(
+                    merge_microbatch_bn_states(micro, momentum=bn_momentum))
             new_state = tuple(
                 jax.tree.map(
                     lambda new, old, si=i: jax.lax.psum(
-                        jnp.where(s == owner[si], new,
+                        jnp.where(s == owner[si] % S, new,
                                   jnp.zeros_like(new)), stage_axis),
-                    merged[i], state[i])
+                    merged_per_v[owner[i] // S][i], state[i])
                 for i in range(model.num_units))
             if spec.num_data > 1:
                 new_state = _pool_bn_over_axis(new_state, spec.data_axis,
                                                bn_momentum)
 
-            # logits: [M, mbs, C] per tick, real only on the last stage.
+            # logits: steady tick (m//S)*S*V + m%S emitted microbatch m's
+            # logits (zero-masked off the head ticks); select the M real
+            # ticks in microbatch order, then fill across stages.
+            logits_sel = jnp.take(logits_all, m_off, axis=0)
             logits_out = jax.lax.psum(
-                jnp.where(s == S - 1, logits_all,
-                          jnp.zeros_like(logits_all)), stage_axis)
+                jnp.where(s == S - 1, logits_sel,
+                          jnp.zeros_like(logits_sel)), stage_axis)
             logits_out = logits_out.reshape(b_local, *out_shape[1:])
 
             loss = (jax.lax.psum(loss_acc, reduce_axes) if reduce_axes
@@ -498,6 +549,7 @@ def make_spmd_cnn_train_step(model: StagedModel, spec: MeshSpec,
                              resize_to: int | None = None,
                              stage_dispatch: str = "switch",
                              schedule: str = "gpipe",
+                             virtual_stages: int = 1,
                              dtype=jnp.float32) -> Callable:
     """One SPMD training step for a staged CNN pipelined over ``stage``.
 
@@ -528,13 +580,18 @@ def make_spmd_cnn_train_step(model: StagedModel, spec: MeshSpec,
             model, spec, sample_shape=sample_shape,
             num_microbatches=num_microbatches, boundaries=boundaries,
             bn_momentum=bn_momentum, stage_dispatch=stage_dispatch,
-            dtype=dtype)
+            virtual_stages=virtual_stages, dtype=dtype)
 
         def loss_and_grad(params, model_state, images, labels):
             loss, logits, new_state, grads = fwd_bwd(params, model_state,
                                                      images, labels)
             return loss, logits, new_state, grads
     elif schedule == "gpipe":
+        if virtual_stages != 1:
+            raise ValueError(
+                "interleaved virtual stages are a 1f1b schedule feature "
+                "(gpipe's whole-program AD would gain nothing — no "
+                "silent ignores)")
         pipeline = make_cnn_pipeline_apply(
             model, spec, sample_shape=sample_shape,
             num_microbatches=num_microbatches, boundaries=boundaries,

@@ -263,7 +263,15 @@ class LMTrainer:
         # dedicated "lm-preempt" slot — the partial-epoch preemption save
         # must never supersede a full-epoch save under versioning.
         name = self.ckpt.newest_name(("lm", "lm-preempt")) or "lm"
-        restored = self.ckpt.restore(self._ckpt_tree(), name)
+        try:
+            restored = self.ckpt.restore(self._ckpt_tree(), name)
+        except Exception:
+            # Pre-round-5 checkpoints lack the virtual_stages marker and
+            # orbax rejects a template with the extra leaf — retry with
+            # the legacy tree; absence of the marker means V=1.
+            legacy = {k: v for k, v in self._ckpt_tree().items()
+                      if k != "virtual_stages"}
+            restored = self.ckpt.restore(legacy, name)
         ckpt_v = int(restored.get("virtual_stages", 1))
         if ckpt_v != self.config.virtual_stages:
             raise ValueError(

@@ -383,12 +383,27 @@ class Trainer:
                     f"{config.pipeline_schedule!r} (interleaved is a "
                     f"single-controller PipelineRunner schedule — no "
                     f"silent ignores)")
-            if config.virtual_stages != 1:
+            if config.virtual_stages != 1 and \
+                    config.pipeline_schedule != "1f1b":
                 raise ValueError(
-                    "virtual stages are a single-controller "
-                    "PipelineRunner schedule; strategy='spmd_pipeline' "
-                    "runs one stage per device (no silent ignores)")
+                    "strategy='spmd_pipeline' supports interleaved "
+                    "virtual stages only under pipeline_schedule='1f1b' "
+                    "(spmd_cnn_pipeline.make_cnn_1f1b_fwd_bwd); gpipe's "
+                    "whole-program AD would gain nothing — no silent "
+                    "ignores")
             boundaries = config.stage_boundaries
+            # Under interleaved virtual stages the model splits into
+            # D = S*V CHUNKS, so boundaries (explicit or auto) are chunk
+            # boundaries — D+1 cut points, not S+1.
+            n_chunks = self.spec.num_stages * config.virtual_stages
+            if (boundaries is not None
+                    and len(boundaries) != n_chunks + 1):
+                raise ValueError(
+                    f"stage_boundaries has {len(boundaries)} cut points "
+                    f"but the pipeline splits into {n_chunks} chunks "
+                    f"({self.spec.num_stages} stages x "
+                    f"{config.virtual_stages} virtual) — provide "
+                    f"{n_chunks + 1}")
             if boundaries is None and config.auto_partition:
                 from distributed_model_parallel_tpu.parallel.auto_partition import (
                     auto_boundaries,
@@ -401,7 +416,7 @@ class Trainer:
                 boundaries = auto_boundaries(
                     self.model,
                     (micro, in_hw, in_hw, train_ds.images.shape[3]),
-                    self.spec.num_stages)
+                    n_chunks)
             self._state_sh = self._repl
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                                model_state=model_state,
@@ -422,7 +437,8 @@ class Trainer:
                     bn_momentum=config.model.bn_momentum,
                     augment=config.data.augment,
                     stage_dispatch=dispatch,
-                    schedule=config.pipeline_schedule, **kw),
+                    schedule=config.pipeline_schedule,
+                    virtual_stages=config.virtual_stages, **kw),
                 in_shardings=(self._state_sh, self._repl, self._batch_sh,
                               self._batch_sh),
                 out_shardings=(self._state_sh, self._repl),

@@ -59,6 +59,11 @@ def parse_args():
                         "(shapes restore either way, but a mismatched k "
                         "routes differently than the trained model)")
     p.add_argument("--checkpoint-dir", default="./checkpoint")
+    p.add_argument("--pp-stages", type=int, default=1,
+                   help="stage count of the TRAINING run — only needed to "
+                        "de-interleave a checkpoint trained with "
+                        "--virtual-stages > 1 (decode itself runs "
+                        "layer-stacked)")
     p.add_argument("--prompt", default="1,2,3",
                    help="comma-separated token ids (the LM trains on a "
                         "synthetic integer stream; there is no text "
@@ -107,6 +112,30 @@ def main():
                 f"model flags (--layers/--d-model/... must equal the "
                 f"training run's): {e}") from e
         params = restored["params"]
+        # A 1f1b run with interleaved virtual stages checkpoints its block
+        # rows in interleaved storage order (marker saved alongside) —
+        # composing them in row order here would run a layer-permuted
+        # model that generates garbage with no error. Convert back.
+        try:
+            v_marker = ckpt.restore_subtree(
+                {"virtual_stages": jnp.zeros((), jnp.int32)}, "lm")
+            ckpt_v = int(v_marker["virtual_stages"])
+        except Exception:
+            ckpt_v = 1                 # pre-marker checkpoint: always V=1
+        if ckpt_v > 1:
+            from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
+                deinterleave_block_rows,
+            )
+
+            if args.pp_stages < 2:
+                raise SystemExit(
+                    f"checkpoint was trained with virtual_stages={ckpt_v}; "
+                    f"pass --pp-stages equal to the training stage count "
+                    f"so the block rows can be de-interleaved")
+            params["blocks"] = deinterleave_block_rows(
+                params["blocks"], cfg.n_layers, args.pp_stages, ckpt_v)
+            print(f"de-interleaved blocks (virtual_stages={ckpt_v}, "
+                  f"S={args.pp_stages})", file=sys.stderr)
         print(f"restored LM checkpoint from {args.checkpoint_dir}",
               file=sys.stderr)
     else:

@@ -285,3 +285,60 @@ def test_dp_bn_stat_pooling_matches_big_batch():
                                rtol=1e-5)
     np.testing.assert_allclose(out[1], mu * o_var + (1 - mu) * big_var,
                                rtol=1e-5)
+
+
+def test_1f1b_interleaved_matches_gpipe_and_runner(batch):
+    """Interleaved virtual stages (V=2) in the SPMD CNN 1F1B engine
+    (VERDICT r4 weak #5): leaf-for-leaf parity against BOTH the SPMD
+    GPipe step and the single-controller PipelineRunner's interleaved
+    placement (virtual_stages=2, 1f1b dispatch order) — numerics are
+    V-invariant, so all three must agree on params, BN stats, and loss."""
+    images, labels = batch
+    model, tx, ts = _make()
+    a, ma = _spmd_step(model, tx, stage=2, microbatches=4,
+                       schedule="gpipe")(
+        ts, jax.random.key(9), images, labels)
+
+    _, _, ts2 = _make()
+    spec = make_mesh(MeshConfig(data=1, stage=2))
+    step_v2 = jax.jit(make_spmd_cnn_train_step(
+        model, spec, tx, sample_shape=(2, 32, 32, 3),
+        mean=CIFAR10_MEAN, std=CIFAR10_STD,
+        num_microbatches=4, augment=False, stage_dispatch="switch",
+        schedule="1f1b", virtual_stages=2))
+    b, mb = step_v2(ts2, jax.random.key(9), images, labels)
+    assert float(ma["loss"]) == pytest.approx(float(mb["loss"]), rel=1e-5)
+    _assert_tree_close(jax.device_get(a.params), jax.device_get(b.params))
+    _assert_tree_close(jax.device_get(a.model_state),
+                       jax.device_get(b.model_state))
+
+    runner = PipelineRunner(
+        model, jax.devices()[:2], tx=tx, rng=jax.random.key(0),
+        sample_shape=(2, 32, 32, 3), mean=CIFAR10_MEAN, std=CIFAR10_STD,
+        num_microbatches=4, augment=False, schedule="1f1b",
+        virtual_stages=2)
+    rm = runner.train_step(jax.random.key(9), images, labels)
+    assert float(mb["loss"]) == pytest.approx(float(rm["loss"]), rel=1e-5)
+    _assert_tree_close(jax.device_get(b.params), runner.merged_params())
+    _assert_tree_close(jax.device_get(b.model_state),
+                       runner.merged_model_state())
+
+
+def test_1f1b_interleaved_dp_x_pp(batch):
+    images, labels = batch
+    model, tx, ts = _make()
+    a, ma = _spmd_step(model, tx, data=2, stage=2, microbatches=2,
+                       schedule="gpipe")(
+        ts, jax.random.key(9), images, labels)
+    _, _, ts2 = _make()
+    spec = make_mesh(MeshConfig(data=2, stage=2))
+    step_v2 = jax.jit(make_spmd_cnn_train_step(
+        model, spec, tx, sample_shape=(2, 32, 32, 3),
+        mean=CIFAR10_MEAN, std=CIFAR10_STD,
+        num_microbatches=2, augment=False, stage_dispatch="switch",
+        schedule="1f1b", virtual_stages=2))
+    b, mb = step_v2(ts2, jax.random.key(9), images, labels)
+    assert float(ma["loss"]) == pytest.approx(float(mb["loss"]), rel=1e-5)
+    _assert_tree_close(jax.device_get(a.params), jax.device_get(b.params))
+    _assert_tree_close(jax.device_get(a.model_state),
+                       jax.device_get(b.model_state))
