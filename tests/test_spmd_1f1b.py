@@ -235,3 +235,13 @@ def test_unknown_schedule_rejected():
     spec = make_mesh(MeshConfig(stage=2))
     with pytest.raises(ValueError, match="unknown spmd pipeline schedule"):
         make_spmd_train_step(cfg, spec, optax.sgd(0.1), 2, schedule="pipedream")
+
+
+def test_1f1b_interleaved_v2_moe_ep():
+    # Interleaved chunks containing routed-MoE blocks with expert
+    # parallelism: the chunk slice must carry the expert-sharded leaves
+    # and the aux 1/V weighting must keep the balance/z stats in the
+    # V=1 normalization.
+    _parity_interleaved(dict(data=1, stage=2, expert=2),
+                        dict(moe_experts=4, moe_top_k=2,
+                             ep_axis="expert"), M=2, V=2, tol=5e-5)
