@@ -282,3 +282,37 @@ def category_totals(rows: Iterable[OpRow]) -> dict[str, float]:
     for r in rows:
         tot[r.category] += r.total_ps / 1e12
     return dict(sorted(tot.items(), key=lambda kv: -kv[1]))
+
+
+def main(argv=None) -> None:
+    """CLI: summarize a jax.profiler trace directory without TensorBoard.
+
+    ``python -m distributed_model_parallel_tpu.utils.xplane /tmp/trace``
+    prints the module executions, per-category device time, and the top
+    ops — the quick-look the reference's time.time() logging never had.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("trace_dir", help="directory passed to trace_to / "
+                                     "jax.profiler.start_trace")
+    p.add_argument("--top", type=int, default=15, help="top ops to print")
+    args = p.parse_args(argv)
+
+    plane = device_plane(load_xspace(args.trace_dir))
+    peaks = plane_peaks(plane)
+    mods = module_events(plane)
+    rows = exclude_envelopes(op_breakdown(plane))
+    print(f"device peaks: {peaks}")
+    mod_s = sum(m.duration_ps for m in mods) / 1e12
+    print(f"{len(mods)} module executions, {mod_s:.4f}s device time")
+    for cat, sec in category_totals(rows).items():
+        print(f"  {cat:24s} {sec * 1e3:10.2f} ms")
+    print(f"top {args.top} ops:")
+    for r in rows[:args.top]:
+        print(f"  {r.total_ps / 1e9:9.3f} ms x{r.count:6d} "
+              f"{r.category:18s} {r.name}")
+
+
+if __name__ == "__main__":   # pragma: no cover - thin CLI shell
+    main()
