@@ -20,6 +20,14 @@ long-context Transformer train step (DMP_BENCH_SEQ, default 8192;
 DMP_BENCH_REMAT=full|dots selects the block remat policy;
 DMP_BENCH_LOSS_CHUNK is the chunked cross-entropy head's chunk size in
 tokens, e.g. 8192 — 0 = dense head) measured in tokens/s/chip.
+
+Failure semantics: first device contact retries with backoff
+(DMP_BENCH_RETRIES, DMP_BENCH_RETRY_DELAY_S); a permanently unreachable
+backend prints ONE parseable JSON failure record
+(``{"error": "tpu-unreachable", ...}``) and exits 0 — never a traceback.
+Every run also appends a telemetry stream (utils/telemetry; DMP_TELEMETRY
+overrides the path, default /tmp/dmp_bench_log/bench_telemetry.jsonl) that
+``scripts/dmp_report.py`` renders.
 """
 
 from __future__ import annotations
@@ -40,25 +48,110 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def bench_lm() -> None:
-    """Long-context Transformer train-step bench (tokens/s/chip + MFU).
+def contact_devices(max_attempts: int | None = None,
+                    delay_s: float | None = None):
+    """First device contact, hardened: bounded retry with exponential
+    backoff, returning the device list or None after permanent failure.
 
-    The flagship long-context workload: flash-attention pallas kernels,
-    RoPE, causal LM loss, one full SPMD train step at DMP_BENCH_SEQ tokens
-    (default 8192 — the sequence length PARITY.md's kernel numbers quote).
+    The round-5 TPU-tunnel outage turned ``jax.devices()`` into a raw
+    ``JaxRuntimeError`` traceback the driver could not parse (VERDICT weak
+    #1). Transient tunnel drops are worth retrying; a permanently absent
+    backend must become a structured failure record (see ``_emit_failure``),
+    not a stack trace. Knobs: DMP_BENCH_RETRIES (default 5),
+    DMP_BENCH_RETRY_DELAY_S (default 2.0, doubling per attempt).
+    """
+    if max_attempts is None:
+        max_attempts = int(os.environ.get("DMP_BENCH_RETRIES", "5"))
+    if delay_s is None:
+        delay_s = float(os.environ.get("DMP_BENCH_RETRY_DELAY_S", "2.0"))
+    last: Exception | None = None
+    for attempt in range(max(1, max_attempts)):
+        try:
+            devs = jax.devices()
+            # A device listing can succeed while the transport is dead;
+            # prove liveness with one tiny round trip.
+            jnp.zeros(()).block_until_ready()
+            return devs
+        except Exception as e:      # noqa: BLE001 - anything here is fatal
+            last = e
+            first_line = (str(e).splitlines() or [""])[0][:200]
+            _log(f"device contact attempt {attempt + 1}/{max_attempts} "
+                 f"failed: {type(e).__name__}: {first_line}")
+            try:
+                # jax caches a failed backend init; clear so the retry
+                # actually re-dials instead of replaying the cached error.
+                from jax.extend import backend as _backend
+
+                _backend.clear_backends()
+            except Exception:
+                pass
+            if attempt < max_attempts - 1:
+                time.sleep(delay_s)
+                delay_s *= 2
+    contact_devices.last_error = last
+    return None
+
+
+def _emit_failure(stage: str, err: Exception | None, attempts: int) -> None:
+    """One parseable JSON failure record on stdout, rc=0 semantics: the
+    driver ingests ``{"error": "tpu-unreachable", ...}`` instead of a
+    traceback; ``value: null`` marks that no measurement exists. The same
+    failure also lands in the run's telemetry stream (best-effort — stream
+    I/O must never turn an outage report into a crash)."""
+    detail = f"{type(err).__name__}: {err}" if err is not None else ""
+    # stdout record FIRST: the driver must get the parseable line promptly;
+    # the telemetry append is bookkeeping after the fact.
+    print(json.dumps({
+        "error": "tpu-unreachable",
+        "stage": stage,
+        "attempts": attempts,
+        "detail": detail[:500],
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "ts": time.time(),
+        "metric": None,
+        "value": None,
+    }), flush=True)
+    try:
+        # device override: writing the header must not re-dial the dead
+        # backend (device_info() would re-init it — minutes under libtpu).
+        t = _telemetry_run("failure", dict(stage=stage),
+                           device={"error": detail[:200] or "unreachable"})
+        t.failure("tpu-unreachable", stage=stage, attempts=attempts,
+                  detail=detail[:500])
+        t.finish()
+    except Exception:
+        pass
+
+
+def _telemetry_run(workload: str, meta: dict, device: dict | None = None):
+    """Bench telemetry stream (utils/telemetry): DMP_TELEMETRY overrides
+    the path; the default lands next to the bench logs. ``device``
+    overrides the header's backend probe (the failure path must not
+    re-dial a dead backend)."""
+    from distributed_model_parallel_tpu.utils.telemetry import TelemetryRun
+
+    path = os.environ.get(
+        "DMP_TELEMETRY", "/tmp/dmp_bench_log/bench_telemetry.jsonl")
+    return TelemetryRun(path, run=f"bench-{workload}",
+                        meta=dict(workload=workload, **meta), device=device)
+
+
+def build_lm_bench():
+    """Long-context Transformer train-step workload, env-configured
+    (DMP_BENCH_SEQ/BATCH/MOE_EXPERTS/PP/...; module docstring).
+
+    Returns ``(trainer, step, info)`` where ``step()`` runs one train step
+    (mutating the trainer's params/opt_state) and returns the device
+    metrics, and ``info`` carries the static measurement identity (cfg,
+    batch, seq, moe, n_chips, steps, tag). Shared with
+    ``benchmarks/run_step_profile.py`` so the profiled program IS the
+    timed program by construction.
     """
     from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.models import transformer as tfm
     from distributed_model_parallel_tpu.train.lm_trainer import (
         LMTrainConfig,
         LMTrainer,
-    )
-    from distributed_model_parallel_tpu.utils.profiling import (
-        compiled_flops,
-        fetch,
-        fetch_overhead,
-        lm_model_flops,
-        peak_flops_per_chip,
     )
 
     n_chips = len(jax.devices())
@@ -110,6 +203,43 @@ def bench_lm() -> None:
                                            toks, tgts)
         return m
 
+    tag = f"moe{moe}x{cfg.model.moe_top_k}_" if moe else ""
+    if cfg.mesh.stage > 1:
+        # Microbatch count is part of the measurement identity: the bubble
+        # fraction (S-1)/(M+S-1) moves throughput ~2x across M.
+        tag += (f"pp{cfg.mesh.stage}m{cfg.num_microbatches}_"
+                f"{cfg.pipeline_schedule}_")
+        if cfg.virtual_stages > 1:
+            tag += f"v{cfg.virtual_stages}_"
+    info = dict(cfg=cfg, batch=batch, seq=seq, moe=moe, n_chips=n_chips,
+                steps=steps, tag=tag, step_args=(toks, tgts))
+    return t, step, info
+
+
+def bench_lm() -> None:
+    """Long-context Transformer train-step bench (tokens/s/chip + MFU).
+
+    The flagship long-context workload: flash-attention pallas kernels,
+    RoPE, causal LM loss, one full SPMD train step at DMP_BENCH_SEQ tokens
+    (default 8192 — the sequence length PARITY.md's kernel numbers quote).
+    """
+    from distributed_model_parallel_tpu.utils.profiling import (
+        compiled_flops,
+        fetch,
+        fetch_overhead,
+        lm_model_flops,
+        peak_flops_per_chip,
+    )
+
+    t, step, info = build_lm_bench()
+    cfg, batch, seq = info["cfg"], info["batch"], info["seq"]
+    moe, n_chips, steps = info["moe"], info["n_chips"], info["steps"]
+    toks, tgts = info["step_args"]
+    telemetry = _telemetry_run("lm", dict(
+        batch_size=batch, seq_len=seq, n_chips=n_chips,
+        tokens_per_step=batch * seq,
+        model_flops_per_step=lm_model_flops(cfg.model, batch, seq)))
+
     fetch(step())                       # compile + warm
     t_fetch = fetch_overhead()
     t0 = time.perf_counter()
@@ -140,14 +270,7 @@ def bench_lm() -> None:
     mfu = (round(flops / n_chips / dt / peak, 4)
            if flops and peak else None)
     tokens_per_s_per_chip = batch * seq / dt / n_chips
-    tag = f"moe{moe}x{cfg.model.moe_top_k}_" if moe else ""
-    if cfg.mesh.stage > 1:
-        # Microbatch count is part of the measurement identity: the bubble
-        # fraction (S-1)/(M+S-1) moves throughput ~2x across M.
-        tag += (f"pp{cfg.mesh.stage}m{cfg.num_microbatches}_"
-                f"{cfg.pipeline_schedule}_")
-        if cfg.virtual_stages > 1:
-            tag += f"v{cfg.virtual_stages}_"
+    tag = info["tag"]
     out = {
         "metric": f"lm_{tag}seq{seq}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s_per_chip, 1),
@@ -157,7 +280,32 @@ def bench_lm() -> None:
     }
     if moe:
         out["moe_drop_rate"] = round(float(m["moe_drop"]), 4)
+    telemetry.step(step=0, step_time_s=dt,
+                   tokens_per_s=batch * seq / dt, mfu=mfu)
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    telemetry.finish()
     print(json.dumps(out))
+
+
+def build_decode_bench():
+    """KV-cache greedy-decode workload, env-configured (DMP_BENCH_BATCH/
+    PROMPT/GEN). Returns ``(gen, gen_args, info)``: ``gen(*gen_args)``
+    runs one prompt+decode program. Shared with the step profiler."""
+    from distributed_model_parallel_tpu.models import transformer as tfm
+
+    batch = int(os.environ.get("DMP_BENCH_BATCH", "8"))
+    t0_len = int(os.environ.get("DMP_BENCH_PROMPT", "128"))
+    steps = int(os.environ.get("DMP_BENCH_GEN", "512"))
+    cfg = tfm.TransformerConfig(
+        vocab_size=32_000, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+        max_seq_len=t0_len + steps, pos_embedding="rope",
+        dtype=jnp.bfloat16)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    prompt = jnp.zeros((batch, t0_len), jnp.int32)
+    gen = jax.jit(lambda p, pr: tfm.generate(p, cfg, pr, steps))
+    info = dict(cfg=cfg, batch=batch, prompt_len=t0_len, gen_steps=steps)
+    return gen, (params, prompt), info
 
 
 def bench_decode() -> None:
@@ -176,16 +324,11 @@ def bench_decode() -> None:
         peak_hbm_bytes_per_chip,
     )
 
-    batch = int(os.environ.get("DMP_BENCH_BATCH", "8"))
-    t0_len = int(os.environ.get("DMP_BENCH_PROMPT", "128"))
-    steps = int(os.environ.get("DMP_BENCH_GEN", "512"))
-    cfg = tfm.TransformerConfig(
-        vocab_size=32_000, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
-        max_seq_len=t0_len + steps, pos_embedding="rope",
-        dtype=jnp.bfloat16)
-    params = tfm.init_params(jax.random.key(0), cfg)
-    prompt = jnp.zeros((batch, t0_len), jnp.int32)
-    gen = jax.jit(lambda p, pr: tfm.generate(p, cfg, pr, steps))
+    gen, (params, prompt), info = build_decode_bench()
+    cfg, batch = info["cfg"], info["batch"]
+    t0_len, steps = info["prompt_len"], info["gen_steps"]
+    telemetry = _telemetry_run("decode", dict(
+        batch_size=batch, prompt_len=t0_len, gen_steps=steps))
     _log(f"decode bench: batch={batch} prompt={t0_len} gen={steps}")
     fetch(gen(params, prompt))          # compile + warm
     t_fetch = fetch_overhead()
@@ -209,7 +352,7 @@ def bench_decode() -> None:
         cfg.kv_heads * cfg.head_dim * 2 * 2
     hbm_peak = peak_hbm_bytes_per_chip()
     implied = (2 * n_params * steps + kv_bytes_total) / dt
-    print(json.dumps({
+    out = {
         "metric": f"lm_decode_bs{batch}_tokens_per_sec_per_chip",
         "value": round(toks_per_s, 1),
         "unit": "tokens/s/chip",
@@ -220,7 +363,13 @@ def bench_decode() -> None:
         "demand_gbs": round(implied / 1e9, 1),
         "demand_frac_of_peak": (round(implied / hbm_peak, 3)
                                 if hbm_peak else None),
-    }))
+    }
+    telemetry.step(step=0, step_time_s=dt / max(1, steps),
+                   tokens_per_s=toks_per_s)
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    telemetry.finish()
+    print(json.dumps(out))
 
 
 def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int,
@@ -290,19 +439,25 @@ def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int,
 
 
 def main() -> None:
+    # First device contact, hardened (VERDICT weak #1): bounded retry with
+    # backoff; on permanent failure emit one parseable JSON failure record
+    # with rc=0 semantics instead of a JaxRuntimeError traceback.
+    t_start = time.perf_counter()
+    devs = contact_devices()
+    if devs is None:
+        _emit_failure("device-contact",
+                      getattr(contact_devices, "last_error", None),
+                      int(os.environ.get("DMP_BENCH_RETRIES", "5")))
+        return
+    _log(f"devices: {devs}")
+    _log(f"device ready after {time.perf_counter() - t_start:.1f}s")
+
     if os.environ.get("DMP_BENCH_WORKLOAD") == "lm":
         bench_lm()
         return
     if os.environ.get("DMP_BENCH_WORKLOAD") == "decode":
         bench_decode()
         return
-
-    t_start = time.perf_counter()
-    _log(f"devices: {jax.devices()}")
-    # Touch the device first so tunnel/bring-up cost is visible separately
-    # from model compile time.
-    jnp.ones((8, 8)).block_until_ready()
-    _log(f"device ready after {time.perf_counter() - t_start:.1f}s")
 
     n_chips = len(jax.devices())
     batch = int(os.environ.get("DMP_BENCH_BATCH", "512"))
@@ -314,6 +469,9 @@ def main() -> None:
     # DMP_BENCH_IMG=224 benches the compute-bound native-resolution
     # workload (on-device 32->224 upsample + ImageNet stride table).
     image_size = int(os.environ.get("DMP_BENCH_IMG", "32"))
+    telemetry = _telemetry_run("cnn", dict(
+        model=model_name, batch_size=batch, image_size=image_size,
+        steps_per_dispatch=steps_per_dispatch, n_chips=n_chips))
     trainer, dispatch = build_cnn_bench(model_name, batch,
                                         steps_per_dispatch, image_size)
 
@@ -415,6 +573,16 @@ def main() -> None:
     # don't claim measured saturation for other models/batches.
     if model_name == "mobilenetv2" and batch == 512 and image_size == 32:
         out["hbm_saturation_measured"] = "benchmarks/step_profile_r5.json"
+    telemetry.step(step=0, step_time_s=dt,
+                   samples_per_s=batch / dt, mfu=mfu)
+    if flops:
+        # Per-device cost-analysis FLOPs: the report CLI divides by one
+        # chip's peak directly (meta key name marks the normalization).
+        telemetry.record("cost_analysis", device_flops_per_step=flops,
+                         bytes_accessed_per_step=bytes_step)
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    telemetry.finish()
     print(json.dumps(out))
 
 

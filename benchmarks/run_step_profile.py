@@ -1,18 +1,32 @@
-"""Hardware-profiler breakdown of the headline CNN train step.
+"""Hardware-profiler breakdown of a dispatched train/decode program.
 
 VERDICT r4 weak #1: the >1.0 demand-side ``hbm_frac_of_peak`` is not a
 saturation measurement. This runner captures a REAL ``jax.profiler`` trace
-of the bs-512 MobileNetV2 dispatched program (the exact workload bench.py
-times), parses the device plane (utils/xplane.py), and commits:
+of a dispatched program (the exact workload bench.py times — shared
+builders, not a copy), parses the device plane (utils/xplane.py), and
+commits:
 
 * device-busy fraction (module device time / wall time between modules)
 * per-category device-time breakdown (conv-fusions vs elementwise vs copies)
 * top-N individual ops with device microseconds
 * the profiler's own device peaks (TFLOP/s, HBM GB/s)
 
-Writes benchmarks/step_profile_r5.json. Run ON CHIP:
+Workload entry list (DMP_PROFILE_WORKLOAD, default ``cnn``):
+
+* ``cnn``    — bs-512 MobileNetV2 multi-step dispatch (bench.py main);
+               writes benchmarks/step_profile_r5.json (historical path)
+* ``lm``     — the long-context Transformer train step (bench.build_lm_bench;
+               DMP_BENCH_SEQ/BATCH/... apply)
+* ``moe``    — same, with every FFN a routed MoE (DMP_BENCH_MOE_EXPERTS,
+               default 8 here)
+* ``decode`` — the KV-cache greedy decode program (bench.build_decode_bench)
+
+Non-cnn workloads write benchmarks/step_profile_<workload>.json. Each run
+also appends a telemetry record (utils/telemetry; DMP_TELEMETRY overrides
+the stream path). Run ON CHIP:
   python benchmarks/run_step_profile.py            # mobilenetv2 bs512
   DMP_BENCH_MODEL=resnet50 python benchmarks/run_step_profile.py
+  DMP_PROFILE_WORKLOAD=lm DMP_BENCH_SEQ=8192 python benchmarks/run_step_profile.py
 """
 
 from __future__ import annotations
@@ -103,13 +117,64 @@ def _op_roofline(rows, n_steps: int, hbm_peak_gbs: float | None) -> dict:
     }
 
 
+def _build_workload(workload: str):
+    """Entry list: (dispatch, steps_per_dispatch, hlo_fn, tag). The
+    builders are bench.py's own, so the profiled program IS the timed
+    program (shared construction, not a copy)."""
+    if workload == "cnn":
+        model_name = os.environ.get("DMP_BENCH_MODEL", "mobilenetv2")
+        batch = int(os.environ.get("DMP_BENCH_BATCH", "512"))
+        spd = int(os.environ.get("DMP_BENCH_SPD", "10"))
+        trainer, dispatch = build_cnn_bench(model_name, batch, spd)
+
+        def hlo():
+            sub = jax.random.key(1)
+            idx = jnp.zeros((spd, batch), jnp.int64)
+            return trainer._multi_step.lower(
+                trainer.state, sub, trainer._dev_images,
+                trainer._dev_labels, idx).compile().as_text()
+
+        return (dispatch, spd, batch, "samples", hlo,
+                f"{model_name}_bs{batch}_spd{spd}")
+
+    if workload in ("lm", "moe"):
+        if workload == "moe" and not os.environ.get("DMP_BENCH_MOE_EXPERTS"):
+            os.environ["DMP_BENCH_MOE_EXPERTS"] = "8"
+        from bench import build_lm_bench
+
+        t, step, info = build_lm_bench()
+        toks, tgts = info["step_args"]
+
+        def hlo():
+            return t._step.lower(t.params, t.opt_state, toks,
+                                 tgts).compile().as_text()
+
+        return (step, 1, info["batch"] * info["seq"], "tokens", hlo,
+                f"lm_{info['tag']}seq{info['seq']}_bs{info['batch']}")
+
+    if workload == "decode":
+        from bench import build_decode_bench
+
+        gen, gen_args, info = build_decode_bench()
+
+        def hlo():
+            return gen.lower(*gen_args).compile().as_text()
+
+        # One dispatched program generates gen_steps tokens: per-"step"
+        # numbers below are per decoded token.
+        return (lambda: gen(*gen_args), info["gen_steps"], info["batch"],
+                "tokens", hlo,
+                f"decode_bs{info['batch']}p{info['prompt_len']}"
+                f"g{info['gen_steps']}")
+
+    raise SystemExit(f"unknown DMP_PROFILE_WORKLOAD={workload!r} "
+                     f"(entry list: cnn, lm, moe, decode)")
+
+
 def main() -> None:
-    model_name = os.environ.get("DMP_BENCH_MODEL", "mobilenetv2")
-    batch = int(os.environ.get("DMP_BENCH_BATCH", "512"))
-    spd = int(os.environ.get("DMP_BENCH_SPD", "10"))
-    # Same builder as bench.py main(): the profiled program IS the timed
-    # program (shared construction, not a copy).
-    trainer, dispatch = build_cnn_bench(model_name, batch, spd)
+    workload = os.environ.get("DMP_PROFILE_WORKLOAD", "cnn")
+    dispatch, spd, units_per_step, unit, hlo_fn, tag = (
+        _build_workload(workload))
 
     for _ in range(2):                      # compile + warm
         fetch(dispatch())
@@ -125,11 +190,7 @@ def main() -> None:
     wall = time.perf_counter() - t0
 
     # Optimized HLO of the dispatched program, to attribute fusions.
-    sub = jax.random.key(1)
-    idx = jnp.zeros((spd, batch), jnp.int64)
-    hlo_text = trainer._multi_step.lower(
-        trainer.state, sub, trainer._dev_images, trainer._dev_labels,
-        idx).compile().as_text()
+    hlo_text = hlo_fn()
 
     space = xplane.load_xspace(TRACE_DIR)
     plane = xplane.device_plane(space)
@@ -157,7 +218,7 @@ def main() -> None:
             for a, b in zip(main_mods, main_mods[1:])]
     op_total_s = sum(r.total_ps for r in rows) / 1e12
 
-    samples_per_s_device = batch / device_s_per_step
+    units_per_s_device = units_per_step / device_s_per_step
 
     top = [{
         "op": r.name, "category": r.category,
@@ -167,14 +228,15 @@ def main() -> None:
     } for r in rows[:30]]
 
     out = {
-        "workload": f"{model_name}_bs{batch}_spd{spd}",
+        "workload": tag,
+        "workload_kind": workload,
         "device_kind": getattr(jax.devices()[0], "device_kind", ""),
         "profiler_peaks": peaks,
         "wall_s": round(wall, 3),
         "n_dispatch": n_dispatch, "steps_per_dispatch": spd,
         "module_device_s_total": round(mod_total_s, 4),
         "device_s_per_step": round(device_s_per_step, 6),
-        "samples_per_s_per_chip_device_time": round(samples_per_s_device, 1),
+        f"{unit}_per_s_per_chip_device_time": round(units_per_s_device, 1),
         "device_busy_frac_of_wall": round(mod_total_s / wall, 3),
         "intermodule_gaps_ms": [round(g * 1e3, 2) for g in gaps],
         "op_time_s_total": round(op_total_s, 4),
@@ -189,7 +251,11 @@ def main() -> None:
                  "content from the optimized HLO (conv-fusion / "
                  "elementwise-fusion / reduce-fusion / copy...)."),
     }
-    path = pathlib.Path(__file__).parent / "step_profile_r5.json"
+    # cnn keeps its historical artifact path (round-5 evidence appends to
+    # it); the new entry-list workloads get their own files.
+    fname = ("step_profile_r5.json" if workload == "cnn"
+             else f"step_profile_{workload}.json")
+    path = pathlib.Path(__file__).parent / fname
     if path.exists():
         existing = json.loads(path.read_text())
         if not isinstance(existing, list):
@@ -198,9 +264,27 @@ def main() -> None:
         existing = []
     existing.append(out)
     path.write_text(json.dumps(existing, indent=1) + "\n")
+
+    # Tag the run's telemetry stream so the report CLI can cite which
+    # profile artifact covers it.
+    from distributed_model_parallel_tpu.utils.telemetry import TelemetryRun
+
+    telemetry = TelemetryRun(
+        os.environ.get("DMP_TELEMETRY",
+                       "/tmp/dmp_profile_log/profile_telemetry.jsonl"),
+        run=f"profile-{workload}",
+        meta=dict(workload=workload, tag=tag, artifact=str(path)))
+    telemetry.step(step=0, step_time_s=device_s_per_step,
+                   **{f"{unit}_per_s": units_per_s_device})
+    telemetry.record("profile", workload=tag,
+                     device_s_per_step=device_s_per_step,
+                     device_busy_frac_of_wall=round(mod_total_s / wall, 3))
+    telemetry.memory()
+    telemetry.finish()
+
     print(json.dumps({k: out[k] for k in (
         "workload", "device_s_per_step",
-        "samples_per_s_per_chip_device_time", "device_busy_frac_of_wall",
+        f"{unit}_per_s_per_chip_device_time", "device_busy_frac_of_wall",
         "category_frac_of_op_time")}, indent=1))
     print(f"wrote {path}")
 

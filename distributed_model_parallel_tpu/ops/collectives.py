@@ -17,6 +17,13 @@ consumes (SURVEY.md §2.2/§2.4):
 
 All functions taking ``axis_name`` must be called inside ``shard_map`` (or
 another named-axis context) over that axis.
+
+Every wrapper accounts its communication volume into the telemetry
+registry (``utils/telemetry.record_collective``) **at trace time** — once
+per compilation, tagged by kind and mesh axis, with per-device wire bytes
+under the ring cost model. ``scripts/dmp_report.py`` renders the totals;
+see the telemetry module docstring for the per-compile (not per-step)
+semantics.
 """
 
 from __future__ import annotations
@@ -26,6 +33,23 @@ from typing import Any, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distributed_model_parallel_tpu.utils.telemetry import record_collective
+
+
+def _tree_bytes(tree: Any) -> int:
+    """Static payload size of a pytree (works on tracers: shape/dtype only)."""
+    return sum(l.size * np.dtype(l.dtype).itemsize
+               for l in jax.tree.leaves(tree))
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis. ``jax.lax.axis_size`` is the
+    stable spelling only in newer jax; the psum-of-1 idiom constant-folds
+    to the same int everywhere."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def flatten_padded(tree: Any, n_shards: int, dtype=jnp.float32) -> jax.Array:
@@ -54,6 +78,7 @@ def unflatten_like(flat: jax.Array, tree: Any) -> Any:
 def psum_mean(tree: Any, axis_name: str) -> Any:
     """Gradient averaging over the data axis — DDP's allreduce-mean."""
     n = jax.lax.psum(1, axis_name)
+    record_collective("psum", axis_name, _tree_bytes(tree), n)
     return jax.tree.map(lambda g: jax.lax.psum(g, axis_name) / n, tree)
 
 
@@ -64,13 +89,16 @@ def ppermute_shift(x: jax.Array, axis_name: str, *, shift: int = 1) -> jax.Array
     send/recv (``distributed_layers.py:7-62``); on hardware this rides the ICI
     ring neighbor links.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
+    record_collective("ppermute", axis_name, _tree_bytes(x), n)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
 
 def all_gather_concat(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.Array:
     """Gather shards along ``axis`` (DataParallel's output ``gather``)."""
+    n = axis_size(axis_name)
+    record_collective("all_gather", axis_name, _tree_bytes(x) * n, n)
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
@@ -78,7 +106,8 @@ def reduce_scatter_mean(x: jax.Array, axis_name: str, *, axis: int = 0) -> jax.A
     """psum_scatter-mean: each shard gets one slice of the reduced result —
     the building block of ZeRO-style sharded optimizers and of halving
     allreduce traffic when parameters are sharded."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
+    record_collective("reduce_scatter", axis_name, _tree_bytes(x), n)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
                                 tiled=True) / n
 
@@ -135,12 +164,15 @@ def bucketed_psum(tree: Any, axis_name: str, *,
         reduce_fn = jax.lax.psum
     leaves, treedef = jax.tree.flatten(tree)
     n = jax.lax.psum(1, axis_name) if mean else 1
+    n_axis = axis_size(axis_name)
     out: list[Any] = [None] * len(leaves)
     for bucket in plan_buckets(tree, bucket_bytes):
         wire_dtype = (jnp.dtype(accum_dtype) if accum_dtype is not None
                       else jnp.result_type(*(leaves[i] for i in bucket)))
         flat = jnp.concatenate(
             [leaves[i].astype(wire_dtype).reshape(-1) for i in bucket])
+        record_collective("bucketed_psum", axis_name,
+                          flat.size * wire_dtype.itemsize, n_axis)
         red = reduce_fn(flat, axis_name)
         if mean:
             red = red / n
@@ -166,9 +198,14 @@ def hierarchical_psum(x: jax.Array, inner_axis: str, outer_axis: str, *,
     ``Readme.md:148-157``.) Requires ``x``'s leading dim divisible by
     |inner|; use ``hierarchical_psum_tree`` for arbitrary pytrees.
     """
+    n_in = axis_size(inner_axis)
+    n_out = axis_size(outer_axis)
+    record_collective("reduce_scatter", inner_axis, _tree_bytes(x), n_in)
     shard = jax.lax.psum_scatter(x, inner_axis, scatter_dimension=0,
                                  tiled=True)
+    record_collective("psum", outer_axis, _tree_bytes(shard), n_out)
     shard = jax.lax.psum(shard, outer_axis)
+    record_collective("all_gather", inner_axis, _tree_bytes(x), n_in)
     out = jax.lax.all_gather(shard, inner_axis, axis=0, tiled=True)
     if mean:
         out = out / (jax.lax.psum(1, inner_axis) * jax.lax.psum(1, outer_axis))
@@ -183,7 +220,7 @@ def hierarchical_psum_tree(tree: Any, inner_axis: str, outer_axis: str, *,
     ``lax.psum``) this sums by default; pass ``mean=True`` for DDP-style
     gradient averaging. The flat vector uses the promoted leaf dtype, not
     f32 — same wire-payload rule as ``bucketed_psum``."""
-    flat = flatten_padded(tree, jax.lax.axis_size(inner_axis),
+    flat = flatten_padded(tree, axis_size(inner_axis),
                           dtype=jnp.result_type(*jax.tree.leaves(tree)))
     red = hierarchical_psum(flat, inner_axis, outer_axis, mean=mean)
     return unflatten_like(red, tree)

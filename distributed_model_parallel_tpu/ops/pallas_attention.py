@@ -36,6 +36,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# ``pltpu.CompilerParams`` is the newer spelling; this container's pallas
+# still names it ``TPUCompilerParams`` (same fields).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 
@@ -426,7 +431,7 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret, window=None):
             pltpu.VMEM((bq, _LANE_W), jnp.float32),
             pltpu.VMEM((bq, d_pad), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=_SEQ_SEMANTICS),
         interpret=interp,
     )(qf, kf, vf)
@@ -479,7 +484,7 @@ def _bwd_dq_call(qf, kf, vf, gf, lse, delta, *, bq, bk, d_pad, causal, scale,
         scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32),
                         pltpu.VMEM((bq, _LANE_W), jnp.float32),
                         pltpu.VMEM((bq, _LANE_W), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=_SEQ_SEMANTICS),
         interpret=interp,
     )(qf, kf, vf, gf, lse, delta)
@@ -512,7 +517,7 @@ def _bwd_dkv_call(qf, kf, vf, gf, lse, delta, *, bq, bk, d_pad, causal,
             pltpu.VMEM((bk, d_pad), jnp.float32),
             pltpu.VMEM((bk, d_pad), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=_SEQ_SEMANTICS),
         interpret=interp,
     )(qf, kf, vf, gf, lse, delta)

@@ -40,6 +40,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from distributed_model_parallel_tpu.ops.collectives import axis_size
+
 _NEG = -1e30
 
 
@@ -68,7 +70,7 @@ def _ring_xla(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
               causal: bool) -> jax.Array:
     """The XLA block-math ring: materializes each hop's local score tensor
     (fine at short T_local); online-softmax state carried in f32."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     scale = q.shape[-1] ** -0.5
@@ -165,7 +167,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal):
         default_blocks,
     )
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t, h, _ = q.shape
     bq, bk = default_blocks()
@@ -212,7 +214,7 @@ def _ring_flash_bwd(axis_name, causal, res, g):
     )
 
     q, k, v, o, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t, h, _ = q.shape
     bq, bk = default_blocks()
@@ -305,7 +307,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     raised matmul-precision context auto-declines the kernel); "flash"
     forces the pallas kernel for dtypes/regimes the table excludes.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
 

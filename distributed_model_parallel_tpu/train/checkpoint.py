@@ -105,7 +105,12 @@ class Checkpointer:
         path = self._latest_path(name)
         if path is None:
             raise FileNotFoundError(self._path(name))
-        tree = self._ckpt.metadata(path).item_metadata.tree
+        meta = self._ckpt.metadata(path)
+        # Newer orbax wraps the tree in .item_metadata.tree; this
+        # container's orbax returns the key->metadata mapping directly.
+        tree = getattr(getattr(meta, "item_metadata", None), "tree", None)
+        if tree is None:
+            tree = meta
         missing = [k for k in target if k not in tree]
         if missing:
             raise KeyError(f"checkpoint {path} has no keys {missing}; "
@@ -118,10 +123,19 @@ class Checkpointer:
         # an 8-device mesh restored for single-device inference —
         # scripts/generate.py's whole use case).
         restore_args = ocp.checkpoint_utils.construct_restore_args(target)
-        return ocp.PyTreeCheckpointer().restore(
-            path, args=ocp.args.PyTreeRestore(item=abstract,
-                                              restore_args=restore_args,
-                                              partial_restore=True))
+        try:
+            return ocp.PyTreeCheckpointer().restore(
+                path, args=ocp.args.PyTreeRestore(item=abstract,
+                                                  restore_args=restore_args,
+                                                  partial_restore=True))
+        except TypeError:
+            # Older orbax has no partial_restore kwarg; transforms={} is its
+            # spelling of the same thing (checkpoint keys absent from
+            # ``item`` are dropped instead of restored).
+            return ocp.PyTreeCheckpointer().restore(
+                path, args=ocp.args.PyTreeRestore(item=abstract,
+                                                  restore_args=restore_args,
+                                                  transforms={}))
 
     def exists(self, name: str = "ckpt") -> bool:
         self.wait_until_finished()

@@ -179,7 +179,23 @@ class LMTrainer:
         )
 
         self.preemption = PreemptionGuard()
-        self.logger = RunLogger(config.log_dir, config.log_name)
+        # Analytic model FLOPs per train step (utils/profiling): lets the
+        # report CLI compute MFU from the telemetry stream alone (flops /
+        # n_devices / step_time / chip peak).
+        from distributed_model_parallel_tpu.utils.profiling import (
+            lm_model_flops,
+        )
+
+        self.logger = RunLogger(
+            config.log_dir, config.log_name,
+            meta=dict(workload="lm",
+                      batch_size=config.batch_size,
+                      seq_len=config.seq_len,
+                      tokens_per_step=config.batch_size * config.seq_len,
+                      mesh=config.mesh.axis_sizes(),
+                      pipeline_schedule=config.pipeline_schedule,
+                      model_flops_per_step=lm_model_flops(
+                          cfg, config.batch_size, config.seq_len)))
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
@@ -295,7 +311,9 @@ class LMTrainer:
                 meter = AverageMeter("loss")
                 drop_meter = AverageMeter("moe_drop")
                 timer = StepTimer()
-                for _ in range(self.config.steps_per_epoch):
+                tokens_per_step = (self.config.batch_size
+                                   * self.config.seq_len)
+                for step_i in range(self.config.steps_per_epoch):
                     if self.preemption.requested():
                         break
                     toks, tgts = self.sample_batch()
@@ -313,6 +331,14 @@ class LMTrainer:
                     if "moe_drop" in step_m:
                         drop_meter.update(float(step_m["moe_drop"]))
                     timer.step_done()
+                    # Per-step telemetry (the LM loop syncs every step, so
+                    # the per-step timing is real, not a window average).
+                    self.logger.telemetry.step(
+                        epoch=epoch, step=step_i, loss=loss_host,
+                        step_time_s=timer.step.last,
+                        data_time_s=timer.data.last,
+                        tokens_per_s=tokens_per_step
+                        / max(timer.step.last, 1e-9))
                 if self.preemption.requested():
                     # Partial epoch: save for resume at this epoch and stop
                     # cleanly (train/preemption.py).
@@ -346,7 +372,9 @@ class LMTrainer:
                     # (ops/moe._route — silent overflow made visible).
                     record["moe_drop_rate"] = drop_meter.avg
                 self.logger.log_epoch(**record)
+                self.logger.telemetry.memory()
                 history.append(record)
                 self.start_epoch = epoch + 1
                 self.ckpt.save(self._ckpt_tree(), "lm")
+        self.logger.finish(epochs_run=len(history))
         return history

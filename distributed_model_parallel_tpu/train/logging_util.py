@@ -1,26 +1,37 @@
-"""Run logging: reference-format text lines + structured JSONL.
+"""Run logging: reference-format text lines + the telemetry event stream.
 
 The reference appends one line per epoch to a text file —
 ``step/loss_train/acc1_train/loss_val/acc1_val`` (+ per-batch timings in the
 pipeline driver) — ``data_parallel.py:167-171``, ``model_parallel.py:119-124``,
 and prints every 30 batches (``data_parallel.py:116-117``, ``utils.py:69-70``).
-We keep that text format for diffability and add a JSONL stream for tooling.
+We keep that text format for diffability; the structured side is no longer a
+parallel ad-hoc JSONL code path but a sink of ``utils/telemetry.TelemetryRun``
+— the same ``{name}.jsonl`` file now carries the typed record stream
+(``run_start``/``step``/``epoch``/``event``/...) that ``scripts/dmp_report.py``
+renders into a run report.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import time
-from typing import Any
+from typing import Any, Mapping
+
+from distributed_model_parallel_tpu.utils.telemetry import TelemetryRun
 
 
 class RunLogger:
-    def __init__(self, log_dir: str, name: str, *, echo: bool = True):
+    def __init__(self, log_dir: str, name: str, *, echo: bool = True,
+                 telemetry: TelemetryRun | None = None,
+                 meta: Mapping[str, Any] | None = None):
         os.makedirs(log_dir, exist_ok=True)
         self.txt_path = os.path.join(log_dir, f"{name}.txt")
         self.jsonl_path = os.path.join(log_dir, f"{name}.jsonl")
         self.echo = echo
+        # The JSONL sink IS the telemetry stream (no second format): callers
+        # may inject a shared TelemetryRun; by default the logger opens one
+        # at the historical jsonl path.
+        self.telemetry = telemetry if telemetry is not None else TelemetryRun(
+            self.jsonl_path, run=name, meta=meta)
 
     def log_epoch(self, epoch: int, **metrics: Any) -> None:
         # Text line mirrors the reference's epoch record (data_parallel.py:167-171).
@@ -31,10 +42,7 @@ class RunLogger:
         line = " ".join(parts)
         with open(self.txt_path, "a") as f:
             f.write(line + "\n")
-        with open(self.jsonl_path, "a") as f:
-            f.write(json.dumps({"ts": time.time(), "epoch": epoch, **{
-                k: (float(v) if hasattr(v, "__float__") else v)
-                for k, v in metrics.items()}}) + "\n")
+        self.telemetry.epoch(epoch=epoch, **metrics)
         if self.echo:
             print(line, flush=True)
 
@@ -42,14 +50,20 @@ class RunLogger:
         """Free-form event line (preemption, guard trips) to both sinks."""
         with open(self.txt_path, "a") as f:
             f.write(message + "\n")
-        with open(self.jsonl_path, "a") as f:
-            f.write(json.dumps({"ts": time.time(), "event": message}) + "\n")
+        self.telemetry.event(message)
         if self.echo:
             print(message, flush=True)
 
     def log_step(self, epoch: int, step: int, **metrics: Any) -> None:
+        """Per-step record: echoed at the reference's cadence AND persisted
+        as a telemetry ``step`` record (timing + throughput keys)."""
+        self.telemetry.step(epoch=epoch, step=step, **metrics)
         if self.echo:
             parts = [f"[{epoch}:{step}]"] + [
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in metrics.items()]
             print(" ".join(parts), flush=True)
+
+    def finish(self, **fields: Any) -> None:
+        """Close out the run stream (registry snapshot + run_end)."""
+        self.telemetry.finish(**fields)

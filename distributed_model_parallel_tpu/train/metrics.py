@@ -39,8 +39,10 @@ class AverageMeter:
     def reset(self):
         self.sum = 0.0
         self.count = 0
+        self.last = 0.0
 
     def update(self, value: float, n: int = 1):
+        self.last = float(value)
         self.sum += float(value) * n
         self.count += n
 

@@ -107,7 +107,13 @@ class PipelineTrainer:
         )
 
         self.preemption = PreemptionGuard()
-        self.logger = RunLogger(config.log_dir, config.log_name)
+        self.logger = RunLogger(
+            config.log_dir, config.log_name,
+            meta=dict(workload="cnn-pipeline", model=config.model.name,
+                      batch_size=config.data.batch_size,
+                      n_stages=len(self.devices),
+                      num_microbatches=config.num_microbatches,
+                      pipeline_schedule=config.pipeline_schedule))
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
@@ -176,6 +182,10 @@ class PipelineTrainer:
         max_inflight = max(1, self.config.max_inflight_steps)
         t_epoch = time.perf_counter()
         n_steps = 0
+        # Per-window residual tracking for the telemetry step records: the
+        # report's percentiles need per-window samples, not the epoch
+        # running mean (which hides stragglers).
+        win_wall, win_data, win_steps = t_epoch, 0.0, 0
         timer.mark()
         for i, (images, labels) in enumerate(loader):
             if train and self.preemption.requested():
@@ -191,12 +201,19 @@ class PipelineTrainer:
                 if log_now or len(pending) >= max_inflight:
                     drain()
                 if log_now:
-                    run_step = (max(0.0, time.perf_counter() - t_epoch
-                                    - timer.data.sum) / max(1, n_steps))
-                    self.logger.log_step(epoch, i, loss=meters["loss"].avg,
-                                         acc1=meters["acc1"].avg,
-                                         step_time=run_step,
-                                         data_time=timer.data.avg)
+                    now = time.perf_counter()
+                    d_data = timer.data.sum - win_data
+                    d_steps = max(1, n_steps - win_steps)
+                    run_step = max(0.0, now - win_wall - d_data) / d_steps
+                    win_wall, win_data, win_steps = (now, timer.data.sum,
+                                                     n_steps)
+                    self.logger.log_step(
+                        epoch, i, loss=meters["loss"].avg,
+                        acc1=meters["acc1"].avg,
+                        step_time_s=run_step,
+                        data_time_s=timer.data.last,
+                        samples_per_s=self.config.data.batch_size
+                        / max(run_step, 1e-9))
             else:
                 m = self.runner.eval_step(images, labels)
                 update(m, m["batch"])
@@ -237,9 +254,11 @@ class PipelineTrainer:
                               time_per_batch=tr.step_time,
                               time_load_per_batch=tr.data_time)
                 self.logger.log_epoch(**record)
+                self.logger.telemetry.memory()
                 history.append(record)
                 if ev is not None and ev.acc1 > self.best_acc:
                     self.best_acc = ev.acc1
                     self.start_epoch = epoch + 1
                     self.ckpt.save(self._ckpt_tree(), "pipeline")
+        self.logger.finish(epochs_run=len(history))
         return history

@@ -457,7 +457,14 @@ class Trainer:
         )
 
         self.preemption = PreemptionGuard()
-        self.logger = RunLogger(config.log_dir, config.log_name)
+        self.logger = RunLogger(
+            config.log_dir, config.log_name,
+            meta=dict(workload="cnn", model=config.model.name,
+                      strategy=config.strategy,
+                      batch_size=config.data.batch_size,
+                      mesh=config.mesh.axis_sizes(),
+                      steps_per_dispatch=config.steps_per_dispatch
+                      if config.device_resident_data else 1))
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
@@ -605,10 +612,17 @@ class Trainer:
                 self._drain(pending, meters)    # blocks: sync point
                 timer.window_done(n)
             if log_now:
-                self.logger.log_step(epoch, i, loss=meters["loss"].avg,
-                                     acc1=meters["acc1"].avg,
-                                     step_time=timer.step.avg,
-                                     data_time=timer.data.avg)
+                # Per-WINDOW samples (meter .last, set by window_done), not
+                # the epoch running mean: the report's step-time percentiles
+                # must see real per-step variation or a straggler window
+                # collapses into the average and disappears.
+                self.logger.log_step(
+                    epoch, i, loss=meters["loss"].avg,
+                    acc1=meters["acc1"].avg,
+                    step_time_s=timer.step.last,
+                    data_time_s=timer.data.last,
+                    samples_per_s=self.config.data.batch_size
+                    / max(timer.step.last, 1e-9))
         n = len(pending)
         self._drain(pending, meters)
         timer.window_done(n)
@@ -651,10 +665,14 @@ class Trainer:
                 timer.window_done(inflight)
                 inflight = 0
             if log_now:
-                self.logger.log_step(epoch, i, loss=meters["loss"].avg,
-                                     acc1=meters["acc1"].avg,
-                                     step_time=timer.step.avg,
-                                     data_time=timer.data.avg)
+                # Per-window samples, same rationale as the per-batch path.
+                self.logger.log_step(
+                    epoch, i, loss=meters["loss"].avg,
+                    acc1=meters["acc1"].avg,
+                    step_time_s=timer.step.last,
+                    data_time_s=timer.data.last,
+                    samples_per_s=self.config.data.batch_size
+                    / max(timer.step.last, 1e-9))
         self._drain(pending, meters)
         timer.window_done(inflight)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
@@ -717,9 +735,13 @@ class Trainer:
                               time_per_batch=tr.step_time,
                               time_load_per_batch=tr.data_time)
                 self.logger.log_epoch(**record)
+                # Device memory watermark per epoch (no-op where the backend
+                # reports none, e.g. CPU).
+                self.logger.telemetry.memory()
                 history.append(record)
                 if ev is not None and ev.acc1 > self.best_acc:
                     self.best_acc = ev.acc1
                     self._save(epoch)
         self.ckpt.wait_until_finished()
+        self.logger.finish(epochs_run=len(history))
         return history

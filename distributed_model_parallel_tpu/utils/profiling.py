@@ -62,13 +62,17 @@ TPU_PEAK_HBM_BYTES: dict[str, float] = {
 }
 
 
-def match_device_kind(table: dict, device=None):
+def match_device_kind(table: dict, device=None, *, kind: str | None = None):
     """Longest-prefix lookup of ``device.device_kind`` in ``table`` (so
     "TPU v5 lite..." hits a "TPU v5 lite" row, not "TPU v5"). Shared by the
     peak-FLOPs table here and the flash dispatch table
-    (ops/pallas_attention.py). Returns the value or None."""
-    device = device if device is not None else jax.devices()[0]
-    kind = getattr(device, "device_kind", "") or ""
+    (ops/pallas_attention.py). Returns the value or None.
+
+    Pass ``kind`` to look up a recorded device_kind string without a live
+    backend (scripts/dmp_report.py reads it from a telemetry stream)."""
+    if kind is None:
+        device = device if device is not None else jax.devices()[0]
+        kind = getattr(device, "device_kind", "") or ""
     for prefix in sorted(table, key=len, reverse=True):
         if kind.startswith(prefix):
             return table[prefix]

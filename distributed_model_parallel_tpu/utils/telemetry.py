@@ -1,0 +1,530 @@
+"""Unified run telemetry: metrics registry + structured JSONL event stream.
+
+The reference's observability is ``time.time()`` deltas averaged per epoch
+(``utils.py:41-74``). Before this module ours was fragmented the same way —
+``StepTimer``/``AverageMeter`` meters, a ``RunLogger`` JSONL stream, and an
+xplane trace parser that never fed one another. This module is the single
+telemetry layer all of them now share:
+
+* a process-wide :class:`MetricsRegistry` (counters, gauges, fixed-bucket
+  histograms) — **host-side only, never inside jit**: metrics record Python
+  floats at dispatch/drain/trace time, they are not traced values;
+* a :class:`TelemetryRun` event stream — one JSONL file per run holding
+  typed records (``run_start``, ``step``, ``epoch``, ``event``, ``memory``,
+  ``metrics``, ``run_end``, ``failure``) that ``scripts/dmp_report.py``
+  turns into step-time percentiles, throughput, MFU, comm volume and
+  memory-watermark answers;
+* collective communication-volume accounting
+  (:func:`record_collective`), called by the ``ops/collectives.py``
+  wrappers **at trace time** — each compilation of a program that uses a
+  wrapper records its estimated per-device wire bytes once, tagged by mesh
+  axis. Trace-time means the numbers are per *compile*, not per executed
+  step: multiply by the step count for a program that retraces once (the
+  steady state), and read them as "what one dispatch moves".
+
+Record schema (all records carry ``ts`` (unix seconds) and ``kind``):
+
+========== ==========================================================
+kind       payload keys
+========== ==========================================================
+run_start  run, jax, device {platform, device_kind, n_devices,
+           process_index}, meta {workload-specific, e.g.
+           model_flops_per_step, batch_size, mesh}
+step       epoch, step, step_time_s, data_time_s, loss,
+           samples_per_s | tokens_per_s, workload extras
+epoch      epoch, loss_train, loss_val, time_per_batch, ...
+event      message (free-form: preemption, guard trips)
+memory     devices: [{id, platform, bytes_in_use, peak_bytes_in_use}]
+metrics    counters, gauges, histograms (registry snapshot)
+run_end    wall_s, plus caller extras
+failure    error, detail, attempts, stage
+========== ==========================================================
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Iterable, Mapping
+
+__all__ = [
+    "AlreadyRegisteredError",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "TelemetryRun",
+    "device_info",
+    "device_memory_snapshot",
+    "install_compile_tracking",
+    "record_collective",
+    "registry",
+    "wire_bytes_estimate",
+]
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic float counter."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter increments must be >= 0, got {n}")
+        self.value += float(n)
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+# Default histogram buckets: log-spaced, 5 per decade, 10us..100s — wide
+# enough for per-step latencies on CPU tests and tunnel-latency TPU runs
+# alike. Quantiles interpolate within a bucket, so the estimate error is
+# bounded by the bucket ratio (10^0.2 ~ 1.58x worst case).
+DEFAULT_TIME_BUCKETS: tuple[float, ...] = tuple(
+    10 ** (-5 + i / 5) for i in range(36))
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated quantiles.
+
+    Exact ``count``/``sum``/``min``/``max``; quantiles come from the bucket
+    cumulative counts with linear interpolation inside the crossing bucket.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, bounds: Iterable[float] | None = None):
+        self.bounds = tuple(sorted(bounds or DEFAULT_TIME_BUCKETS))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        # First bound >= v (linear scan: bucket counts are small and this
+        # is host-side bookkeeping, not a hot loop).
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def percentile(self, q: float) -> float | None:
+        """Interpolated q-th percentile (q in [0, 100]); None when empty."""
+        if self.count == 0:
+            return None
+        target = q / 100.0 * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if cum + c >= target and c > 0:
+                # Bucket i spans (lo, hi]; clamp to observed min/max so a
+                # single-sample histogram reports the sample, not a bound.
+                lo = self.bounds[i - 1] if i > 0 else self.min
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                lo, hi = max(lo, self.min), min(hi, self.max)
+                frac = (target - cum) / c
+                return lo + frac * (hi - lo)
+            cum += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.sum / self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class AlreadyRegisteredError(ValueError):
+    """A metric name+tags was reused with a different metric type."""
+
+
+def _fmt_key(name: str, tags: tuple[tuple[str, str], ...]) -> str:
+    if not tags:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in tags)
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Process-wide named metrics, keyed by (name, sorted tags)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, Any] = {}
+
+    def _get(self, cls, name: str, tags: Mapping[str, Any], **kw):
+        key = (name, tuple(sorted((k, str(v)) for k, v in tags.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(**kw)
+            elif not isinstance(m, cls):
+                raise AlreadyRegisteredError(
+                    f"{_fmt_key(*key)} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str, **tags) -> Counter:
+        return self._get(Counter, name, tags)
+
+    def gauge(self, name: str, **tags) -> Gauge:
+        return self._get(Gauge, name, tags)
+
+    def histogram(self, name: str, bounds: Iterable[float] | None = None,
+                  **tags) -> Histogram:
+        return self._get(Histogram, name, tags, bounds=bounds)
+
+    def snapshot(self) -> dict:
+        """JSON-ready dump: {"counters": {...}, "gauges": {...},
+        "histograms": {...}} with ``name{k=v,...}`` keys."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            items = list(self._metrics.items())
+        for (name, tags), m in sorted(items, key=lambda kv: kv[0]):
+            key = _fmt_key(name, tags)
+            if isinstance(m, Counter):
+                out["counters"][key] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][key] = m.value
+            else:
+                out["histograms"][key] = m.snapshot()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+_default_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (collectives accounting, compile counts)."""
+    return _default_registry
+
+
+# ---------------------------------------------------------------------------
+# Recompilation tracking (jax.monitoring)
+# ---------------------------------------------------------------------------
+
+_compile_tracking_installed = False
+
+
+def install_compile_tracking() -> bool:
+    """Count backend compilations into ``registry().counter("jax_compiles")``.
+
+    Uses the public ``jax.monitoring`` listener API
+    (``/jax/core/compile/backend_compile_duration`` fires once per XLA
+    compile — i.e. once per trace-cache miss, which is exactly what a
+    "recompilation count" should mean). Idempotent; returns whether the
+    listener is installed. Total compile seconds accumulate alongside in
+    ``jax_compile_seconds`` so the report can say how much wall time
+    compilation ate.
+    """
+    global _compile_tracking_installed
+    if _compile_tracking_installed:
+        return True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if event.endswith("backend_compile_duration"):
+                reg = registry()
+                reg.counter("jax_compiles").inc()
+                reg.counter("jax_compile_seconds").inc(max(0.0, duration))
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:        # pragma: no cover - jax without monitoring
+        return False
+    _compile_tracking_installed = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Collective communication-volume accounting (called at trace time)
+# ---------------------------------------------------------------------------
+
+# Per-device wire bytes moved by one execution of a collective over an
+# n-way axis, as a fraction of the logical payload — the standard ring
+# algorithm costs. ppermute sends the whole shard once; all-reduce is
+# reduce-scatter + all-gather.
+_WIRE_FACTORS = {
+    "psum": lambda n: 2 * (n - 1) / n,
+    "bucketed_psum": lambda n: 2 * (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+}
+
+
+def wire_bytes_estimate(kind: str, payload_bytes: int, n_shards: int) -> float:
+    """Estimated per-device wire bytes for one execution of a collective.
+
+    ``payload_bytes`` is the LOGICAL payload: the full reduced tree for
+    psum/reduce_scatter, the full gathered result for all_gather, the
+    per-device shard for ppermute. Ring-algorithm cost model; actual ICI
+    traffic depends on the topology XLA picks, so treat as an estimate.
+    """
+    n = max(1, int(n_shards))
+    factor = _WIRE_FACTORS.get(kind)
+    if factor is None:
+        factor = lambda n: 1.0  # noqa: E731 - unknown kinds count payload
+    return float(payload_bytes) * factor(n)
+
+
+def record_collective(kind: str, axis: Any, payload_bytes: Any,
+                      n_shards: Any) -> None:
+    """Account one collective call into the registry, tagged by mesh axis.
+
+    Called by the ``ops/collectives.py`` wrappers while they trace. Never
+    raises: a tracer leaking into ``n_shards`` (dynamic axis size) or any
+    other surprise silently skips the sample rather than breaking the
+    user's jit. Counters written (see module docstring for trace-time
+    semantics):
+
+    * ``collective_traces{kind,axis}`` — times this collective traced;
+    * ``collective_payload_bytes{kind,axis}`` — logical payload bytes;
+    * ``collective_wire_bytes_est{kind,axis}`` — ring-model wire bytes.
+    """
+    try:
+        n = int(n_shards)
+        b = int(payload_bytes)
+        axis_s = axis if isinstance(axis, str) else ",".join(map(str, axis))
+        reg = registry()
+        tags = dict(kind=kind, axis=axis_s)
+        reg.counter("collective_traces", **tags).inc()
+        reg.counter("collective_payload_bytes", **tags).inc(b)
+        reg.counter("collective_wire_bytes_est", **tags).inc(
+            wire_bytes_estimate(kind, b, n))
+    except Exception:
+        return
+
+
+# ---------------------------------------------------------------------------
+# Device probes (host-side, guarded: must never take a run down)
+# ---------------------------------------------------------------------------
+
+def device_info() -> dict:
+    """Backend identity for the run_start record; {"error": ...} when the
+    backend is unreachable (bench failure records still need a header)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        d0 = devs[0]
+        return {
+            "platform": d0.platform,
+            "device_kind": getattr(d0, "device_kind", "") or "",
+            "n_devices": len(devs),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def device_memory_snapshot() -> list[dict] | None:
+    """Per-device memory watermarks via ``memory_stats()`` where the backend
+    implements it (TPU/GPU); None when no device reports (CPU returns
+    None per device)."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            rec = {"id": d.id, "platform": d.platform}
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                      "largest_alloc_size"):
+                if k in stats:
+                    rec[k] = int(stats[k])
+            out.append(rec)
+        return out or None
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The run event stream
+# ---------------------------------------------------------------------------
+
+def _coerce(v: Any) -> Any:
+    """JSON-safe coercion: device/numpy scalars to float, containers
+    element-wise; anything else through str() as a last resort."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, Mapping):
+        return {str(k): _coerce(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_coerce(x) for x in v]
+    if hasattr(v, "__float__"):
+        try:
+            return float(v)
+        except Exception:
+            pass
+    return str(v)
+
+
+class TelemetryRun:
+    """Append-only JSONL event stream for one run.
+
+    Opens (and creates directories for) ``path``, writes a ``run_start``
+    header, then takes typed records. Thread-safe appends; every record is
+    one line, flushed, so a killed run still leaves a parseable stream.
+    """
+
+    def __init__(self, path: str, *, run: str = "run",
+                 meta: Mapping[str, Any] | None = None,
+                 registry_: MetricsRegistry | None = None,
+                 track_compiles: bool = True,
+                 device: Mapping[str, Any] | None = None):
+        self.path = path
+        self.registry = registry_ if registry_ is not None else registry()
+        self._lock = threading.Lock()
+        self._finished = False
+        self._t0 = time.time()
+        # Counter baseline at stream open: the registry is process-global,
+        # so a second run in the same process must not inherit the first
+        # run's collective-volume / compile counts in its metrics record.
+        self._counter_baseline = dict(
+            self.registry.snapshot().get("counters", {}))
+        # Step-time histogram is RUN-LOCAL (histograms have no delta
+        # semantics, so sharing the global registry would merge runs).
+        self._step_hist = Histogram()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        if track_compiles:
+            install_compile_tracking()
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:        # pragma: no cover - jax always present here
+            jax_version = None
+        # ``device`` override: callers reporting a DEAD backend (bench
+        # failure records) must not re-dial it just to write the header —
+        # device_info() would re-initialize the backend from scratch.
+        self.record("run_start", run=run, jax=jax_version,
+                    device=dict(device) if device is not None
+                    else device_info(),
+                    meta=_coerce(dict(meta or {})))
+
+    def record(self, kind: str, **fields) -> None:
+        line = json.dumps({"ts": time.time(), "kind": kind,
+                           **{k: _coerce(v) for k, v in fields.items()}},
+                          default=str)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    def step(self, **fields) -> None:
+        """One training/bench step (or drain window) worth of timings.
+        Conventional keys: epoch, step, step_time_s, data_time_s, loss,
+        samples_per_s or tokens_per_s. Step times also feed a run-local
+        ``step_time_s`` histogram, so the final metrics record carries
+        bucket-quantile estimates next to the raw records."""
+        t = fields.get("step_time_s")
+        if isinstance(t, (int, float)) and not isinstance(t, bool):
+            self._step_hist.observe(t)
+        self.record("step", **fields)
+
+    def epoch(self, **fields) -> None:
+        self.record("epoch", **fields)
+
+    def event(self, message: str) -> None:
+        self.record("event", message=message)
+
+    def failure(self, error: str, **fields) -> None:
+        self.record("failure", error=error, **fields)
+
+    def memory(self) -> list[dict] | None:
+        """Record device memory watermarks (no-op record skipped when the
+        backend reports none, e.g. CPU)."""
+        snap = device_memory_snapshot()
+        if snap:
+            self.record("memory", devices=snap)
+        return snap
+
+    def metrics(self) -> None:
+        """Snapshot the registry into the stream.
+
+        Counters are reported as DELTAS since this stream opened (the
+        registry is process-global; without the baseline a second run in
+        the same process would re-report the first run's comm volume and
+        compile counts). The ``step_time_s`` histogram is run-local, so
+        its quantiles describe only this run; gauges and any caller-made
+        registry histograms are absolute."""
+        snap = self.registry.snapshot()
+        base = self._counter_baseline
+        snap["counters"] = {k: v - base.get(k, 0)
+                            for k, v in snap.get("counters", {}).items()}
+        if self._step_hist.count:
+            snap.setdefault("histograms", {})["step_time_s"] = \
+                self._step_hist.snapshot()
+        self.record("metrics", **snap)
+
+    def finish(self, **fields) -> None:
+        """Write the final ``metrics`` + ``run_end`` records (idempotent)."""
+        if self._finished:
+            return
+        self._finished = True
+        self.metrics()
+        self.record("run_end", wall_s=time.time() - self._t0, **fields)
+
+
+def read_records(path: str) -> list[dict]:
+    """Parse a telemetry JSONL file, skipping truncated trailing lines
+    (a killed run may leave a partial final record)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

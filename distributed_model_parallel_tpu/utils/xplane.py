@@ -38,6 +38,23 @@ import jax
 _xplane_pb2 = None
 
 
+class XplaneProtosUnavailable(ImportError):
+    """The xplane_pb2 protobuf bindings are not importable.
+
+    Subclasses ImportError so pre-existing ``except ImportError`` callers
+    keep working; new callers (the CLI below, scripts/dmp_report.py) catch
+    this specifically and print :data:`PROTO_HINT` instead of a traceback.
+    """
+
+
+PROTO_HINT = (
+    "xplane trace analysis needs the xplane_pb2 protobuf bindings "
+    "(tensorflow.tsl.profiler.protobuf.xplane_pb2, shipped with the "
+    "tensorflow wheel); they are not importable here — install tensorflow "
+    "(CPU build is enough) or skip the trace-analysis step; trace CAPTURE "
+    "(jax.profiler / trace_to) works without them")
+
+
 def _pb2():
     """Lazy import: tensorflow is heavy and only profiler analysis needs it."""
     global _xplane_pb2
@@ -45,11 +62,18 @@ def _pb2():
         try:
             from tensorflow.tsl.profiler.protobuf import xplane_pb2
         except ImportError as e:        # pragma: no cover - env without tf
-            raise ImportError(
-                "xplane analysis needs the xplane_pb2 proto bindings "
-                "(shipped with tensorflow); not available here") from e
+            raise XplaneProtosUnavailable(PROTO_HINT) from e
         _xplane_pb2 = xplane_pb2
     return _xplane_pb2
+
+
+def protos_available() -> bool:
+    """True when the xplane_pb2 bindings import (analysis paths will work)."""
+    try:
+        _pb2()
+    except XplaneProtosUnavailable:
+        return False
+    return True
 
 
 @contextlib.contextmanager
@@ -299,6 +323,11 @@ def main(argv=None) -> None:
     p.add_argument("--top", type=int, default=15, help="top ops to print")
     args = p.parse_args(argv)
 
+    try:
+        _pb2()
+    except XplaneProtosUnavailable as e:
+        # Actionable one-liner, no traceback (VERDICT next #8).
+        raise SystemExit(f"[xplane] {e}") from None
     plane = device_plane(load_xspace(args.trace_dir))
     peaks = plane_peaks(plane)
     mods = module_events(plane)

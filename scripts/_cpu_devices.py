@@ -45,4 +45,14 @@ def force_cpu_devices(
     if n > 1:
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except AttributeError:
+            # Older jax (this container's) lacks the config option; the
+            # XLA_FLAGS spelling works there — but only as a fallback,
+            # because a newer jax rejects having BOTH knobs set.
+            flags_env = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags_env:
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags_env} "
+                    f"--xla_force_host_platform_device_count={n}").strip()

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Run report: one command that answers "why is this step slow".
+
+Joins a run's telemetry JSONL (utils/telemetry.TelemetryRun — written by
+every trainer via RunLogger and by bench.py) with an optional xplane trace
+directory (utils/xplane op breakdown) and prints:
+
+* step-time percentiles (p50/p90/p99) and throughput from ``step`` records;
+* MFU against the profiling.py peak tables — or an honest "MFU unavailable"
+  line when the device has no peak entry (CPU) or the run recorded no FLOPs;
+* HBM-roofline position when the run recorded demand bytes;
+* communication volume per collective kind x mesh axis (trace-time
+  estimates from ops/collectives.py);
+* device memory watermarks and recompilation counts;
+* top-N device ops + per-category device time from the xplane trace
+  (``--trace``), degrading to an actionable one-liner when the tensorflow
+  proto bindings are absent.
+
+Usage:
+  python scripts/dmp_report.py log/lm.jsonl
+  python scripts/dmp_report.py log/train.jsonl --trace /tmp/dmp_step_trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    read_records,
+)
+
+
+def percentile(xs: list[float], q: float) -> float:
+    """Linear-interpolated percentile of a non-empty list (no numpy dep in
+    the report path — the stream is host data)."""
+    ys = sorted(xs)
+    if len(ys) == 1:
+        return ys[0]
+    pos = q / 100.0 * (len(ys) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ys) - 1)
+    return ys[lo] + (pos - lo) * (ys[hi] - ys[lo])
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(b) < 1024 or unit == "TB":
+            return f"{b:.1f} {unit}"
+        b /= 1024
+    return f"{b:.1f} TB"
+
+
+def _fmt_s(s: float) -> str:
+    return f"{s * 1e3:.2f} ms" if s < 1 else f"{s:.3f} s"
+
+
+def _by_kind(records: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for r in records:
+        # Legacy streams (pre-telemetry RunLogger) had no "kind": treat
+        # records carrying an epoch as epoch records so old logs still
+        # render a (reduced) report.
+        kind = r.get("kind") or ("epoch" if "epoch" in r else "event")
+        out.setdefault(kind, []).append(r)
+    return out
+
+
+def _steps_section(lines: list[str], steps: list[dict]) -> list[float]:
+    """Append the step-timing section; returns the step-time list so the
+    efficiency section reuses the same filtered values."""
+    lines.append(f"== steps ({len(steps)} records) ==")
+    times = [r["step_time_s"] for r in steps
+             if isinstance(r.get("step_time_s"), (int, float))]
+    if times:
+        lines.append(
+            f"step time   p50 {_fmt_s(percentile(times, 50))}   "
+            f"p90 {_fmt_s(percentile(times, 90))}   "
+            f"p99 {_fmt_s(percentile(times, 99))}   "
+            f"mean {_fmt_s(sum(times) / len(times))}")
+    else:
+        lines.append("step time   (no step_time_s keys recorded)")
+    data = [r["data_time_s"] for r in steps
+            if isinstance(r.get("data_time_s"), (int, float))]
+    if data:
+        tot = sum(data) + sum(times)
+        lines.append(
+            f"data time   mean {_fmt_s(sum(data) / len(data))}"
+            + (f"   (data/compute split {sum(data) / tot:.1%} data)"
+               if tot > 0 else ""))
+    for key, unit in (("tokens_per_s", "tokens/s"),
+                      ("samples_per_s", "samples/s")):
+        vals = [r[key] for r in steps
+                if isinstance(r.get(key), (int, float))]
+        if vals:
+            lines.append(f"throughput  mean {sum(vals) / len(vals):,.1f} "
+                         f"{unit}   max {max(vals):,.1f} {unit}")
+    return times
+
+
+def _mfu_section(lines: list[str], meta: dict, device: dict,
+                 by_kind: dict, times: list[float]) -> None:
+    from distributed_model_parallel_tpu.utils.profiling import (
+        TPU_PEAK_FLOPS,
+        TPU_PEAK_HBM_BYTES,
+        match_device_kind,
+    )
+
+    lines.append("== efficiency ==")
+    kind = device.get("device_kind", "") or device.get("platform", "?")
+    n_dev = max(1, int(device.get("n_devices", 1) or 1))
+    peak = match_device_kind(TPU_PEAK_FLOPS, kind=kind)
+    # Global analytic FLOPs (trainer/LM-bench meta) or per-device
+    # cost-analysis FLOPs (CNN bench "cost_analysis" record).
+    flops_global = meta.get("model_flops_per_step")
+    ca = (by_kind.get("cost_analysis") or [{}])[-1]
+    flops_device = ca.get("device_flops_per_step")
+    if not times:
+        lines.append("MFU unavailable (no step-time records)")
+    elif peak is None:
+        lines.append(f"MFU unavailable (no peak-FLOPs table entry for "
+                     f"device_kind={kind!r} — expected on CPU)")
+    elif not (flops_global or flops_device):
+        lines.append("MFU unavailable (run recorded no FLOPs-per-step; the "
+                     "LM trainer and bench.py record them)")
+    else:
+        t50 = percentile(times, 50)
+        per_chip = (flops_device if flops_device
+                    else flops_global / n_dev)
+        lines.append(f"MFU {per_chip / t50 / peak:.3f}  "
+                     f"({per_chip / 1e12:.2f} TF/chip/step at p50 "
+                     f"{_fmt_s(t50)} vs {peak / 1e12:.0f} TF/s peak "
+                     f"[{kind}])")
+    hbm_peak = match_device_kind(TPU_PEAK_HBM_BYTES, kind=kind)
+    bytes_step = ca.get("bytes_accessed_per_step")
+    if bytes_step and times and hbm_peak:
+        rate = bytes_step / percentile(times, 50)
+        lines.append(
+            f"HBM roofline: demand {rate / 1e9:.0f} GB/s vs "
+            f"{hbm_peak / 1e9:.0f} GB/s peak ({rate / hbm_peak:.2f}x) — "
+            f"demand-side estimate, >1.0 means VMEM reuse, not impossible "
+            f"DMA")
+    elif bytes_step:
+        lines.append("HBM roofline unavailable (no peak-bandwidth entry "
+                     f"for device_kind={kind!r})")
+
+
+def _comm_section(lines: list[str], by_kind: dict) -> None:
+    snaps = by_kind.get("metrics") or []
+    counters = snaps[-1].get("counters", {}) if snaps else {}
+    comm = {k: v for k, v in counters.items()
+            if k.startswith("collective_wire_bytes_est")}
+    lines.append("== communication (trace-time estimates, per compile) ==")
+    if not comm:
+        lines.append("(no collective traffic recorded)")
+    else:
+        for key in sorted(comm):
+            tags = key[key.index("{") + 1:-1]
+            traces = counters.get(f"collective_traces{{{tags}}}", 0)
+            lines.append(f"{tags:40s} {_fmt_bytes(comm[key]):>12s} wire "
+                         f"({traces:.0f} traces)")
+    n_compiles = counters.get("jax_compiles")
+    if n_compiles is not None:
+        secs = counters.get("jax_compile_seconds", 0.0)
+        lines.append(f"compilations: {n_compiles:.0f} "
+                     f"({secs:.1f}s total backend compile time)")
+
+
+def _memory_section(lines: list[str], by_kind: dict) -> None:
+    mems = by_kind.get("memory") or []
+    if not mems:
+        return
+    lines.append("== device memory ==")
+    peak_by_dev: dict = {}
+    for rec in mems:
+        for d in rec.get("devices", []):
+            cur = peak_by_dev.get(d.get("id"), 0)
+            peak_by_dev[d.get("id")] = max(
+                cur, d.get("peak_bytes_in_use", d.get("bytes_in_use", 0)))
+    for dev_id, peak in sorted(peak_by_dev.items()):
+        lines.append(f"device {dev_id}: peak {_fmt_bytes(peak)} in use")
+
+
+def _trace_section(lines: list[str], trace_dir: str, top: int) -> None:
+    from distributed_model_parallel_tpu.utils import xplane
+
+    lines.append(f"== xplane trace ({trace_dir}) ==")
+    try:
+        xplane._pb2()
+    except xplane.XplaneProtosUnavailable as e:
+        lines.append(f"trace analysis skipped: {e}")
+        return
+    try:
+        plane = xplane.device_plane(xplane.load_xspace(trace_dir))
+    except (FileNotFoundError, ValueError) as e:
+        lines.append(f"trace analysis skipped: {e}")
+        return
+    mods = xplane.module_events(plane)
+    rows = xplane.exclude_envelopes(xplane.op_breakdown(plane))
+    mod_s = sum(m.duration_ps for m in mods) / 1e12
+    lines.append(f"{len(mods)} module executions, {mod_s:.4f}s device time")
+    for cat, sec in xplane.category_totals(rows).items():
+        lines.append(f"  {cat:24s} {sec * 1e3:10.2f} ms")
+    lines.append(f"top {top} ops:")
+    for r in rows[:top]:
+        lines.append(f"  {r.total_ps / 1e9:9.3f} ms x{r.count:6d} "
+                     f"{r.category:18s} {r.name}")
+
+
+def build_report(records: list[dict], *, trace_dir: str | None = None,
+                 top: int = 15) -> str:
+    """Render the report text for one telemetry stream."""
+    by_kind = _by_kind(records)
+    lines: list[str] = []
+
+    starts = by_kind.get("run_start") or [{}]
+    start = starts[-1]
+    device = start.get("device", {}) or {}
+    meta = start.get("meta", {}) or {}
+    lines.append("== run ==")
+    lines.append(
+        f"run {start.get('run', '?')}   device "
+        f"{device.get('platform', '?')} x{device.get('n_devices', '?')} "
+        f"({device.get('device_kind', '?')})   jax {start.get('jax', '?')}")
+    if meta:
+        lines.append("meta " + " ".join(
+            f"{k}={v}" for k, v in sorted(meta.items())
+            if not isinstance(v, (dict, list))))
+    for f in by_kind.get("failure", []):
+        lines.append(f"FAILURE: {f.get('error')} — {f.get('detail', '')}")
+
+    steps = by_kind.get("step", [])
+    times = _steps_section(lines, steps)
+    _mfu_section(lines, meta, device, by_kind, times)
+    _comm_section(lines, by_kind)
+    _memory_section(lines, by_kind)
+
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        lines.append(f"== epochs ({len(epochs)}) ==")
+        last = epochs[-1]
+        keys = [k for k in ("epoch", "loss_train", "acc1_train", "loss_val",
+                            "acc1_val", "time_per_batch", "tokens_per_s")
+                if last.get(k) is not None]
+        lines.append("last: " + "  ".join(
+            f"{k}={last[k]:.4g}" if isinstance(last[k], float)
+            else f"{k}={last[k]}" for k in keys))
+
+    ends = by_kind.get("run_end")
+    if ends:
+        lines.append(f"run wall time: {ends[-1].get('wall_s', 0):.1f}s")
+    else:
+        lines.append("(no run_end record — run still in flight or killed)")
+
+    if trace_dir:
+        _trace_section(lines, trace_dir, top)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="Render a run report from a telemetry JSONL stream")
+    p.add_argument("jsonl", help="telemetry stream (RunLogger's "
+                                 "{log_dir}/{name}.jsonl or DMP_TELEMETRY)")
+    p.add_argument("--trace", default=None,
+                   help="xplane trace directory (utils/xplane.trace_to / "
+                        "jax.profiler.start_trace) to join in")
+    p.add_argument("--top", type=int, default=15,
+                   help="top device ops to print from the trace")
+    args = p.parse_args(argv)
+    if not os.path.exists(args.jsonl):
+        raise SystemExit(f"no such telemetry file: {args.jsonl}")
+    records = read_records(args.jsonl)
+    if not records:
+        raise SystemExit(f"{args.jsonl} holds no parseable records")
+    print(build_report(records, trace_dir=args.trace, top=args.top))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,299 @@
+"""utils/telemetry.py: registry semantics, the JSONL event stream, the
+collectives comm accounting, trainer integration on a tiny CPU run, and a
+scripts/dmp_report.py smoke test over the resulting stream.
+
+Also pins the bench.py failure contract (ISSUE 1 acceptance): with
+JAX_PLATFORMS pointed at an unreachable backend, bench.py must exit 0 with
+ONE parseable JSON failure record on stdout — no traceback.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.utils import telemetry
+from tests.conftest import tiny_train_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_dmp_report():
+    spec = importlib.util.spec_from_file_location(
+        "dmp_report", os.path.join(REPO, "scripts", "dmp_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("steps")
+    c.inc()
+    c.inc(2.5)
+    assert reg.counter("steps").value == 3.5       # same object by key
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("lr")
+    g.set(0.4)
+    assert reg.gauge("lr").value == 0.4
+
+    h = reg.histogram("t", bounds=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["min"] == 0.05 and snap["max"] == 5.0
+    assert snap["sum"] == pytest.approx(6.05)
+    # p50 must land in the (0.1, 1.0] bucket that holds the two 0.5s.
+    assert 0.1 <= snap["p50"] <= 1.0
+
+
+def test_histogram_single_sample_reports_sample():
+    h = telemetry.Histogram(bounds=[1.0, 10.0])
+    h.observe(3.0)
+    # Clamped to observed min/max — not a bucket bound.
+    assert h.percentile(50) == pytest.approx(3.0)
+    assert h.percentile(99) == pytest.approx(3.0)
+
+
+def test_tags_key_separate_metrics_and_type_conflicts_raise():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("bytes", axis="data").inc(10)
+    reg.counter("bytes", axis="stage").inc(20)
+    snap = reg.snapshot()
+    assert snap["counters"]["bytes{axis=data}"] == 10
+    assert snap["counters"]["bytes{axis=stage}"] == 20
+    with pytest.raises(telemetry.AlreadyRegisteredError):
+        reg.gauge("bytes", axis="data")
+    reg.reset()
+    assert reg.snapshot() == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_wire_bytes_estimates():
+    # Ring-algorithm cost model: allreduce 2(n-1)/n, gather/scatter (n-1)/n,
+    # ppermute the full shard.
+    assert telemetry.wire_bytes_estimate("psum", 800, 8) == \
+        pytest.approx(2 * 7 / 8 * 800)
+    assert telemetry.wire_bytes_estimate("all_gather", 800, 8) == \
+        pytest.approx(7 / 8 * 800)
+    assert telemetry.wire_bytes_estimate("ppermute", 800, 8) == 800
+
+
+def test_record_collective_never_raises_on_tracers():
+    # A dynamic axis size (tracer) must skip the sample, not break tracing.
+    class NotAnInt:
+        def __int__(self):
+            raise TypeError("traced")
+
+    telemetry.record_collective("psum", "data", 100, NotAnInt())
+
+
+# ---------------------------------------------------------------------------
+# Event stream round trip
+# ---------------------------------------------------------------------------
+
+def test_jsonl_round_trip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    reg = telemetry.MetricsRegistry()
+    run = telemetry.TelemetryRun(path, run="unit", meta={"batch_size": 32},
+                                 registry_=reg, track_compiles=False)
+    # numpy scalars must coerce to JSON floats.
+    run.step(epoch=0, step=1, loss=np.float32(2.5), step_time_s=0.01,
+             samples_per_s=3200.0)
+    run.event("preemption requested")
+    reg.counter("jax_compiles").inc(3)
+    run.finish(epochs_run=1)
+    run.finish()                       # idempotent: one run_end only
+
+    records = telemetry.read_records(path)
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["run_start", "step", "event", "metrics", "run_end"]
+    start, step, event, metrics, end = records
+    assert start["meta"]["batch_size"] == 32
+    assert "device" in start and "ts" in start
+    assert step["loss"] == 2.5 and isinstance(step["loss"], float)
+    assert step["samples_per_s"] == 3200.0
+    assert event["message"] == "preemption requested"
+    assert metrics["counters"]["jax_compiles"] == 3
+    assert end["epochs_run"] == 1 and end["wall_s"] >= 0
+
+
+def test_metrics_counters_are_deltas_since_stream_open(tmp_path):
+    # The registry is process-global: a second run in the same process
+    # must not re-report the first run's comm volume / compile counts.
+    reg = telemetry.MetricsRegistry()
+    reg.counter("jax_compiles").inc(5)          # "previous run"
+    run = telemetry.TelemetryRun(str(tmp_path / "r2.jsonl"), run="second",
+                                 registry_=reg, track_compiles=False)
+    reg.counter("jax_compiles").inc(2)          # this run's compiles
+    run.step(step=0, step_time_s=0.25)          # feeds the histogram too
+    run.finish()
+    records = telemetry.read_records(run.path)
+    (metrics,) = [r for r in records if r["kind"] == "metrics"]
+    assert metrics["counters"]["jax_compiles"] == 2
+    assert metrics["histograms"]["step_time_s"]["count"] == 1
+
+
+def test_read_records_skips_truncated_tail(tmp_path):
+    path = tmp_path / "r.jsonl"
+    path.write_text('{"ts": 1, "kind": "step"}\n{"ts": 2, "ki')
+    (rec,) = telemetry.read_records(str(path))
+    assert rec["kind"] == "step"
+
+
+# ---------------------------------------------------------------------------
+# Collectives accounting (trace-time, tagged by mesh axis)
+# ---------------------------------------------------------------------------
+
+def test_psum_mean_records_comm_volume(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.collectives import psum_mean
+
+    telemetry.registry().reset()
+    x = jnp.arange(32, dtype=jnp.float32)
+
+    f = jax.shard_map(lambda v: psum_mean(v, "data"), mesh=mesh8.mesh,
+                      in_specs=P("data"), out_specs=P("data"))
+    jax.jit(f)(x).block_until_ready()
+
+    snap = telemetry.registry().snapshot()["counters"]
+    key = "collective_wire_bytes_est{axis=data,kind=psum}"
+    # Per-shard payload is 4 floats = 16 bytes; ring allreduce moves
+    # 2*(8-1)/8 of it. Counted at least once (trace time).
+    assert snap[key] >= 2 * 7 / 8 * 16
+    assert snap["collective_traces{axis=data,kind=psum}"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration + report CLI smoke (tiny CPU runs)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained_stream(tmp_path_factory):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    tmp_path = tmp_path_factory.mktemp("telemetry_run")
+    cfg = tiny_train_config(tmp_path, epochs=1, log_every_n_steps=1)
+    t = Trainer(cfg)
+    t.fit(1)
+    return t.logger.jsonl_path
+
+
+def test_trainer_writes_telemetry_stream(trained_stream):
+    records = telemetry.read_records(trained_stream)
+    by_kind = {}
+    for r in records:
+        by_kind.setdefault(r["kind"], []).append(r)
+    assert by_kind["run_start"][0]["meta"]["workload"] == "cnn"
+    assert by_kind["run_start"][0]["device"]["platform"] == "cpu"
+    # Step records carry timing + throughput keys (ISSUE 1 acceptance).
+    steps = by_kind["step"]
+    assert steps, "no step records in the stream"
+    for rec in steps:
+        assert isinstance(rec["step_time_s"], float)
+        assert isinstance(rec["data_time_s"], float)
+        assert isinstance(rec["samples_per_s"], float)
+    assert by_kind["epoch"][-1]["loss_train"] is not None
+    # run_end preceded by the registry snapshot; compile tracking counted
+    # the jitted step compilations.
+    assert by_kind["metrics"][-1]["counters"].get("jax_compiles", 0) >= 1
+    assert by_kind["run_end"][-1]["epochs_run"] == 1
+
+
+def test_dmp_report_renders_cpu_run(trained_stream):
+    dmp_report = _load_dmp_report()
+    records = telemetry.read_records(trained_stream)
+    text = dmp_report.build_report(records)
+    assert "p50" in text and "p99" in text
+    assert "samples/s" in text
+    # On CPU the peak tables have no entry: the report must say MFU is
+    # unavailable, not fabricate a number.
+    assert "MFU unavailable" in text
+    assert "run wall time" in text
+
+
+def test_dmp_report_cli_main(trained_stream, capsys):
+    dmp_report = _load_dmp_report()
+    dmp_report.main([trained_stream])
+    out = capsys.readouterr().out
+    assert "== steps" in out and "MFU unavailable" in out
+
+
+def test_dmp_report_computes_mfu_when_peak_known():
+    dmp_report = _load_dmp_report()
+    records = [
+        {"ts": 0, "kind": "run_start", "run": "lm",
+         "device": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                    "n_devices": 1},
+         "meta": {"model_flops_per_step": 1.97e12}},
+        {"ts": 1, "kind": "step", "step": 0, "step_time_s": 0.1,
+         "tokens_per_s": 1000.0},
+    ]
+    text = dmp_report.build_report(records)
+    # 1.97e12 flops / 0.1 s / 197e12 peak = 0.100
+    assert "MFU 0.100" in text
+
+
+def test_lm_trainer_stream_has_tokens_and_flops(tmp_path):
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+
+    cfg = LMTrainConfig(
+        model=tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                    n_layers=1, d_ff=64, max_seq_len=16),
+        batch_size=4, seq_len=16, steps_per_epoch=2, epochs=1,
+        n_tokens=2000, eval_batches=0,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    t = LMTrainer(cfg)
+    t.fit(1)
+    records = telemetry.read_records(t.logger.jsonl_path)
+    start = [r for r in records if r["kind"] == "run_start"][0]
+    assert start["meta"]["model_flops_per_step"] > 0
+    steps = [r for r in records if r["kind"] == "step"]
+    assert len(steps) == 2
+    for rec in steps:
+        assert rec["tokens_per_s"] > 0 and rec["step_time_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bench.py failure contract
+# ---------------------------------------------------------------------------
+
+def test_bench_unreachable_backend_emits_json_failure_record():
+    # "cuda" fails fast in this image (no GPU plugin) while exercising the
+    # exact unreachable-backend path; JAX_PLATFORMS=tpu also lands here but
+    # libtpu's own metadata retries make it minutes-slow.
+    env = dict(os.environ,
+               JAX_PLATFORMS="cuda",
+               DMP_BENCH_RETRIES="2",
+               DMP_BENCH_RETRY_DELAY_S="0.05")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE json record, got: {proc.stdout!r}"
+    rec = json.loads(lines[0])
+    assert rec["error"] == "tpu-unreachable"
+    assert rec["attempts"] == 2
+    assert rec["value"] is None
+    assert "Traceback" not in proc.stdout
