@@ -48,79 +48,30 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def contact_devices(max_attempts: int | None = None,
-                    delay_s: float | None = None):
-    """First device contact, hardened: bounded retry with exponential
-    backoff, returning the device list or None after permanent failure.
-
-    The round-5 TPU-tunnel outage turned ``jax.devices()`` into a raw
-    ``JaxRuntimeError`` traceback the driver could not parse (VERDICT weak
-    #1). Transient tunnel drops are worth retrying; a permanently absent
-    backend must become a structured failure record (see ``_emit_failure``),
-    not a stack trace. Knobs: DMP_BENCH_RETRIES (default 5),
-    DMP_BENCH_RETRY_DELAY_S (default 2.0, doubling per attempt).
-    """
-    if max_attempts is None:
-        max_attempts = int(os.environ.get("DMP_BENCH_RETRIES", "5"))
-    if delay_s is None:
-        delay_s = float(os.environ.get("DMP_BENCH_RETRY_DELAY_S", "2.0"))
-    last: Exception | None = None
-    for attempt in range(max(1, max_attempts)):
-        try:
-            devs = jax.devices()
-            # A device listing can succeed while the transport is dead;
-            # prove liveness with one tiny round trip.
-            jnp.zeros(()).block_until_ready()
-            return devs
-        except Exception as e:      # noqa: BLE001 - anything here is fatal
-            last = e
-            first_line = (str(e).splitlines() or [""])[0][:200]
-            _log(f"device contact attempt {attempt + 1}/{max_attempts} "
-                 f"failed: {type(e).__name__}: {first_line}")
-            try:
-                # jax caches a failed backend init; clear so the retry
-                # actually re-dials instead of replaying the cached error.
-                from jax.extend import backend as _backend
-
-                _backend.clear_backends()
-            except Exception:
-                pass
-            if attempt < max_attempts - 1:
-                time.sleep(delay_s)
-                delay_s *= 2
-    contact_devices.last_error = last
-    return None
+# First device contact, hardened (bounded retry + backoff; see
+# utils/device_contact.py — extracted from here in PR 2 so the training
+# drivers share the exact same failure contract). The historical
+# DMP_BENCH_RETRIES / DMP_BENCH_RETRY_DELAY_S env knobs keep working.
+from distributed_model_parallel_tpu.utils.device_contact import (  # noqa: E402
+    contact_devices,
+)
 
 
 def _emit_failure(stage: str, err: Exception | None, attempts: int) -> None:
     """One parseable JSON failure record on stdout, rc=0 semantics: the
     driver ingests ``{"error": "tpu-unreachable", ...}`` instead of a
-    traceback; ``value: null`` marks that no measurement exists. The same
-    failure also lands in the run's telemetry stream (best-effort — stream
-    I/O must never turn an outage report into a crash)."""
-    detail = f"{type(err).__name__}: {err}" if err is not None else ""
-    # stdout record FIRST: the driver must get the parseable line promptly;
-    # the telemetry append is bookkeeping after the fact.
-    print(json.dumps({
-        "error": "tpu-unreachable",
-        "stage": stage,
-        "attempts": attempts,
-        "detail": detail[:500],
-        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
-        "ts": time.time(),
-        "metric": None,
-        "value": None,
-    }), flush=True)
-    try:
-        # device override: writing the header must not re-dial the dead
-        # backend (device_info() would re-init it — minutes under libtpu).
-        t = _telemetry_run("failure", dict(stage=stage),
-                           device={"error": detail[:200] or "unreachable"})
-        t.failure("tpu-unreachable", stage=stage, attempts=attempts,
-                  detail=detail[:500])
-        t.finish()
-    except Exception:
-        pass
+    traceback; ``value: null`` marks that no measurement exists. Shared
+    with the training drivers (utils/device_contact.emit_unreachable);
+    bench keeps its historical telemetry path + run naming."""
+    from distributed_model_parallel_tpu.utils.device_contact import (
+        emit_unreachable,
+    )
+
+    emit_unreachable(
+        stage, err, attempts,
+        telemetry_path=os.environ.get(
+            "DMP_TELEMETRY", "/tmp/dmp_bench_log/bench_telemetry.jsonl"),
+        run_name="bench-failure")
 
 
 def _telemetry_run(workload: str, meta: dict, device: dict | None = None):
@@ -447,7 +398,7 @@ def main() -> None:
     if devs is None:
         _emit_failure("device-contact",
                       getattr(contact_devices, "last_error", None),
-                      int(os.environ.get("DMP_BENCH_RETRIES", "5")))
+                      getattr(contact_devices, "attempts", 0))
         return
     _log(f"devices: {devs}")
     _log(f"device ready after {time.perf_counter() - t_start:.1f}s")

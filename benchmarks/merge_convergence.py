@@ -26,6 +26,13 @@ def blocks(lines):
     block is preserved, not discarded."""
     out, cur = [], []
     for rec in lines:
+        # The jsonl stream carries typed records (utils/telemetry.py) and
+        # per-STEP records also hold an "epoch" key — only true epoch
+        # records qualify. Legacy pre-telemetry streams had no "kind";
+        # their epoch records are the ones carrying loss_train.
+        kind = rec.get("kind") or ("epoch" if "loss_train" in rec else None)
+        if kind != "epoch":
+            continue
         e = rec.get("epoch")
         if e is None:
             continue

@@ -138,6 +138,43 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RecoveryConfig:
+    """Automatic failure recovery (train/resilience.RecoverySupervisor).
+
+    Default-off (``max_retries=0``): every detection keeps its historical
+    fail-fast behavior. With ``max_retries > 0`` the supervisor maintains a
+    per-epoch "last good" checkpoint slot and, on a non-finite loss/params
+    detection (requires ``check_finite_every > 0``), restores it, optionally
+    shrinks the learning rate, and retries the epoch — up to the budget.
+    Restores verify the per-checkpoint integrity manifest and fall back to
+    the previous committed version when the newest is torn
+    (train/checkpoint.Checkpointer.restore ``allow_fallback``).
+    """
+
+    # Bounded retry budget for restore-and-resume recoveries; 0 disables the
+    # supervisor (detections raise, as before).
+    max_retries: int = 0
+    # Multiply the learning rate by this factor on every non-finite recovery
+    # (1.0 = keep it). Trainers that cannot rebuild their optimizer mid-run
+    # reject values != 1.0 loudly — no silent ignores.
+    lr_shrink: float = 1.0
+    # Committed checkpoint versions retained per slot (Checkpointer keep-K):
+    # >= 2 gives torn-newest restores something to fall back to.
+    keep_checkpoints: int = 2
+    # Escalate a stall-budget overrun (see TrainConfig.stall_budget_s) to a
+    # graceful checkpoint-and-exit instead of only logging. The watchdog's
+    # periodic "still blocked" lines appear either way.
+    stall_exit: bool = False
+    # Watchdog log cadence while a sync is blocked (None = budget/2, capped
+    # to [0.05s, 30s]).
+    watchdog_interval_s: float | None = None
+    # Deterministic fault-injection plan (utils/faults.py): FaultSpec
+    # entries or "kind@at[:param]" strings, e.g. ("nan_loss@1",). Empty =
+    # no chaos.
+    faults: Sequence[Any] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Top-level run configuration."""
 
@@ -177,6 +214,10 @@ class TrainConfig:
     # forever on dist.recv, distributed_layers.py:20).
     check_finite_every: int = 0
     stall_budget_s: float | None = None
+    # Automatic recovery policy + fault-injection plan
+    # (train/resilience.py, utils/faults.py). Off by default.
+    recovery: RecoveryConfig = dataclasses.field(
+        default_factory=RecoveryConfig)
     # Device-resident fast path (gspmd strategy): upload the train set to the
     # accelerators once and run steps_per_dispatch train steps per jitted
     # program (lax.scan over on-device index gathers) — amortizes dispatch

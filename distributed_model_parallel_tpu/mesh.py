@@ -38,10 +38,17 @@ def best_effort_distributed_init() -> bool:
     ``jax.distributed.initialize()`` from the cluster environment. Returns True
     if a multi-process runtime was initialized.
     """
-    if jax.process_count() > 1:
-        return True  # already initialized
     want = os.environ.get("DMP_TPU_DISTRIBUTED", "auto")
     if want == "0":
+        return False
+    try:
+        if jax.process_count() > 1:
+            return True  # already initialized
+    except Exception as e:
+        # Backend unreachable: don't traceback out of the probe — the
+        # caller's hardened device contact (utils/device_contact.py)
+        # owns the retry/parseable-failure-record policy.
+        logger.warning("backend probe failed during distributed init: %s", e)
         return False
     coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if want == "1" or coordinator:

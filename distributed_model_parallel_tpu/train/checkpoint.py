@@ -10,13 +10,92 @@ params, BN state, optimizer state, step and best-acc in one tree.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
-from typing import Any
+import time
+import zlib
+from typing import Any, Callable
 
 import jax
 import orbax.checkpoint as ocp
+
+from distributed_model_parallel_tpu.utils.faults import (
+    FaultInjector,
+    InjectedFaultError,
+    tear_checkpoint,
+)
+
+# Per-checkpoint integrity manifest, written into each version directory
+# once its save has committed: relative path -> {size, crc32} for every
+# file. A torn/truncated/partially-copied version fails verification and
+# ``restore(..., allow_fallback=True)`` skips it. Absence of a manifest is
+# "unverifiable" (legacy / foreign checkpoint), not "bad".
+MANIFEST_FILENAME = "dmp_manifest.json"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """No committed checkpoint version survived verification/restore."""
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def write_manifest(path: str) -> str:
+    """Write the integrity manifest for a committed checkpoint directory
+    (atomic: temp file + rename). Returns the manifest path."""
+    entries: dict[str, dict] = {}
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            if fn == MANIFEST_FILENAME:
+                continue
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, path)
+            entries[rel] = {"size": os.path.getsize(p),
+                            "crc32": _file_crc32(p)}
+    out = os.path.join(path, MANIFEST_FILENAME)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"created": time.time(), "files": entries}, f)
+    os.replace(tmp, out)
+    return out
+
+
+def verify_manifest(path: str) -> str | None:
+    """Check a checkpoint directory against its manifest.
+
+    Returns ``None`` when every recorded file matches (size + crc32),
+    ``"missing"`` when there is no manifest to check (unverifiable, not
+    necessarily bad), and a human-readable mismatch reason otherwise.
+    """
+    mpath = os.path.join(path, MANIFEST_FILENAME)
+    if not os.path.exists(mpath):
+        return "missing"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (json.JSONDecodeError, KeyError, OSError) as e:
+        return f"unreadable manifest: {type(e).__name__}"
+    for rel, want in files.items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            return f"missing file {rel}"
+        size = os.path.getsize(p)
+        if size != want["size"]:
+            return (f"size mismatch on {rel} "
+                    f"({size} != {want['size']} bytes)")
+        if _file_crc32(p) != want["crc32"]:
+            return f"checksum mismatch on {rel}"
+    return None
 
 
 class Checkpointer:
@@ -27,18 +106,37 @@ class Checkpointer:
     the step after a checkpoint no longer stalls behind filesystem writes.
 
     Crash safety: each save writes a fresh ``{name}-{v}`` directory (orbax
-    commits it with an atomic rename); the previous version is pruned only at
-    the *next* save, after confirming the newer one committed. So there is
-    never a moment with zero committed checkpoints on disk, and a reader in
-    another process sees whichever version last committed. ``restore`` /
-    ``exists`` resolve to the newest committed version (falling back to a
-    bare legacy ``{name}`` directory).
+    commits it with an atomic rename); older versions are pruned only at the
+    *next* save, after confirming the newer one committed, and the newest
+    ``keep`` committed versions are retained per slot. So there is never a
+    moment with zero committed checkpoints on disk, and a reader in another
+    process sees whichever version last committed. ``restore`` / ``exists``
+    resolve to the newest committed version (falling back to a bare legacy
+    ``{name}`` directory).
+
+    Integrity: once a save commits, an integrity manifest (file sizes +
+    crc32 checksums) is written into the version directory.
+    ``restore(..., allow_fallback=True)`` verifies each candidate version
+    against its manifest (and survives a restore-time failure on
+    manifest-less versions) and falls back to the previous committed
+    version — the torn-newest-checkpoint recovery path
+    (train/resilience.py).
+
+    ``injector`` (utils/faults.py) is the chaos hook: ``save_fail`` /
+    ``tear_save`` faults fire at their planned occurrence of the ``save``
+    site. Disabled injectors cost one no-op poll per save.
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, *, keep: int = 2,
+                 injector: FaultInjector | None = None):
         self.directory = os.path.abspath(directory)
+        self.keep = max(1, int(keep))
         os.makedirs(self.directory, exist_ok=True)
         self._ckpt = ocp.StandardCheckpointer()
+        self._injector = injector
+        # Version paths whose manifest still needs writing once the
+        # (possibly asynchronous) save commits.
+        self._pending_manifest: list[str] = []
 
     def _path(self, name: str, version: int | None = None) -> str:
         leaf = name if version is None else f"{name}-{version}"
@@ -62,12 +160,22 @@ class Checkpointer:
         legacy = self._path(name)
         return legacy if os.path.exists(legacy) else None
 
+    def _candidate_paths(self, name: str) -> list[str]:
+        """Restore candidates, newest committed version first, legacy bare
+        directory last."""
+        out = [self._path(name, v)
+               for v in sorted(self._versions(name), reverse=True)]
+        legacy = self._path(name)
+        if os.path.exists(legacy):
+            out.append(legacy)
+        return out
+
     def save(self, tree: Any, name: str = "ckpt", *, force: bool = True,
              wait: bool = True) -> str:
         del force  # kept for API compatibility; versioning never overwrites
-        self._ckpt.wait_until_finished()  # the previous save has committed...
+        self.wait_until_finished()  # the previous save has committed...
         versions = self._versions(name)
-        for v in versions[:-1]:           # ...so all but the newest can go
+        for v in versions[:-self.keep]:   # ...keep the newest K, prune older
             shutil.rmtree(self._path(name, v), ignore_errors=True)
         if versions and os.path.exists(self._path(name)):
             # A versioned save has committed, so a bare legacy `{name}` dir
@@ -75,25 +183,82 @@ class Checkpointer:
             shutil.rmtree(self._path(name), ignore_errors=True)
         next_v = versions[-1] + 1 if versions else 0
         path = self._path(name, next_v)
+        faults = (self._injector.poll("save")
+                  if self._injector is not None else [])
+        if any(s.kind == "save_fail" for s in faults):
+            # Die "mid-write": a torn version directory appears committed
+            # to the version scan but holds no restorable checkpoint.
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "_DMP_TORN"), "w") as f:
+                f.write("injected save failure\n")
+            raise InjectedFaultError(f"injected save failure for {path}")
+        tear = any(s.kind == "tear_save" for s in faults)
         self._ckpt.save(path, tree)
-        if wait:
-            self._ckpt.wait_until_finished()
+        self._pending_manifest.append(path)
+        if wait or tear:
+            self.wait_until_finished()
+        if tear:
+            tear_checkpoint(path)
         return path
 
     def wait_until_finished(self) -> None:
-        """Block until any asynchronous save has fully committed."""
+        """Block until any asynchronous save has fully committed, then
+        write the integrity manifests for the newly committed versions."""
         self._ckpt.wait_until_finished()
+        while self._pending_manifest:
+            path = self._pending_manifest.pop()
+            if os.path.isdir(path):
+                write_manifest(path)
 
-    def restore(self, target: Any, name: str = "ckpt") -> Any:
+    def restore(self, target: Any, name: str = "ckpt", *,
+                allow_fallback: bool = False,
+                on_fallback: Callable[[str, str], None] | None = None) -> Any:
         """Restore the newest committed version into the structure/shardings
         of ``target`` (an abstract or concrete pytree). Raises
-        FileNotFoundError if absent."""
+        FileNotFoundError if absent.
+
+        With ``allow_fallback=True`` each candidate version (newest first)
+        is verified against its integrity manifest before the restore is
+        attempted, and a torn/corrupt/unrestorable version is skipped in
+        favor of the previous committed one; ``on_fallback(path, reason)``
+        observes every rejection (the supervisor turns it into
+        failure/recovery telemetry). CheckpointIntegrityError when no
+        version survives.
+        """
         self.wait_until_finished()
-        path = self._latest_path(name)
-        if path is None:
+        candidates = self._candidate_paths(name)
+        if not candidates:
             raise FileNotFoundError(self._path(name))
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
-        return self._ckpt.restore(path, abstract)
+        if not allow_fallback:
+            return self._ckpt.restore(candidates[0], abstract)
+        rejected: list[tuple[str, str]] = []
+        for path in candidates:
+            reason = verify_manifest(path)
+            if reason is None:
+                # Verified intact: a restore error here is a template /
+                # structure problem (e.g. resuming under a different
+                # config), not corruption — an older version of the same
+                # run can't fix that, so fail fast with orbax's error.
+                return self._ckpt.restore(path, abstract)
+            if reason != "missing":
+                rejected.append((path, reason))
+                if on_fallback is not None:
+                    on_fallback(path, reason)
+                continue
+            # Unverifiable (no manifest — legacy or foreign checkpoint):
+            # attempt the restore and treat failure as a torn version.
+            try:
+                return self._ckpt.restore(path, abstract)
+            except Exception as e:  # noqa: BLE001 - fall back on any failure
+                detail = f"restore failed: {type(e).__name__}: {e}"
+                rejected.append((path, detail))
+                if on_fallback is not None:
+                    on_fallback(path, detail)
+        raise CheckpointIntegrityError(
+            f"no restorable version of {name!r} in {self.directory}: "
+            + "; ".join(f"{os.path.basename(p)} ({r[:160]})"
+                        for p, r in rejected))
 
     def restore_subtree(self, target: Any, name: str = "ckpt") -> Any:
         """Restore only the top-level keys present in ``target`` (a dict),

@@ -14,11 +14,15 @@ placement drift — which these guards check cheaply:
   can check it directly).
 * ``check_finite`` — raises on NaN/Inf in a pytree (e.g. loss explosion),
   replacing silent divergence with a loud failure; cheap enough to run every
-  N steps.
-* ``StallDetector`` — a watchdog flagging steps that exceed a wall-clock
-  budget (the observable symptom of a wedged collective/hardware hang, which
-  in the reference just blocks forever on ``dist.recv``,
-  ``distributed_layers.py:20``).
+  N steps. The whole pytree is fetched with ONE ``jax.device_get`` (one host
+  sync total, not one per leaf) and the scan raises at the first non-finite
+  leaf.
+* ``StallDetector`` — the original post-hoc step-budget flag, kept for
+  standalone use. The trainers now run the *live*
+  ``train/resilience.Watchdog`` instead: it logs "still blocked after Ns"
+  lines while the sync is still wedged (the observable symptom of a dead
+  collective, which in the reference just blocks forever on ``dist.recv``,
+  ``distributed_layers.py:20``) and can escalate to checkpoint-and-exit.
 """
 
 from __future__ import annotations
@@ -59,9 +63,18 @@ class NonFiniteError(FloatingPointError):
 
 
 def check_finite(tree: Any, *, name: str = "tree") -> None:
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        arr = np.asarray(jax.device_get(leaf))
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    if not flat:
+        return
+    # ONE device->host fetch for the whole tree: per-leaf device_get would
+    # pay one blocking round trip per leaf (hundreds for a real model, each
+    # a full tunnel RTT on remote-device transports).
+    host = jax.device_get([leaf for _path, leaf in flat])
+    for (path, _leaf), arr in zip(flat, host):
+        arr = np.asarray(arr)
         if not np.isfinite(arr).all():
+            # Short-circuit on the first bad leaf — no point scanning the
+            # rest of an already-condemned tree.
             raise NonFiniteError(
                 f"{name}{jax.tree_util.keystr(path)} contains "
                 f"{np.isnan(arr).sum()} NaN / {np.isinf(arr).sum()} Inf values")
@@ -107,17 +120,35 @@ class GuardRunner:
     drained metrics window is checked (those values are already on host — the
     check is free), and every N steps the parameters are fetched and checked
     too (a device→host sync, hence the coarser, explicit cadence).
-    ``TrainConfig.stall_budget_s=S`` arms the StallDetector around every
-    blocking drain; an overrun logs loudly but does not raise — wall-clock
-    slowness can be transport noise, while NaN is always a bug.
+    ``TrainConfig.stall_budget_s=S`` arms a live
+    :class:`~distributed_model_parallel_tpu.train.resilience.Watchdog`
+    around every blocking drain: while the sync is still blocked it logs
+    "still blocked after Ns" lines, and an overrun flips ``stall.stalled``
+    and (when the recovery supervisor wires ``on_stall`` with
+    ``recovery.stall_exit``) escalates to a graceful checkpoint-and-exit —
+    it never raises mid-sync, because wall-clock slowness can be transport
+    noise while NaN is always a bug. ``injector`` serves planned ``stall``
+    faults inside the watched region (utils/faults.py).
     """
 
     def __init__(self, *, check_finite_every: int = 0,
-                 stall_budget_s: float | None = None, logger=None):
+                 stall_budget_s: float | None = None, logger=None,
+                 watchdog_interval_s: float | None = None,
+                 on_stall=None, injector=None):
         self.every = check_finite_every
-        self.stall = (StallDetector(stall_budget_s)
-                      if stall_budget_s else None)
+        if stall_budget_s:
+            from distributed_model_parallel_tpu.train.resilience import (
+                Watchdog,
+            )
+
+            self.stall = Watchdog(stall_budget_s,
+                                  interval_s=watchdog_interval_s,
+                                  logger=logger, on_escalate=on_stall)
+        else:
+            self.stall = None
         self.logger = logger
+        self.injector = (injector if injector is not None
+                         and injector.enabled else None)
         self._seen = 0
         self._next_params_check = check_finite_every
 
@@ -129,7 +160,7 @@ class GuardRunner:
         """Context manager wrapping a blocking sync point."""
         import contextlib
 
-        if self.stall is None:
+        if self.stall is None and self.injector is None:
             return contextlib.nullcontext()
         return self._watched()
 
@@ -138,15 +169,14 @@ class GuardRunner:
 
         @contextlib.contextmanager
         def ctx():
-            was_stalled = self.stall.stalled
-            with self.stall.step():
+            wd = (self.stall.watch("sync") if self.stall is not None
+                  else contextlib.nullcontext())
+            with wd:
+                if self.injector is not None:
+                    # Injected stalls sleep INSIDE the watched region, so
+                    # the watchdog observes them like a real wedged sync.
+                    self.injector.maybe_stall("sync")
                 yield
-            if self.stall.stalled and not was_stalled:
-                msg = (f"guard: sync exceeded the stall budget "
-                       f"({self.stall.worst_s:.1f}s > "
-                       f"{self.stall.budget_s:.1f}s)")
-                if self.logger is not None:
-                    self.logger.log_line(msg)
         return ctx()
 
     def after_sync(self, host_metrics: Any, n_steps: int,

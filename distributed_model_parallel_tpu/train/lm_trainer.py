@@ -16,7 +16,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributed_model_parallel_tpu.config import MeshConfig, OptimizerConfig
+from distributed_model_parallel_tpu.config import (
+    MeshConfig,
+    OptimizerConfig,
+    RecoveryConfig,
+)
 from distributed_model_parallel_tpu.mesh import MeshSpec, make_mesh
 from distributed_model_parallel_tpu.models import transformer as tfm
 from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
@@ -88,6 +92,10 @@ class LMTrainConfig:
     # Guards (train/guards.py:GuardRunner) — same semantics as TrainConfig.
     check_finite_every: int = 0
     stall_budget_s: float | None = None
+    # Automatic recovery policy + fault-injection plan — same semantics as
+    # TrainConfig.recovery (train/resilience.py, utils/faults.py).
+    recovery: RecoveryConfig = dataclasses.field(
+        default_factory=RecoveryConfig)
 
 
 class LMTrainer:
@@ -196,12 +204,26 @@ class LMTrainer:
                       pipeline_schedule=config.pipeline_schedule,
                       model_flops_per_step=lm_model_flops(
                           cfg, config.batch_size, config.seq_len)))
+        from distributed_model_parallel_tpu.train.resilience import (
+            RecoverySupervisor,
+        )
+        from distributed_model_parallel_tpu.utils.faults import FaultInjector
+
+        self.faults = FaultInjector(config.recovery.faults)
+        self.ckpt = Checkpointer(config.checkpoint_dir,
+                                 keep=config.recovery.keep_checkpoints,
+                                 injector=self.faults)
+        self.resilience = RecoverySupervisor(
+            config.recovery, logger=self.logger, ckpt=self.ckpt,
+            preemption=self.preemption, slot="lm-good", injector=self.faults,
+            check_finite_every=config.check_finite_every)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
             check_finite_every=config.check_finite_every,
-            stall_budget_s=config.stall_budget_s, logger=self.logger)
-        self.ckpt = Checkpointer(config.checkpoint_dir)
+            stall_budget_s=config.stall_budget_s, logger=self.logger,
+            watchdog_interval_s=config.recovery.watchdog_interval_s,
+            on_stall=self.resilience.on_stall, injector=self.faults)
         self.start_epoch = 0
         if config.resume and (self.ckpt.exists("lm")
                               or self.ckpt.exists("lm-preempt")):
@@ -280,7 +302,11 @@ class LMTrainer:
         # must never supersede a full-epoch save under versioning.
         name = self.ckpt.newest_name(("lm", "lm-preempt")) or "lm"
         try:
-            restored = self.ckpt.restore(self._ckpt_tree(), name)
+            # allow_fallback: skip a torn newest version (crash window /
+            # partial copy) for the previous committed one.
+            restored = self.ckpt.restore(
+                self._ckpt_tree(), name, allow_fallback=True,
+                on_fallback=self.resilience.note_fallback)
         except Exception:
             # Pre-round-5 checkpoints lack the virtual_stages marker and
             # orbax rejects a template with the extra leaf — retry with
@@ -302,79 +328,149 @@ class LMTrainer:
         self.opt_state = restored["opt_state"]
         self.start_epoch = int(restored["epoch"])
 
+    def _restore_good(self):
+        """Recovery restore from the supervisor's "last good" slot
+        (train/resilience.py), with torn-version fallback."""
+        restored = self.ckpt.restore(
+            self._ckpt_tree(), self.resilience.slot, allow_fallback=True,
+            on_fallback=self.resilience.note_fallback)
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+
+    def _apply_lr_shrink(self, factor: float) -> None:
+        """Recovery-time LR shrink: rebuild the optimizer and the jitted
+        train step at the scaled LR — opt_state structure is unchanged (the
+        schedule is a closure), so the restored state carries over."""
+        opt = dataclasses.replace(
+            self.config.optimizer,
+            learning_rate=self.config.optimizer.learning_rate * factor)
+        self.config = dataclasses.replace(self.config, optimizer=opt)
+        self.tx = make_optimizer(opt, self.config.steps_per_epoch,
+                                 self.config.epochs)
+        self._step = make_spmd_train_step(
+            self.cfg, self.spec, self.tx,
+            num_microbatches=self.config.num_microbatches,
+            schedule=self.config.pipeline_schedule,
+            virtual_stages=self.config.virtual_stages)
+
     # ----------------------------------------------------------------- loop
+    def _poll_step_faults(self, step_m):
+        """Serve planned step-site faults (utils/faults.py): poison this
+        step's loss or the live params, or request a simulated preemption.
+        Returns the (possibly poisoned) step metrics."""
+        from distributed_model_parallel_tpu.utils.faults import poison
+
+        for spec in self.faults.poll("step"):
+            if spec.kind == "preempt":
+                self.preemption.request()
+            elif spec.kind == "nan_loss":
+                step_m = poison(step_m)
+            elif spec.kind == "nan_params":
+                self.params = poison(self.params)
+        return step_m
+
+    def _train_one_epoch(self, epoch: int, epochs: int) -> dict | None:
+        """One training epoch + eval. Returns the history record, or None
+        when a preemption stopped the epoch mid-way (checkpoint already
+        written). Raises NonFiniteError through to fit()'s recovery path."""
+        meter = AverageMeter("loss")
+        drop_meter = AverageMeter("moe_drop")
+        timer = StepTimer()
+        tokens_per_step = (self.config.batch_size
+                           * self.config.seq_len)
+        for step_i in range(self.config.steps_per_epoch):
+            if self.preemption.requested():
+                break
+            toks, tgts = self.sample_batch()
+            timer.data_ready()
+            self.params, self.opt_state, step_m = self._step(
+                self.params, self.opt_state, jnp.asarray(toks),
+                jnp.asarray(tgts))
+            if self.faults.enabled:
+                step_m = self._poll_step_faults(step_m)
+            with self.guards.watch():
+                # the per-step sync point
+                loss_host = float(step_m["loss"])
+            if self.guards.enabled:
+                self.guards.after_sync({"loss": loss_host}, 1,
+                                       params=self.params)
+            meter.update(loss_host)
+            if "moe_drop" in step_m:
+                drop_meter.update(float(step_m["moe_drop"]))
+            timer.step_done()
+            # Per-step telemetry (the LM loop syncs every step, so
+            # the per-step timing is real, not a window average).
+            self.logger.telemetry.step(
+                epoch=epoch, step=step_i, loss=loss_host,
+                step_time_s=timer.step.last,
+                data_time_s=timer.data.last,
+                tokens_per_s=tokens_per_step
+                / max(timer.step.last, 1e-9))
+        if self.preemption.requested():
+            # Partial epoch: save for resume at this epoch and stop
+            # cleanly (train/preemption.py).
+            from distributed_model_parallel_tpu.train.preemption import (
+                checkpoint_on_preempt,
+            )
+
+            self.start_epoch = epoch
+            checkpoint_on_preempt(self.preemption, self.ckpt,
+                                  self._ckpt_tree(), "lm-preempt",
+                                  self.logger, epoch)
+            return None
+        from distributed_model_parallel_tpu.train.trainer import (
+            eval_now,
+        )
+
+        loss_val = (self.evaluate()
+                    if self._eval_loss is not None
+                    and eval_now(epoch, epochs,
+                                 self.config.eval_every)
+                    else None)
+        record = dict(epoch=epoch, loss_train=meter.avg,
+                      loss_val=loss_val,
+                      time_per_batch=timer.step.avg,
+                      time_load_per_batch=timer.data.avg,
+                      tokens_per_s=self.config.batch_size
+                      * self.config.seq_len / max(timer.step.avg, 1e-9))
+        if drop_meter.count:
+            # MoE router observability: mean fraction of
+            # token-choices dropped at capacity this epoch
+            # (ops/moe._route — silent overflow made visible).
+            record["moe_drop_rate"] = drop_meter.avg
+        return record
+
     def fit(self, epochs: int | None = None) -> list[dict]:
+        """Epoch loop with eval, per-epoch checkpointing, preemption-safe
+        stop, and (when ``recovery.max_retries > 0``) automatic restore-
+        and-retry on non-finite detections (train/resilience.py)."""
+        from distributed_model_parallel_tpu.train.guards import (
+            NonFiniteError,
+        )
+
         epochs = epochs if epochs is not None else self.config.epochs
         history = []
         with self.preemption.installed():
-            for epoch in range(self.start_epoch, epochs):
-                meter = AverageMeter("loss")
-                drop_meter = AverageMeter("moe_drop")
-                timer = StepTimer()
-                tokens_per_step = (self.config.batch_size
-                                   * self.config.seq_len)
-                for step_i in range(self.config.steps_per_epoch):
-                    if self.preemption.requested():
-                        break
-                    toks, tgts = self.sample_batch()
-                    timer.data_ready()
-                    self.params, self.opt_state, step_m = self._step(
-                        self.params, self.opt_state, jnp.asarray(toks),
-                        jnp.asarray(tgts))
-                    with self.guards.watch():
-                        # the per-step sync point
-                        loss_host = float(step_m["loss"])
-                    if self.guards.enabled:
-                        self.guards.after_sync({"loss": loss_host}, 1,
-                                               params=self.params)
-                    meter.update(loss_host)
-                    if "moe_drop" in step_m:
-                        drop_meter.update(float(step_m["moe_drop"]))
-                    timer.step_done()
-                    # Per-step telemetry (the LM loop syncs every step, so
-                    # the per-step timing is real, not a window average).
-                    self.logger.telemetry.step(
-                        epoch=epoch, step=step_i, loss=loss_host,
-                        step_time_s=timer.step.last,
-                        data_time_s=timer.data.last,
-                        tokens_per_s=tokens_per_step
-                        / max(timer.step.last, 1e-9))
-                if self.preemption.requested():
-                    # Partial epoch: save for resume at this epoch and stop
-                    # cleanly (train/preemption.py).
-                    from distributed_model_parallel_tpu.train.preemption import (
-                        checkpoint_on_preempt,
-                    )
-
-                    self.start_epoch = epoch
-                    checkpoint_on_preempt(self.preemption, self.ckpt,
-                                          self._ckpt_tree(), "lm-preempt",
-                                          self.logger, epoch)
+            self.resilience.begin(self._ckpt_tree)
+            epoch = self.start_epoch
+            while epoch < epochs:
+                try:
+                    record = self._train_one_epoch(epoch, epochs)
+                except NonFiniteError as e:
+                    if self.resilience.recover_nonfinite(
+                            e, epoch=epoch, restore=self._restore_good,
+                            shrink_lr=self._apply_lr_shrink):
+                        continue        # state restored — redo the epoch
+                    raise
+                if record is None:      # preempted mid-epoch
                     break
-                from distributed_model_parallel_tpu.train.trainer import (
-                    eval_now,
-                )
-
-                loss_val = (self.evaluate()
-                            if self._eval_loss is not None
-                            and eval_now(epoch, epochs,
-                                         self.config.eval_every)
-                            else None)
-                record = dict(epoch=epoch, loss_train=meter.avg,
-                              loss_val=loss_val,
-                              time_per_batch=timer.step.avg,
-                              time_load_per_batch=timer.data.avg,
-                              tokens_per_s=self.config.batch_size
-                              * self.config.seq_len / max(timer.step.avg, 1e-9))
-                if drop_meter.count:
-                    # MoE router observability: mean fraction of
-                    # token-choices dropped at capacity this epoch
-                    # (ops/moe._route — silent overflow made visible).
-                    record["moe_drop_rate"] = drop_meter.avg
                 self.logger.log_epoch(**record)
                 self.logger.telemetry.memory()
                 history.append(record)
                 self.start_epoch = epoch + 1
                 self.ckpt.save(self._ckpt_tree(), "lm")
+                # Finite-checked epoch state = the recovery restore point.
+                self.resilience.note_good(self._ckpt_tree)
+                epoch += 1
         self.logger.finish(epochs_run=len(history))
         return history

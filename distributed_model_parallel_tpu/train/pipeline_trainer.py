@@ -36,6 +36,14 @@ from distributed_model_parallel_tpu.train.trainer import EpochResult, eval_now
 class PipelineTrainer:
     def __init__(self, config: TrainConfig, devices=None):
         self.config = config
+        if config.recovery.lr_shrink != 1.0:
+            # Validate before the (expensive) runner build: fail fast.
+            raise ValueError(
+                "recovery.lr_shrink is implemented by the Trainer/LMTrainer "
+                "engines (they rebuild their optimizer + jitted steps); the "
+                "single-controller PipelineRunner bakes its optimizer into "
+                "per-stage programs at construction — restore-and-retry "
+                "recovery works, LR shrink does not. No silent ignores")
         if devices is None:
             devices = jax.devices()[:max(config.mesh.stage, 1)]
         if len(devices) < config.mesh.stage:
@@ -114,12 +122,27 @@ class PipelineTrainer:
                       n_stages=len(self.devices),
                       num_microbatches=config.num_microbatches,
                       pipeline_schedule=config.pipeline_schedule))
+        from distributed_model_parallel_tpu.train.resilience import (
+            RecoverySupervisor,
+        )
+        from distributed_model_parallel_tpu.utils.faults import FaultInjector
+
+        self.faults = FaultInjector(config.recovery.faults)
+        self.ckpt = Checkpointer(config.checkpoint_dir,
+                                 keep=config.recovery.keep_checkpoints,
+                                 injector=self.faults)
+        self.resilience = RecoverySupervisor(
+            config.recovery, logger=self.logger, ckpt=self.ckpt,
+            preemption=self.preemption, slot="pipeline-good",
+            injector=self.faults,
+            check_finite_every=config.check_finite_every)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
             check_finite_every=config.check_finite_every,
-            stall_budget_s=config.stall_budget_s, logger=self.logger)
-        self.ckpt = Checkpointer(config.checkpoint_dir)
+            stall_budget_s=config.stall_budget_s, logger=self.logger,
+            watchdog_interval_s=config.recovery.watchdog_interval_s,
+            on_stall=self.resilience.on_stall, injector=self.faults)
         self.best_acc = 0.0
         self.start_epoch = 0
         self._rng = jax.random.key(config.seed + 1)
@@ -133,10 +156,9 @@ class PipelineTrainer:
                 "best_acc": jnp.asarray(self.best_acc, jnp.float32),
                 "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
 
-    def _resume(self):
-        name = (self.ckpt.newest_name(("pipeline", "pipeline-preempt"))
-                or "pipeline")
-        restored = self.ckpt.restore(self._ckpt_tree(), name)
+    def _push_restored(self, restored) -> None:
+        """Scatter a restored checkpoint tree back onto the per-stage
+        devices."""
         params, state = restored["params"], restored["model_state"]
         for s, (lo, hi) in enumerate(self.runner.slices):
             dev = self.runner.devices[s]
@@ -145,7 +167,41 @@ class PipelineTrainer:
             self.runner.stages[s].model_state = jax.device_put(
                 tuple(state[lo:hi]), dev)
         self.best_acc = float(restored["best_acc"])
+
+    def _resume(self):
+        name = (self.ckpt.newest_name(("pipeline", "pipeline-preempt"))
+                or "pipeline")
+        # allow_fallback: a torn newest version (crash window / partial
+        # copy) is skipped for the previous committed one.
+        restored = self.ckpt.restore(
+            self._ckpt_tree(), name, allow_fallback=True,
+            on_fallback=self.resilience.note_fallback)
+        self._push_restored(restored)
         self.start_epoch = int(restored["epoch"])
+
+    def _restore_good(self):
+        """Recovery restore from the supervisor's "last good" slot
+        (train/resilience.py), with torn-version fallback."""
+        restored = self.ckpt.restore(
+            self._ckpt_tree(), self.resilience.slot, allow_fallback=True,
+            on_fallback=self.resilience.note_fallback)
+        self._push_restored(restored)
+
+    def _poll_step_faults(self, pending: list) -> None:
+        """Serve planned step-site faults (utils/faults.py): poison the
+        just-queued step metrics or the per-stage params, or request a
+        simulated preemption."""
+        from distributed_model_parallel_tpu.utils.faults import poison
+
+        for spec in self.faults.poll("step"):
+            if spec.kind == "preempt":
+                self.preemption.request()
+            elif spec.kind == "nan_loss" and pending:
+                mm, b = pending[-1]
+                pending[-1] = (poison(mm), b)
+            elif spec.kind == "nan_params":
+                for stage in self.runner.stages:
+                    stage.params = poison(stage.params)
 
     def _run_epoch(self, epoch: int, train: bool) -> EpochResult:
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
@@ -197,6 +253,8 @@ class PipelineTrainer:
                 pending.append(
                     (self.runner.train_step_device(sub, images, labels),
                      float(labels.shape[0])))
+                if self.faults.enabled:
+                    self._poll_step_faults(pending)
                 log_now = i % self.config.log_every_n_steps == 0
                 if log_now or len(pending) >= max_inflight:
                     drain()
@@ -225,11 +283,26 @@ class PipelineTrainer:
                            meters["acc5"].avg, step_avg, timer.data.avg)
 
     def fit(self, epochs: int | None = None) -> list[dict]:
+        """Epoch loop with eval, best-acc checkpointing, preemption-safe
+        stop, and (when ``recovery.max_retries > 0``) automatic restore-
+        and-retry on non-finite detections (train/resilience.py)."""
+        from distributed_model_parallel_tpu.train.guards import (
+            NonFiniteError,
+        )
+
         epochs = epochs if epochs is not None else self.config.epochs
         history = []
         with self.preemption.installed():
-            for epoch in range(self.start_epoch, epochs):
-                tr = self._run_epoch(epoch, train=True)
+            self.resilience.begin(self._ckpt_tree)
+            epoch = self.start_epoch
+            while epoch < epochs:
+                try:
+                    tr = self._run_epoch(epoch, train=True)
+                except NonFiniteError as e:
+                    if self.resilience.recover_nonfinite(
+                            e, epoch=epoch, restore=self._restore_good):
+                        continue        # state restored — redo the epoch
+                    raise
                 if self.preemption.requested():
                     # Partial epoch: resume at this epoch (the pipeline
                     # path had NO checkpointing at all in the reference,
@@ -260,5 +333,8 @@ class PipelineTrainer:
                     self.best_acc = ev.acc1
                     self.start_epoch = epoch + 1
                     self.ckpt.save(self._ckpt_tree(), "pipeline")
+                # Finite-checked epoch state = the recovery restore point.
+                self.resilience.note_good(self._ckpt_tree)
+                epoch += 1
         self.logger.finish(epochs_run=len(history))
         return history

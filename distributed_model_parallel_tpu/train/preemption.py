@@ -26,9 +26,20 @@ def checkpoint_on_preempt(guard: "PreemptionGuard", ckpt, tree, name: str,
     """The shared honor-a-preemption sequence used by every epoch driver:
     durable save under the dedicated slot, event line, consume the request
     (so a later fit() trains normally). Callers set their resume epoch
-    before building ``tree`` and ``break`` after."""
+    before building ``tree`` and ``break`` after.
+
+    Emits the typed ``failure`` / ``recovery`` telemetry pair (a preemption
+    — real SIGTERM, injected fault, or watchdog stall escalation — is a
+    failure whose recovery action is this graceful checkpoint-and-exit), so
+    ``scripts/dmp_report.py`` shows it on the resilience timeline."""
+    telemetry = getattr(logger, "telemetry", None)
+    if telemetry is not None:
+        telemetry.failure("preempted", stage=name, epoch=epoch)
     ckpt.save(tree, name, wait=True)
     logger.log_line(f"preempted: checkpoint saved at epoch {epoch}")
+    if telemetry is not None:
+        telemetry.recovery(action="checkpoint-and-exit", slot=name,
+                           epoch=epoch)
     guard.reset()
 
 

@@ -1,0 +1,321 @@
+"""Recovery supervisor: wires failure detection to recovery policy.
+
+The guards (train/guards.py) and preemption flag (train/preemption.py) can
+*notice* NaN, stalls and SIGTERM — but until this module every detection
+ended the run: nothing rolled back, retried, or fell back to an older
+checkpoint (ISSUE 2; the reference loses everything since the last
+best-accuracy save on any kill, SURVEY.md §5). The supervisor closes the
+loop:
+
+* **non-finite loss/params** → restore the per-epoch "last good" checkpoint
+  slot, optionally shrink the learning rate, and retry the epoch — bounded
+  by ``RecoveryConfig.max_retries``;
+* **torn/corrupt newest checkpoint** on any supervised restore → the
+  integrity manifest (train/checkpoint.py) rejects it and the restore falls
+  back to the previous committed version;
+* **failed save** of the good slot → logged, retried once, and otherwise
+  skipped (the previous committed version stays restorable) instead of
+  killing training;
+* **stalled sync** → the :class:`Watchdog` logs "still blocked after Ns"
+  lines *while* the sync is blocked (the old ``StallDetector`` could only
+  flag after the fact) and, with ``RecoveryConfig.stall_exit``, escalates to
+  a graceful checkpoint-and-exit via the preemption flag.
+
+Every detection emits a typed telemetry ``failure`` record and every action
+a ``recovery`` record (utils/telemetry.py), so ``scripts/dmp_report.py``
+renders a recovery timeline. Fault injection for testing all of this on
+demand lives in utils/faults.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable
+
+from distributed_model_parallel_tpu.config import RecoveryConfig
+from distributed_model_parallel_tpu.utils.faults import FaultInjector, FaultSpec
+
+
+class Watchdog:
+    """Live stall watchdog around blocking sync points.
+
+    ``watch()`` arms a background monitor thread for the scope of one
+    blocking call: while the call is still running, the monitor logs a
+    "still blocked after Ns" line every ``interval_s`` — so a wedged
+    collective is visible *before* the step returns — and flips
+    ``stalled`` / fires ``on_escalate`` once the stall budget is exceeded.
+    On exit the overrun is also checked post-hoc (tiny overruns can
+    complete between monitor ticks), which preserves the old
+    ``StallDetector`` semantics (``stalled`` / ``worst_s`` /
+    one loud "exceeded the stall budget" log line).
+    """
+
+    def __init__(self, budget_s: float, *, interval_s: float | None = None,
+                 logger=None,
+                 on_escalate: Callable[[str, float], None] | None = None):
+        self.budget_s = float(budget_s)
+        self.interval_s = (float(interval_s) if interval_s
+                           else min(30.0, max(0.05, self.budget_s / 2)))
+        self.logger = logger
+        self.on_escalate = on_escalate
+        self.stalled = False
+        self.worst_s = 0.0
+        self._overrun_logged = False
+        self._escalated = False
+        # ONE long-lived monitor thread, armed/disarmed per watch(): the
+        # LM trainer syncs every step, so per-watch thread spawn/join would
+        # tax the hot path of every guarded run, stall or not. Arm/disarm
+        # is two lock acquisitions.
+        self._cv = threading.Condition()
+        self._armed_at: float | None = None
+        self._what = "sync"
+        self._gen = 0
+        self._thread: threading.Thread | None = None
+
+    def _log(self, msg: str) -> None:
+        if self.logger is not None:
+            self.logger.log_line(msg)
+
+    def _escalate(self, what: str, dt: float) -> None:
+        if self._escalated or self.on_escalate is None:
+            return
+        self._escalated = True
+        self.on_escalate(what, dt)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._monitor,
+                                            daemon=True, name="dmp-watchdog")
+            self._thread.start()
+
+    def _monitor(self) -> None:
+        while True:
+            with self._cv:
+                while self._armed_at is None:
+                    self._cv.wait()          # idle: costs nothing
+                gen, t0, what = self._gen, self._armed_at, self._what
+                self._cv.wait(self.interval_s)
+                still = self._armed_at is not None and self._gen == gen
+                dt = (time.perf_counter() - t0) if still else 0.0
+            if still:
+                # Log outside the lock so slow sink I/O never blocks the
+                # main thread's disarm on watch() exit.
+                self._log(f"watchdog: {what} still blocked after {dt:.1f}s "
+                          f"(budget {self.budget_s:.1f}s)")
+                if dt > self.budget_s:
+                    self.stalled = True
+                    self._escalate(what, dt)
+
+    @contextlib.contextmanager
+    def watch(self, what: str = "sync"):
+        self._ensure_thread()
+        t0 = time.perf_counter()
+        with self._cv:
+            self._gen += 1
+            self._armed_at = t0
+            self._what = what
+            self._cv.notify()
+        try:
+            yield self
+        finally:
+            with self._cv:
+                self._gen += 1
+                self._armed_at = None
+            dt = time.perf_counter() - t0
+            self.worst_s = max(self.worst_s, dt)
+            if dt > self.budget_s:
+                self.stalled = True
+                if not self._overrun_logged:
+                    self._overrun_logged = True
+                    self._log(f"guard: sync exceeded the stall budget "
+                              f"({dt:.1f}s > {self.budget_s:.1f}s)")
+                self._escalate(what, dt)
+
+
+def _short(e: BaseException, n: int = 300) -> str:
+    return f"{type(e).__name__}: {e}"[:n]
+
+
+class RecoverySupervisor:
+    """Per-trainer recovery orchestration (see module docstring).
+
+    The trainer owns the mechanics (how to build its checkpoint tree, how
+    to push restored state back onto devices, how to rebuild its optimizer
+    at a smaller LR); the supervisor owns the policy (when to restore, the
+    retry budget, what to record). ``slot`` is the trainer's "last good"
+    checkpoint name — saved by :meth:`note_good` after every clean epoch
+    and at :meth:`begin`, restored by the trainer's callback on recovery.
+    """
+
+    def __init__(self, config: RecoveryConfig, *, logger, ckpt, preemption,
+                 slot: str = "good", injector: FaultInjector | None = None,
+                 check_finite_every: int | None = None):
+        if config.max_retries < 0:
+            raise ValueError(
+                f"recovery.max_retries must be >= 0, got {config.max_retries}")
+        if not (0.0 < config.lr_shrink <= 1.0):
+            raise ValueError(
+                f"recovery.lr_shrink must be in (0, 1], got "
+                f"{config.lr_shrink}")
+        if config.keep_checkpoints < 1:
+            raise ValueError(
+                f"recovery.keep_checkpoints must be >= 1, got "
+                f"{config.keep_checkpoints}")
+        self.config = config
+        self.logger = logger
+        self.ckpt = ckpt
+        self.preemption = preemption
+        self.slot = slot
+        self.injector = (injector if injector is not None
+                         else FaultInjector(config.faults))
+        self.injector.on_fire = self._on_fault_fired
+        self.retries_left = config.max_retries
+        self.lr_scale = 1.0
+        self._stall_reported = False
+        self._fallback_reported: set[str] = set()
+        if check_finite_every is not None and check_finite_every <= 0:
+            if any(s.kind in ("nan_loss", "nan_params")
+                   for s in self.injector.plan):
+                # An injected NaN nothing detects doesn't test recovery —
+                # it crashes the metrics drain on int(NaN). No silent
+                # misconfigurations.
+                raise ValueError(
+                    "the fault plan injects NaN (nan_loss/nan_params) but "
+                    "check_finite_every is 0, so the guards would never "
+                    "detect it; set check_finite_every >= 1")
+            if self.enabled:
+                self.logger.log_line(
+                    "resilience: warning — recovery.max_retries is set but "
+                    "check_finite_every is 0, so non-finite steps are never "
+                    "detected (stall/preempt/save recovery still active)")
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.max_retries > 0
+
+    @property
+    def _telemetry(self):
+        return self.logger.telemetry
+
+    # -- chaos bookkeeping --------------------------------------------------
+    def _on_fault_fired(self, spec: FaultSpec, site: str, index: int) -> None:
+        self.logger.log_line(
+            f"chaos: injected fault {spec.kind} at {site}[{index}]")
+
+    # -- good-state bookkeeping ---------------------------------------------
+    def begin(self, tree_fn: Callable[[], Any]) -> None:
+        """Seed the good slot at fit() start so an epoch-0 failure has a
+        known-good state to restore (no-op when recovery is disabled)."""
+        self.note_good(tree_fn)
+
+    def note_good(self, tree_fn: Callable[[], Any]) -> None:
+        """Persist the current (finiteness-checked) state as "last good".
+
+        A failed save is itself a recoverable failure: record it, retry
+        once, and otherwise keep training on the previous committed
+        version — the one case a save failure must NOT do is kill a run
+        that was healthy a moment ago.
+        """
+        if not self.enabled:
+            return
+        try:
+            self.ckpt.save(tree_fn(), self.slot, wait=True)
+            return
+        except Exception as e:  # noqa: BLE001 - any save failure is handled
+            self._telemetry.failure("checkpoint-save-failed", stage=self.slot,
+                                    detail=_short(e))
+            self.logger.log_line(
+                f"resilience: save to {self.slot!r} failed "
+                f"({type(e).__name__}) — retrying once")
+        try:
+            self.ckpt.save(tree_fn(), self.slot, wait=True)
+        except Exception as e:  # noqa: BLE001
+            self._telemetry.recovery(action="save-skipped", slot=self.slot,
+                                     detail=_short(e))
+            self.logger.log_line(
+                "resilience: retry failed — keeping the previous committed "
+                "version of the good slot")
+        else:
+            self._telemetry.recovery(action="save-retried", slot=self.slot)
+            self.logger.log_line("resilience: good-slot save retry succeeded")
+
+    # -- recovery actions ---------------------------------------------------
+    def recover_nonfinite(self, exc: BaseException, *, epoch: int,
+                          restore: Callable[[], None],
+                          shrink_lr: Callable[[float], None] | None = None
+                          ) -> bool:
+        """Handle a NonFiniteError raised out of an epoch. Returns True when
+        the epoch should be retried (state restored), False when the caller
+        must re-raise (recovery disabled, budget exhausted, or nothing to
+        restore)."""
+        self._telemetry.failure("non-finite", epoch=epoch,
+                                detail=_short(exc),
+                                retries_left=self.retries_left)
+        if not self.enabled:
+            return False
+        if self.retries_left <= 0:
+            self.logger.log_line(
+                "resilience: non-finite retry budget exhausted — raising")
+            return False
+        self.retries_left -= 1
+        try:
+            restore()
+        except FileNotFoundError:
+            self.logger.log_line(
+                f"resilience: no {self.slot!r} checkpoint to restore — "
+                f"raising")
+            return False
+        except Exception as e:  # noqa: BLE001 - e.g. every version torn
+            # (CheckpointIntegrityError). The caller re-raises the original
+            # NonFiniteError — the restore failure is context, not cause.
+            self._telemetry.failure("recovery-restore-failed",
+                                    slot=self.slot, detail=_short(e))
+            self.logger.log_line(
+                f"resilience: restoring {self.slot!r} failed "
+                f"({type(e).__name__}: {str(e)[:160]}) — raising the "
+                f"original non-finite error")
+            return False
+        if self.config.lr_shrink != 1.0 and shrink_lr is not None:
+            self.lr_scale *= self.config.lr_shrink
+            shrink_lr(self.config.lr_shrink)
+        self._telemetry.recovery(action="restored", slot=self.slot,
+                                 epoch=epoch, retries_left=self.retries_left,
+                                 lr_scale=self.lr_scale)
+        self.logger.log_line(
+            f"resilience: non-finite at epoch {epoch} — restored "
+            f"{self.slot!r}, lr x{self.lr_scale:g}, retrying "
+            f"({self.retries_left} retries left)")
+        return True
+
+    def note_fallback(self, path: str, reason: str) -> None:
+        """Checkpointer callback: the newest version was torn/corrupt and
+        the restore is falling back to the previous committed one. One
+        failure/recovery pair per torn path — a resume that retries
+        several template layouts re-verifies the same candidates."""
+        if path in self._fallback_reported:
+            return
+        self._fallback_reported.add(path)
+        self._telemetry.failure("checkpoint-torn", detail=f"{path}: "
+                                f"{reason}"[:300])
+        self._telemetry.recovery(action="checkpoint-fallback", detail=path)
+        self.logger.log_line(
+            f"resilience: checkpoint {path} failed verification/restore "
+            f"({reason[:120]}) — falling back to the previous version")
+
+    def on_stall(self, what: str, blocked_s: float) -> None:
+        """Watchdog escalation: record the stall; with ``stall_exit``,
+        request a graceful checkpoint-and-exit (the preemption path then
+        saves and emits the matching ``recovery`` record)."""
+        if self._stall_reported:
+            return
+        self._stall_reported = True
+        self._telemetry.failure(
+            "stall", detail=f"{what} blocked {blocked_s:.1f}s "
+            f"(budget exceeded)")
+        if self.config.stall_exit:
+            self.logger.log_line(
+                "resilience: stall budget exceeded — requesting graceful "
+                "checkpoint-and-exit")
+            self.preemption.request()

@@ -246,6 +246,11 @@ class Trainer:
         ema = config.optimizer.ema_decay
         if ema is not None and not (0.0 <= ema <= 1.0):
             raise ValueError(f"ema_decay must be in [0, 1], got {ema}")
+        # Everything _build_steps needs to (re)construct the jitted step
+        # functions — stored so a recovery-time LR shrink can rebuild them
+        # without re-running state init (train/resilience.py).
+        self._kw = kw
+        self._in_hw = in_hw
         if config.strategy == "ddp":
             if config.device_resident_data:
                 raise ValueError(
@@ -258,8 +263,6 @@ class Trainer:
             # Explicit per-replica engine: BN state carries a leading
             # per-replica axis sharded over the data axis (parallel/ddp.py).
             from distributed_model_parallel_tpu.parallel.ddp import (
-                make_ddp_eval_step,
-                make_ddp_train_step,
                 replicate_model_state,
             )
 
@@ -272,12 +275,6 @@ class Trainer:
                 model_state=self.spec.batch_sharded(),
                 opt_state=self._repl)
             self.state = jax.device_put(state, self._state_sh)
-            self._train_step = make_ddp_train_step(
-                self.model, self.tx, self.spec,
-                augment=config.data.augment,
-                bucket_bytes=config.ddp_bucket_bytes,
-                allreduce=config.ddp_allreduce, **kw)
-            self._eval_step = make_ddp_eval_step(self.model, self.spec, **kw)
         elif config.strategy in ("gspmd", "fsdp"):
             if config.strategy == "fsdp":
                 # ZeRO-3: params + optimizer state live sharded over `data`;
@@ -315,21 +312,8 @@ class Trainer:
                                ema_params=ema_params,
                                ema_model_state=ema_model_state)
             self.state = jax.device_put(state, self._state_sh)
-            self._train_step = jax.jit(
-                make_train_step(self.model, self.tx, ema_decay=ema,
-                                augment=config.data.augment, **kw),
-                in_shardings=(self._state_sh, self._repl, self._batch_sh,
-                              self._batch_sh),
-                out_shardings=(self._state_sh, self._repl),
-                donate_argnums=(0,))
-            self._eval_step = jax.jit(
-                make_eval_step(self.model, use_ema=ema is not None, **kw),
-                in_shardings=(self._state_sh, self._batch_sh, self._batch_sh),
-                out_shardings=self._repl)
             if config.device_resident_data:
                 # Fast path: dataset lives on device; K steps per dispatch.
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
                 if getattr(train_ds, "is_lazy", False):
                     raise ValueError(
                         "device_resident_data requires materialized pixels "
@@ -342,16 +326,6 @@ class Trainer:
                     train_ds.images.reshape(n, -1), self._repl)
                 self._dev_labels = jax.device_put(
                     np.asarray(train_ds.labels), self._repl)
-                idx_sh = NamedSharding(self.spec.mesh,
-                                       P(None, self.spec.data_axis))
-                self._multi_step = jax.jit(
-                    make_multi_step(self.model, self.tx, ema_decay=ema,
-                                    image_shape=train_ds.images.shape[1:],
-                                    augment=config.data.augment, **kw),
-                    in_shardings=(self._state_sh, self._repl, self._repl,
-                                  self._repl, idx_sh),
-                    out_shardings=(self._state_sh, self._repl),
-                    donate_argnums=(0,))
         elif config.strategy == "spmd_pipeline":
             # Single-program GPipe over the `stage` mesh axis for staged
             # CNNs (parallel/spmd_cnn_pipeline.py) — the multi-host-capable
@@ -361,10 +335,6 @@ class Trainer:
             # the GSPMD step. Params stay replicated (each device computes
             # only its own stage), so eval rides the ordinary batch-sharded
             # GSPMD forward.
-            from distributed_model_parallel_tpu.parallel.spmd_cnn_pipeline import (
-                make_spmd_cnn_train_step,
-            )
-
             if config.device_resident_data:
                 raise ValueError(
                     "device_resident_data is only supported with "
@@ -417,6 +387,7 @@ class Trainer:
                     self.model,
                     (micro, in_hw, in_hw, train_ds.images.shape[3]),
                     n_chunks)
+            self._boundaries = boundaries
             self._state_sh = self._repl
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                                model_state=model_state,
@@ -425,31 +396,11 @@ class Trainer:
             # masked dispatch on CPU: conv backward inside lax.switch loses
             # intra-op threading on the XLA CPU backend (~35x slower —
             # spmd_cnn_pipeline.py); TPU keeps the switch default.
-            dispatch = ("masked" if jax.devices()[0].platform == "cpu"
-                        else "switch")
-            self._train_step = jax.jit(
-                make_spmd_cnn_train_step(
-                    self.model, self.spec, self.tx,
-                    sample_shape=(2, in_hw, in_hw,
-                                  train_ds.images.shape[3]),
-                    num_microbatches=config.num_microbatches,
-                    boundaries=boundaries,
-                    bn_momentum=config.model.bn_momentum,
-                    augment=config.data.augment,
-                    stage_dispatch=dispatch,
-                    schedule=config.pipeline_schedule,
-                    virtual_stages=config.virtual_stages, **kw),
-                in_shardings=(self._state_sh, self._repl, self._batch_sh,
-                              self._batch_sh),
-                out_shardings=(self._state_sh, self._repl),
-                donate_argnums=(0,))
-            self._eval_step = jax.jit(
-                make_eval_step(self.model, use_ema=False, **kw),
-                in_shardings=(self._state_sh, self._batch_sh,
-                              self._batch_sh),
-                out_shardings=self._repl)
+            self._dispatch = ("masked" if jax.devices()[0].platform == "cpu"
+                              else "switch")
         else:
             raise KeyError(f"unknown strategy {config.strategy!r}")
+        self._build_steps()
 
         self._max_inflight = max(1, config.max_inflight_steps)
         from distributed_model_parallel_tpu.train.preemption import (
@@ -465,17 +416,119 @@ class Trainer:
                       mesh=config.mesh.axis_sizes(),
                       steps_per_dispatch=config.steps_per_dispatch
                       if config.device_resident_data else 1))
+        from distributed_model_parallel_tpu.train.resilience import (
+            RecoverySupervisor,
+        )
+        from distributed_model_parallel_tpu.utils.faults import FaultInjector
+
+        self.faults = FaultInjector(config.recovery.faults)
+        self.ckpt = Checkpointer(config.checkpoint_dir,
+                                 keep=config.recovery.keep_checkpoints,
+                                 injector=self.faults)
+        self.resilience = RecoverySupervisor(
+            config.recovery, logger=self.logger, ckpt=self.ckpt,
+            preemption=self.preemption, slot="good", injector=self.faults,
+            check_finite_every=config.check_finite_every)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
             check_finite_every=config.check_finite_every,
-            stall_budget_s=config.stall_budget_s, logger=self.logger)
-        self.ckpt = Checkpointer(config.checkpoint_dir)
+            stall_budget_s=config.stall_budget_s, logger=self.logger,
+            watchdog_interval_s=config.recovery.watchdog_interval_s,
+            on_stall=self.resilience.on_stall, injector=self.faults)
         self.best_acc = 0.0
         self.start_epoch = 0
         self._rng = jax.random.key(config.seed + 1)
         if config.resume and (self.ckpt.exists() or self.ckpt.exists("preempt")):
             self._resume()
+
+    def _build_steps(self) -> None:
+        """(Re)build the jitted step functions from the current config and
+        ``self.tx``. Called once at init and again by ``_apply_lr_shrink``
+        after a recovery rebuilds the optimizer: state, shardings and the
+        on-device dataset are untouched, so a restored ``opt_state`` stays
+        structurally compatible (the LR lives in the schedule closure, not
+        in the state)."""
+        config = self.config
+        kw = self._kw
+        ema = config.optimizer.ema_decay
+        self._multi_step = None
+        if config.strategy == "ddp":
+            from distributed_model_parallel_tpu.parallel.ddp import (
+                make_ddp_eval_step,
+                make_ddp_train_step,
+            )
+
+            self._train_step = make_ddp_train_step(
+                self.model, self.tx, self.spec,
+                augment=config.data.augment,
+                bucket_bytes=config.ddp_bucket_bytes,
+                allreduce=config.ddp_allreduce, **kw)
+            self._eval_step = make_ddp_eval_step(self.model, self.spec, **kw)
+        elif config.strategy in ("gspmd", "fsdp"):
+            self._train_step = jax.jit(
+                make_train_step(self.model, self.tx, ema_decay=ema,
+                                augment=config.data.augment, **kw),
+                in_shardings=(self._state_sh, self._repl, self._batch_sh,
+                              self._batch_sh),
+                out_shardings=(self._state_sh, self._repl),
+                donate_argnums=(0,))
+            self._eval_step = jax.jit(
+                make_eval_step(self.model, use_ema=ema is not None, **kw),
+                in_shardings=(self._state_sh, self._batch_sh, self._batch_sh),
+                out_shardings=self._repl)
+            if config.device_resident_data:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                idx_sh = NamedSharding(self.spec.mesh,
+                                       P(None, self.spec.data_axis))
+                self._multi_step = jax.jit(
+                    make_multi_step(self.model, self.tx, ema_decay=ema,
+                                    image_shape=self.train_ds.images.shape[1:],
+                                    augment=config.data.augment, **kw),
+                    in_shardings=(self._state_sh, self._repl, self._repl,
+                                  self._repl, idx_sh),
+                    out_shardings=(self._state_sh, self._repl),
+                    donate_argnums=(0,))
+        elif config.strategy == "spmd_pipeline":
+            from distributed_model_parallel_tpu.parallel.spmd_cnn_pipeline import (
+                make_spmd_cnn_train_step,
+            )
+
+            in_hw = self._in_hw
+            self._train_step = jax.jit(
+                make_spmd_cnn_train_step(
+                    self.model, self.spec, self.tx,
+                    sample_shape=(2, in_hw, in_hw,
+                                  self.train_ds.images.shape[3]),
+                    num_microbatches=config.num_microbatches,
+                    boundaries=self._boundaries,
+                    bn_momentum=config.model.bn_momentum,
+                    augment=config.data.augment,
+                    stage_dispatch=self._dispatch,
+                    schedule=config.pipeline_schedule,
+                    virtual_stages=config.virtual_stages, **kw),
+                in_shardings=(self._state_sh, self._repl, self._batch_sh,
+                              self._batch_sh),
+                out_shardings=(self._state_sh, self._repl),
+                donate_argnums=(0,))
+            self._eval_step = jax.jit(
+                make_eval_step(self.model, use_ema=False, **kw),
+                in_shardings=(self._state_sh, self._batch_sh,
+                              self._batch_sh),
+                out_shardings=self._repl)
+
+    def _apply_lr_shrink(self, factor: float) -> None:
+        """Recovery-time LR shrink: scale the configured LR, rebuild the
+        optimizer (same opt_state structure — the schedule is a closure)
+        and re-jit the step functions (train/resilience.py)."""
+        opt = self.config.optimizer
+        self.config = self.config.replace(
+            optimizer=dataclasses.replace(
+                opt, learning_rate=opt.learning_rate * factor))
+        self.tx = make_optimizer(self.config.optimizer,
+                                 len(self.train_loader), self.config.epochs)
+        self._build_steps()
 
     # -- checkpointing (reference data_parallel.py:80-87,143-155) ------------
     def _ckpt_tree(self):
@@ -507,13 +560,28 @@ class Trainer:
             if key not in seen:          # the candidates overlap with tmpl
                 seen.add(key)
                 layouts.append(layout)
+        from distributed_model_parallel_tpu.train.checkpoint import (
+            CheckpointIntegrityError,
+        )
+
         restored = None
         for i, layout in enumerate(layouts):
             try:
-                restored = self.ckpt.restore({**tmpl, "state": layout}, name)
+                # allow_fallback: a torn newest version (crash window,
+                # partial copy) is skipped for the previous committed one —
+                # manifest-verified versions that fail keep raising (a
+                # structure mismatch is a config problem, not corruption).
+                restored = self.ckpt.restore(
+                    {**tmpl, "state": layout}, name, allow_fallback=True,
+                    on_fallback=self.resilience.note_fallback)
                 break
-            except (ValueError, KeyError, TypeError) as e:
+            except (ValueError, KeyError, TypeError,
+                    CheckpointIntegrityError) as e:
                 if i == len(layouts) - 1:
+                    if isinstance(e, CheckpointIntegrityError):
+                        # Every version is torn/corrupt: that is a disk
+                        # problem, not a config mismatch — don't misdiagnose.
+                        raise
                     raise ValueError(
                         f"checkpoint {name!r} does not match the current "
                         f"configuration's train-state structure — resuming "
@@ -540,6 +608,16 @@ class Trainer:
         self.start_epoch = epoch + 1
         self.ckpt.save(self._ckpt_tree(),
                        wait=not self.config.async_checkpoint)
+
+    def _restore_good(self):
+        """Recovery restore: pull the supervisor's "last good" slot (same
+        tree layout as this run wrote it) back onto the devices, with
+        torn-version fallback (train/resilience.py)."""
+        restored = self.ckpt.restore(
+            self._ckpt_tree(), self.resilience.slot, allow_fallback=True,
+            on_fallback=self.resilience.note_fallback)
+        self.state = jax.device_put(restored["state"], self._state_sh)
+        self.best_acc = float(restored["best_acc"])
 
     # -- epoch loops ---------------------------------------------------------
     def _shard_batch(self, images, labels):
@@ -592,6 +670,22 @@ class Trainer:
                 meters["acc5"].update(float(c5[j]) / b * 100, int(b))
         pending.clear()
 
+    def _poll_step_faults(self, pending: list) -> None:
+        """Serve planned step-site faults (utils/faults.py): poison the
+        just-computed metrics or the live params, or request a simulated
+        preemption — the chaos hooks the recovery tests drive. No-op (one
+        counter bump) when no fault plan is configured."""
+        from distributed_model_parallel_tpu.utils.faults import poison
+
+        for spec in self.faults.poll("step"):
+            if spec.kind == "preempt":
+                self.preemption.request()
+            elif spec.kind == "nan_loss" and pending:
+                pending[-1] = poison(pending[-1])
+            elif spec.kind == "nan_params":
+                self.state = self.state.replace(
+                    params=poison(self.state.params))
+
     def train_epoch(self, epoch: int) -> EpochResult:
         if getattr(self, "_multi_step", None) is not None:
             return self._train_epoch_device_resident(epoch)
@@ -606,6 +700,8 @@ class Trainer:
             self._rng, sub = jax.random.split(self._rng)
             self.state, metrics = self._train_step(self.state, sub, images, labels)
             pending.append(metrics)
+            if self.faults.enabled:
+                self._poll_step_faults(pending)
             log_now = i % self.config.log_every_n_steps == 0
             if log_now or len(pending) >= self._max_inflight:
                 n = len(pending)
@@ -655,6 +751,10 @@ class Trainer:
                 self.state, sub, self._dev_images, self._dev_labels,
                 jnp.asarray(chunk))
             pending.append(metrics)
+            if self.faults.enabled:
+                # One step-site poll per DISPATCH (K fused steps) — faults
+                # cannot target an individual step inside the scan.
+                self._poll_step_faults(pending)
             inflight += chunk.shape[0]
             # Log when a multiple of log_every_n_steps falls inside this
             # dispatch's [i, i+K) step window — same cadence as the
@@ -706,12 +806,31 @@ class Trainer:
         the epoch loop breaks at the next step boundary, a checkpoint is
         written pointing resume at the interrupted epoch, and fit returns
         the completed history (train/preemption.py).
+
+        With recovery enabled (``TrainConfig.recovery.max_retries > 0``) a
+        NonFiniteError raised by the guards restores the supervisor's
+        per-epoch "last good" checkpoint, optionally shrinks the LR, and
+        retries the epoch — bounded by the retry budget
+        (train/resilience.py).
         """
+        from distributed_model_parallel_tpu.train.guards import (
+            NonFiniteError,
+        )
+
         epochs = epochs if epochs is not None else self.config.epochs
         history = []
         with self.preemption.installed():
-            for epoch in range(self.start_epoch, epochs):
-                tr = self.train_epoch(epoch)
+            self.resilience.begin(self._ckpt_tree)
+            epoch = self.start_epoch
+            while epoch < epochs:
+                try:
+                    tr = self.train_epoch(epoch)
+                except NonFiniteError as e:
+                    if self.resilience.recover_nonfinite(
+                            e, epoch=epoch, restore=self._restore_good,
+                            shrink_lr=self._apply_lr_shrink):
+                        continue        # state restored — redo the epoch
+                    raise
                 if self.preemption.requested():
                     # Partial epoch: resume *at* this epoch (the standard
                     # redo-the-epoch convention); the dedicated slot never
@@ -742,6 +861,10 @@ class Trainer:
                 if ev is not None and ev.acc1 > self.best_acc:
                     self.best_acc = ev.acc1
                     self._save(epoch)
+                # Epoch completed with finite metrics/params — persist it
+                # as the recovery restore point (no-op unless enabled).
+                self.resilience.note_good(self._ckpt_tree)
+                epoch += 1
         self.ckpt.wait_until_finished()
         self.logger.finish(epochs_run=len(history))
         return history

@@ -1,0 +1,143 @@
+"""Hardened first device contact, shared by bench.py and the CLI drivers.
+
+The round-5 TPU-tunnel outage turned ``jax.devices()`` into a raw
+``JaxRuntimeError`` traceback the bench driver could not parse (VERDICT
+weak #1); bench.py grew a bounded-retry + parseable-failure-record pattern
+in PR 1, and this module extracts it so ``scripts/train_data_parallel.py``,
+``scripts/train_lm.py`` and ``scripts/train_model_parallel.py`` share the
+exact same failure contract:
+
+* transient transport drops are retried with exponential backoff
+  (``DMP_CONTACT_RETRIES`` / ``DMP_CONTACT_RETRY_DELAY_S``; bench.py's
+  historical ``DMP_BENCH_RETRIES`` / ``DMP_BENCH_RETRY_DELAY_S`` spellings
+  keep working);
+* a permanently unreachable backend becomes ONE parseable JSON record on
+  stdout (``{"error": "tpu-unreachable", ...}``) plus a telemetry
+  ``failure`` record — never a stack trace. bench.py exits 0 afterwards
+  (its driver ingests the record); the training drivers exit
+  :data:`EXIT_TPU_UNREACHABLE` so a cluster supervisor can retry the job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# Distinct, documented exit status for "backend unreachable after retries"
+# (training drivers; bench.py keeps its historical rc=0 contract).
+EXIT_TPU_UNREACHABLE = 17
+
+
+def _log(msg: str, prefix: str = "device-contact") -> None:
+    print(f"[{prefix}] {msg}", file=sys.stderr, flush=True)
+
+
+def _env(name: str, default: str) -> str:
+    # New spelling first, bench.py's historical one second.
+    return os.environ.get(f"DMP_CONTACT_{name}",
+                          os.environ.get(f"DMP_BENCH_{name}", default))
+
+
+def contact_devices(max_attempts: int | None = None,
+                    delay_s: float | None = None, *,
+                    log_prefix: str = "device-contact"):
+    """First device contact, hardened: bounded retry with exponential
+    backoff, returning the device list or None after permanent failure
+    (the last exception lands on ``contact_devices.last_error`` and the
+    attempt count on ``contact_devices.attempts``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if max_attempts is None:
+        max_attempts = int(_env("RETRIES", "5"))
+    if delay_s is None:
+        delay_s = float(_env("RETRY_DELAY_S", "2.0"))
+    max_attempts = max(1, max_attempts)
+    contact_devices.attempts = max_attempts
+    last: Exception | None = None
+    for attempt in range(max_attempts):
+        try:
+            devs = jax.devices()
+            # A device listing can succeed while the transport is dead;
+            # prove liveness with one tiny round trip.
+            jnp.zeros(()).block_until_ready()
+            contact_devices.attempts = attempt + 1
+            return devs
+        except Exception as e:      # noqa: BLE001 - anything here is fatal
+            last = e
+            first_line = (str(e).splitlines() or [""])[0][:200]
+            _log(f"device contact attempt {attempt + 1}/{max_attempts} "
+                 f"failed: {type(e).__name__}: {first_line}", log_prefix)
+            try:
+                # jax caches a failed backend init; clear so the retry
+                # actually re-dials instead of replaying the cached error.
+                from jax.extend import backend as _backend
+
+                _backend.clear_backends()
+            except Exception:
+                pass
+            if attempt < max_attempts - 1:
+                time.sleep(delay_s)
+                delay_s *= 2
+    contact_devices.last_error = last
+    return None
+
+
+def emit_unreachable(stage: str, err: Exception | None, attempts: int, *,
+                     telemetry_path: str | None = None,
+                     run_name: str | None = None) -> dict:
+    """One parseable JSON failure record on stdout plus (best-effort) a
+    telemetry ``failure`` record — the driver-facing form of a permanently
+    unreachable backend. Returns the record.
+
+    ``telemetry_path`` defaults to ``DMP_TELEMETRY`` (no stream written
+    when unset); bench.py passes its historical default path so its
+    failure stream keeps landing next to the bench logs.
+    """
+    detail = f"{type(err).__name__}: {err}" if err is not None else ""
+    record = {
+        "error": "tpu-unreachable",
+        "stage": stage,
+        "attempts": attempts,
+        "detail": detail[:500],
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "ts": time.time(),
+        "metric": None,
+        "value": None,
+    }
+    # stdout record FIRST: the caller's supervisor must get the parseable
+    # line promptly; the telemetry append is bookkeeping after the fact.
+    print(json.dumps(record), flush=True)
+    path = (telemetry_path if telemetry_path is not None
+            else os.environ.get("DMP_TELEMETRY"))
+    if path:
+        try:
+            from distributed_model_parallel_tpu.utils.telemetry import (
+                TelemetryRun,
+            )
+
+            # device override: writing the header must not re-dial the
+            # dead backend (device_info() would re-init it).
+            t = TelemetryRun(path, run=run_name or f"{stage}-failure",
+                             meta=dict(stage=stage),
+                             device={"error": detail[:200] or "unreachable"})
+            t.failure("tpu-unreachable", stage=stage, attempts=attempts,
+                      detail=detail[:500])
+            t.finish()
+        except Exception:
+            pass
+    return record
+
+
+def require_devices(stage: str, *, log_prefix: str | None = None):
+    """The training-driver entry: contact the backend, or emit the failure
+    record and exit ``EXIT_TPU_UNREACHABLE``. Returns the device list."""
+    devs = contact_devices(log_prefix=log_prefix or stage)
+    if devs is None:
+        emit_unreachable(stage, getattr(contact_devices, "last_error", None),
+                         getattr(contact_devices, "attempts", 0))
+        raise SystemExit(EXIT_TPU_UNREACHABLE)
+    return devs

@@ -1,0 +1,192 @@
+"""Deterministic, config-driven fault injection for chaos-testing the
+training stack.
+
+The reference has no failure story at all (SURVEY.md §5) and — until this
+module — neither did we have a way to *provoke* one on demand: the guards
+(train/guards.py) and the recovery supervisor (train/resilience.py) could
+only be tested against failures that happened to occur. A ``FaultInjector``
+closes that gap: a ``RecoveryConfig.faults`` plan names exactly which fault
+fires at exactly which occurrence of which hook site, so a chaos test (or
+``scripts/dmp_chaos.py``) is a deterministic program, not a flaky race.
+
+Fault taxonomy (``kind`` → hook site → effect):
+
+=============  ======  =====================================================
+kind           site    effect when fired
+=============  ======  =====================================================
+``nan_loss``   step    poison that step's metrics with NaN (a loss
+                       explosion as the guards see it)
+``nan_params`` step    poison the live parameters with NaN (detected at the
+                       next params-cadence finiteness check)
+``preempt``    step    request a graceful preemption (exactly what a TPU
+                       maintenance SIGTERM does, minus the signal)
+``stall``      sync    sleep ``param`` seconds inside the guarded blocking
+                       drain, so the sync overruns the stall budget
+``save_fail``  save    die "mid-write": leave a torn version directory
+                       behind and raise ``InjectedFaultError``
+``tear_save``  save    let the save commit, then truncate its files — the
+                       torn-newest-checkpoint scenario a crashed writer or
+                       partial copy leaves on disk
+=============  ======  =====================================================
+
+Sites are consulted by the trainers (``step``), ``GuardRunner.watch``
+(``sync``) and ``Checkpointer.save`` (``save``). Each ``poll(site)`` call
+advances that site's occurrence counter; a spec fires when its ``at`` index
+matches — once, deterministically, independent of wall clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Sequence
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFaultError",
+    "parse_faults",
+    "poison",
+    "tear_checkpoint",
+]
+
+
+class InjectedFaultError(RuntimeError):
+    """Raised by an injected ``save_fail`` fault (never by real code paths)."""
+
+
+FAULT_SITES = {
+    "nan_loss": "step",
+    "nan_params": "step",
+    "preempt": "step",
+    "stall": "sync",
+    "save_fail": "save",
+    "tear_save": "save",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: ``kind`` fires at the ``at``-th occurrence
+    (0-based) of its hook site; ``param`` is the kind-specific knob
+    (sleep seconds for ``stall``)."""
+
+    kind: str
+    at: int
+    param: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_SITES:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: "
+                f"{sorted(FAULT_SITES)}")
+        if self.at < 0:
+            raise ValueError(f"fault occurrence index must be >= 0, got "
+                             f"{self.at}")
+
+    @property
+    def site(self) -> str:
+        return FAULT_SITES[self.kind]
+
+
+def parse_faults(spec: str) -> tuple[FaultSpec, ...]:
+    """Parse a CLI/env fault plan: comma-separated ``kind@at[:param]``
+    entries, e.g. ``"nan_loss@1,stall@0:0.5"``."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise ValueError(
+                f"bad fault entry {entry!r}: expected kind@at[:param]")
+        kind, _, rest = entry.partition("@")
+        at_s, _, param_s = rest.partition(":")
+        out.append(FaultSpec(kind=kind.strip(), at=int(at_s),
+                             param=float(param_s) if param_s else 0.0))
+    return tuple(out)
+
+
+def _coerce_spec(f: "FaultSpec | str") -> FaultSpec:
+    if isinstance(f, FaultSpec):
+        return f
+    parsed = parse_faults(f)
+    if len(parsed) != 1:
+        raise ValueError(f"one fault entry expected, got {f!r}")
+    return parsed[0]
+
+
+class FaultInjector:
+    """Deterministic fault firing against named hook sites.
+
+    ``poll(site)`` advances the site's occurrence counter and returns the
+    specs scheduled for that occurrence (usually zero or one). A disabled
+    injector (empty plan) polls as a cheap no-op, so trainers can call it
+    unconditionally. ``on_fire`` (settable after construction — the
+    supervisor wires itself in) observes every firing for telemetry.
+    """
+
+    def __init__(self, faults: Sequence["FaultSpec | str"] = (),
+                 *, on_fire: Callable[[FaultSpec, str, int], None]
+                 | None = None):
+        self.plan: tuple[FaultSpec, ...] = tuple(
+            _coerce_spec(f) for f in (faults or ()))
+        self.on_fire = on_fire
+        self.fired: list[FaultSpec] = []
+        self._counts: dict[str, int] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.plan)
+
+    def poll(self, site: str) -> list[FaultSpec]:
+        if not self.plan:
+            return []
+        i = self._counts.get(site, 0)
+        self._counts[site] = i + 1
+        out = [s for s in self.plan if s.site == site and s.at == i]
+        for s in out:
+            self.fired.append(s)
+            if self.on_fire is not None:
+                self.on_fire(s, site, i)
+        return out
+
+    def maybe_stall(self, site: str = "sync") -> None:
+        """Poll ``site`` and serve any ``stall`` fault by sleeping — called
+        inside the watchdog-guarded region so the delay is observed."""
+        for spec in self.poll(site):
+            if spec.kind == "stall":
+                time.sleep(spec.param)
+
+
+def poison(tree: Any) -> Any:
+    """NaN every floating-point leaf of a pytree (the injected-NaN faults'
+    payload; non-float leaves pass through untouched)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: (jnp.full_like(x, jnp.nan)
+                   if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                   else x), tree)
+
+
+def tear_checkpoint(path: str) -> None:
+    """Simulate a torn checkpoint write: truncate every regular file under
+    ``path`` to half its size (the integrity manifest, when present, is left
+    intact so verification can catch the tear — exactly the state a crashed
+    writer or interrupted copy leaves behind)."""
+    from distributed_model_parallel_tpu.train.checkpoint import (
+        MANIFEST_FILENAME,
+    )
+
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            if fn == MANIFEST_FILENAME:
+                continue
+            p = os.path.join(root, fn)
+            size = os.path.getsize(p)
+            with open(p, "r+b") as f:
+                f.truncate(size // 2)

@@ -37,7 +37,13 @@ event      message (free-form: preemption, guard trips)
 memory     devices: [{id, platform, bytes_in_use, peak_bytes_in_use}]
 metrics    counters, gauges, histograms (registry snapshot)
 run_end    wall_s, plus caller extras
-failure    error, detail, attempts, stage
+failure    error, detail, attempts, stage — a detected failure (guards,
+           torn checkpoint, stall, preemption, unreachable backend)
+recovery   action, plus context (slot, epoch, retries_left, lr_scale) —
+           a recovery action taken by train/resilience.RecoverySupervisor;
+           every failure record the supervisor handles gets a matching
+           recovery record, and scripts/dmp_report.py renders the pair
+           timeline
 ========== ==========================================================
 """
 
@@ -478,6 +484,11 @@ class TelemetryRun:
 
     def failure(self, error: str, **fields) -> None:
         self.record("failure", error=error, **fields)
+
+    def recovery(self, action: str, **fields) -> None:
+        """One recovery action (restore, fallback, checkpoint-and-exit,
+        save retry) — the matching half of a ``failure`` record."""
+        self.record("recovery", action=action, **fields)
 
     def memory(self) -> list[dict] | None:
         """Record device memory watermarks (no-op record skipped when the

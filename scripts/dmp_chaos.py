@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Chaos smoke: one injected-NaN-recovers-and-finishes training loop.
+
+Runs a tiny data-parallel CNN fit (synthetic data, CPU-friendly) with a
+deterministic ``nan_loss`` fault injected at step 1 and the recovery
+supervisor armed (``utils/faults.py``, ``train/resilience.py``): the guards
+detect the NaN, the supervisor restores the last good checkpoint, shrinks
+the LR, retries the epoch, and training completes end to end. Prints the
+``dmp_report`` resilience timeline plus ONE parseable JSON summary line,
+and exits non-zero if the run did not both inject and recover.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/dmp_chaos.py [--epochs 2] \
+      [--faults nan_loss@1] [--retries 2] [--lr-shrink 0.5]
+
+This is the ``chaos`` test tier's executable recipe — see
+docs/RESILIENCE.md and ``pytest -m chaos``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", default=2, type=int)
+    p.add_argument("--faults", default="nan_loss@1",
+                   help="fault plan, e.g. 'nan_loss@1,stall@0:0.2'")
+    p.add_argument("--retries", default=2, type=int)
+    p.add_argument("--lr-shrink", default=0.5, type=float)
+    p.add_argument("--workdir", default=None,
+                   help="log/checkpoint root (default: a fresh tmp dir)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dmp_chaos_")
+
+    from distributed_model_parallel_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        OptimizerConfig,
+        RecoveryConfig,
+        TrainConfig,
+    )
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from distributed_model_parallel_tpu.utils.faults import parse_faults
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+    config = TrainConfig(
+        model=ModelConfig(name="tinycnn"),
+        data=DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
+                        synthetic_train_size=96, synthetic_eval_size=32),
+        optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=2),
+        mesh=MeshConfig(data=1),
+        epochs=args.epochs,
+        check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=args.retries,
+                                lr_shrink=args.lr_shrink,
+                                faults=parse_faults(args.faults)),
+        log_dir=os.path.join(workdir, "log"),
+        checkpoint_dir=os.path.join(workdir, "ckpt"),
+        log_every_n_steps=1000,
+    )
+    trainer = Trainer(config)
+    history = trainer.fit()
+
+    records = read_records(trainer.logger.jsonl_path)
+    failures = [r for r in records if r.get("kind") == "failure"]
+    recoveries = [r for r in records if r.get("kind") == "recovery"]
+
+    # The report's resilience timeline for the run we just chaos-tested.
+    from scripts.dmp_report import build_report
+
+    print(build_report(records))
+
+    summary = {
+        "chaos": "injected-nan-recovers",
+        "epochs_completed": len(history),
+        "faults_injected": [s.kind for s in trainer.faults.fired],
+        "failures_recorded": len(failures),
+        "recoveries_recorded": len(recoveries),
+        "retries_used": config.recovery.max_retries
+        - trainer.resilience.retries_left,
+        "final_lr": trainer.config.optimizer.learning_rate,
+        "telemetry": trainer.logger.jsonl_path,
+    }
+    print(json.dumps(summary), flush=True)
+    ok = (len(history) == args.epochs and trainer.faults.fired
+          and failures and recoveries)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,8 @@ directory (utils/xplane op breakdown) and prints:
 * communication volume per collective kind x mesh axis (trace-time
   estimates from ops/collectives.py);
 * device memory watermarks and recompilation counts;
+* the failure/recovery timeline (injected faults, non-finite restores,
+  stall escalations, torn-checkpoint fallbacks — train/resilience.py);
 * top-N device ops + per-category device time from the xplane trace
   (``--trace``), degrading to an actionable one-liner when the tensorflow
   proto bindings are absent.
@@ -184,6 +186,44 @@ def _memory_section(lines: list[str], by_kind: dict) -> None:
         lines.append(f"device {dev_id}: peak {_fmt_bytes(peak)} in use")
 
 
+def _resilience_section(lines: list[str], by_kind: dict) -> None:
+    """Failure / recovery timeline: every detected failure (non-finite,
+    stall, torn checkpoint, failed save, preemption) next to the recovery
+    action the supervisor took (train/resilience.py), in event order."""
+    fails = by_kind.get("failure") or []
+    recs = by_kind.get("recovery") or []
+    if not fails and not recs:
+        return
+    starts = by_kind.get("run_start") or []
+    t0 = starts[-1].get("ts") if starts else None
+    if t0 is None:
+        t0 = min((r.get("ts") for r in fails + recs
+                  if isinstance(r.get("ts"), (int, float))), default=0.0)
+    lines.append(f"== resilience ({len(fails)} failures, "
+                 f"{len(recs)} recoveries) ==")
+    events = sorted(fails + recs,
+                    key=lambda r: r.get("ts") or 0.0)
+    for r in events:
+        dt = (r["ts"] - t0) if isinstance(r.get("ts"), (int, float)) else 0.0
+        if r.get("kind") == "failure" or "error" in r:
+            extra = " ".join(
+                f"{k}={r[k]}" for k in ("epoch", "stage", "attempts",
+                                        "retries_left")
+                if r.get(k) is not None)
+            detail = str(r.get("detail", ""))[:100]
+            lines.append(f"  [+{dt:7.1f}s] failure   {r.get('error'):<24}"
+                         + (f" {extra}" if extra else "")
+                         + (f"  ({detail})" if detail else ""))
+        else:
+            extra = " ".join(
+                f"{k}={r[k]}" for k in ("slot", "epoch", "retries_left",
+                                        "lr_scale")
+                if r.get(k) is not None)
+            lines.append(f"  [+{dt:7.1f}s] recovery  "
+                         f"{str(r.get('action')):<24}"
+                         + (f" {extra}" if extra else ""))
+
+
 def _trace_section(lines: list[str], trace_dir: str, top: int) -> None:
     from distributed_model_parallel_tpu.utils import xplane
 
@@ -237,6 +277,7 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     _mfu_section(lines, meta, device, by_kind, times)
     _comm_section(lines, by_kind)
     _memory_section(lines, by_kind)
+    _resilience_section(lines, by_kind)
 
     epochs = by_kind.get("epoch", [])
     if epochs:

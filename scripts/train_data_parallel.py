@@ -99,6 +99,25 @@ def parse_args():
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     p.add_argument("--num-devices", default=0, type=int,
                    help="data-parallel width (0 = all visible devices)")
+    p.add_argument("--check-finite-every", default=0, type=int,
+                   help="check drained metrics every sync and the params "
+                        "every N steps for NaN/Inf (0 = off)")
+    p.add_argument("--stall-budget", default=None, type=float, metavar="S",
+                   help="arm the live stall watchdog around blocking syncs")
+    p.add_argument("--recovery-retries", default=0, type=int,
+                   help="automatic recovery: restore the last good "
+                        "checkpoint and retry the epoch on non-finite "
+                        "detections, up to N times (0 = fail fast; needs "
+                        "--check-finite-every)")
+    p.add_argument("--recovery-lr-shrink", default=1.0, type=float,
+                   help="multiply the LR by this factor on every "
+                        "non-finite recovery (e.g. 0.5)")
+    p.add_argument("--stall-exit", action="store_true",
+                   help="escalate a stall-budget overrun to a graceful "
+                        "checkpoint-and-exit")
+    p.add_argument("--inject-faults", default=None, metavar="PLAN",
+                   help="deterministic chaos plan, e.g. "
+                        "'nan_loss@1,stall@0:0.5' (utils/faults.py)")
     p.add_argument("--dcn-data", default=1, type=int,
                    help="how many data-parallel ways cross the host (DCN) "
                         "boundary; must divide the data width. Lays the mesh "
@@ -110,6 +129,14 @@ def parse_args():
 def main():
     args = parse_args()
     best_effort_distributed_init()
+    # First device contact, hardened (bench.py's bounded-retry pattern): a
+    # permanently unreachable backend becomes one parseable JSON record +
+    # exit 17, never a traceback (utils/device_contact.py).
+    from distributed_model_parallel_tpu.utils.device_contact import (
+        require_devices,
+    )
+
+    require_devices("train-data-parallel")
     import jax
 
     if args.ddp and args.fsdp:
@@ -125,6 +152,15 @@ def main():
               file=sys.stderr)
     n = args.num_devices or len(jax.devices())
     steps_per_epoch = max(1, 50000 // args.batch_size)
+    from distributed_model_parallel_tpu.config import RecoveryConfig
+    from distributed_model_parallel_tpu.utils.faults import parse_faults
+
+    recovery = RecoveryConfig(
+        max_retries=args.recovery_retries,
+        lr_shrink=args.recovery_lr_shrink,
+        stall_exit=args.stall_exit,
+        faults=parse_faults(args.inject_faults) if args.inject_faults
+        else ())
     config = TrainConfig(
         model=ModelConfig(name=args.model,
                           batchnorm=("none" if args.no_bn
@@ -151,6 +187,9 @@ def main():
         strategy="ddp" if args.ddp else ("fsdp" if args.fsdp else "gspmd"),
         ddp_bucket_bytes=args.bucket_mb * 1024 * 1024 or None,
         ddp_allreduce=args.allreduce,
+        check_finite_every=args.check_finite_every,
+        stall_budget_s=args.stall_budget,
+        recovery=recovery,
         log_name=args.log_name or f"data_para_{args.batch_size}",
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer

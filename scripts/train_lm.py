@@ -80,12 +80,35 @@ def parse_args():
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--check-finite-every", default=0, type=int,
+                   help="check loss every step and params every N steps "
+                        "for NaN/Inf (0 = off)")
+    p.add_argument("--recovery-retries", default=0, type=int,
+                   help="restore the last good checkpoint and retry the "
+                        "epoch on non-finite detections, up to N times")
+    p.add_argument("--recovery-lr-shrink", default=1.0, type=float,
+                   help="multiply the LR by this factor on every recovery")
+    p.add_argument("--inject-faults", default=None, metavar="PLAN",
+                   help="deterministic chaos plan, e.g. 'nan_loss@3' "
+                        "(utils/faults.py)")
     return p.parse_args()
 
 
 def main():
     args = parse_args()
-    from distributed_model_parallel_tpu.config import MeshConfig, OptimizerConfig
+    # First device contact, hardened (bench.py's bounded-retry pattern):
+    # an unreachable backend becomes one parseable JSON record + exit 17.
+    from distributed_model_parallel_tpu.utils.device_contact import (
+        require_devices,
+    )
+
+    require_devices("train-lm")
+    from distributed_model_parallel_tpu.config import (
+        MeshConfig,
+        OptimizerConfig,
+        RecoveryConfig,
+    )
+    from distributed_model_parallel_tpu.utils.faults import parse_faults
     from distributed_model_parallel_tpu.models.transformer import TransformerConfig
     from distributed_model_parallel_tpu.train.lm_trainer import (
         LMTrainConfig,
@@ -126,6 +149,12 @@ def main():
         pipeline_schedule=args.schedule,
         virtual_stages=args.virtual_stages,
         steps_per_epoch=args.steps, epochs=args.epochs, resume=args.resume,
+        check_finite_every=args.check_finite_every,
+        recovery=RecoveryConfig(
+            max_retries=args.recovery_retries,
+            lr_shrink=args.recovery_lr_shrink,
+            faults=parse_faults(args.inject_faults) if args.inject_faults
+            else ()),
     )
     LMTrainer(config).fit()
 

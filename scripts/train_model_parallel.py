@@ -81,6 +81,13 @@ def parse_args():
 
 def main():
     args = parse_args()
+    # First device contact, hardened (bench.py's bounded-retry pattern):
+    # an unreachable backend becomes one parseable JSON record + exit 17.
+    from distributed_model_parallel_tpu.utils.device_contact import (
+        require_devices,
+    )
+
+    require_devices("train-model-parallel")
     boundaries = (None if args.boundaries is None else
                   [int(x) for x in args.boundaries.split(",")])
     if boundaries is not None and args.auto_partition:

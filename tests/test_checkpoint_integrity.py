@@ -1,0 +1,205 @@
+"""Checkpointer crash window: torn/truncated newest versions and leftover
+orbax tmp dirs must be skipped in favor of the previous committed version —
+with and without the per-checkpoint integrity manifest — plus keep-K
+retention and the save-site fault hooks (ISSUE 2 satellite)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.train.checkpoint import (
+    MANIFEST_FILENAME,
+    CheckpointIntegrityError,
+    Checkpointer,
+    verify_manifest,
+    write_manifest,
+)
+from distributed_model_parallel_tpu.utils.faults import (
+    FaultInjector,
+    InjectedFaultError,
+    tear_checkpoint,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _tree(v: float):
+    return {"w": jnp.full((4, 4), v), "step": jnp.asarray(int(v), jnp.int32)}
+
+
+def _assert_w(restored, v: float):
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((4, 4), v, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# manifest write/verify
+# ---------------------------------------------------------------------------
+
+def test_manifest_written_at_save_and_verifies(tmp_path):
+    ckpt = Checkpointer(str(tmp_path))
+    path = ckpt.save(_tree(1.0), "m")
+    mpath = os.path.join(path, MANIFEST_FILENAME)
+    assert os.path.exists(mpath)
+    assert verify_manifest(path) is None
+    manifest = json.load(open(mpath))
+    assert manifest["files"]               # records real files
+    assert MANIFEST_FILENAME not in manifest["files"]
+
+
+def test_manifest_catches_truncation_and_missing_files(tmp_path):
+    ckpt = Checkpointer(str(tmp_path))
+    path = ckpt.save(_tree(1.0), "m")
+    # Truncate one recorded file -> size mismatch.
+    rel, meta = next(iter(json.load(
+        open(os.path.join(path, MANIFEST_FILENAME)))["files"].items()))
+    with open(os.path.join(path, rel), "r+b") as f:
+        f.truncate(max(0, meta["size"] - 1))
+    assert "mismatch" in verify_manifest(path)
+    # Remove it entirely -> missing file.
+    os.remove(os.path.join(path, rel))
+    assert "missing file" in verify_manifest(path)
+
+
+def test_manifest_catches_bitflip(tmp_path):
+    ckpt = Checkpointer(str(tmp_path))
+    path = ckpt.save(_tree(1.0), "m")
+    files = json.load(open(os.path.join(path, MANIFEST_FILENAME)))["files"]
+    # Same-size corruption: only the checksum can see it.
+    rel = max(files, key=lambda r: files[r]["size"])
+    p = os.path.join(path, rel)
+    data = bytearray(open(p, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    assert "checksum" in verify_manifest(path)
+
+
+def test_manifest_absent_reports_missing(tmp_path):
+    os.makedirs(tmp_path / "bare")
+    assert verify_manifest(str(tmp_path / "bare")) == "missing"
+    write_manifest(str(tmp_path / "bare"))
+    assert verify_manifest(str(tmp_path / "bare")) is None
+
+
+# ---------------------------------------------------------------------------
+# crash window: torn newest + leftover tmp dirs skipped for the previous
+# committed version, with and without the manifest
+# ---------------------------------------------------------------------------
+
+def test_leftover_orbax_tmp_dir_is_skipped(tmp_path):
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(_tree(1.0), "ck")
+    # A crashed writer leaves an uncommitted orbax tmp dir with a higher
+    # version number — it must never count as a committed version.
+    os.makedirs(tmp_path / "ck-7.orbax-checkpoint-tmp")
+    assert ckpt._versions("ck") == [0]
+    assert ckpt.exists("ck")
+    _assert_w(ckpt.restore(_tree(0.0), "ck"), 1.0)
+    _assert_w(ckpt.restore(_tree(0.0), "ck", allow_fallback=True), 1.0)
+
+
+@pytest.mark.parametrize("with_manifest", [True, False])
+def test_torn_newest_falls_back_to_previous(tmp_path, with_manifest):
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(_tree(1.0), "ck")
+    newest = ckpt.save(_tree(2.0), "ck")
+    if not with_manifest:
+        os.remove(os.path.join(newest, MANIFEST_FILENAME))
+    tear_checkpoint(newest)       # truncates files, keeps any manifest
+    # Fallback restore lands on the previous committed version.
+    seen = []
+    restored = ckpt.restore(_tree(0.0), "ck", allow_fallback=True,
+                            on_fallback=lambda p, r: seen.append((p, r)))
+    _assert_w(restored, 1.0)
+    assert len(seen) == 1 and seen[0][0] == newest
+    if with_manifest:
+        assert "mismatch" in seen[0][1]
+    # Without fallback the torn newest stays a loud failure.
+    with pytest.raises(Exception):
+        ckpt.restore(_tree(0.0), "ck")
+
+
+def test_all_versions_torn_raises_integrity_error(tmp_path):
+    ckpt = Checkpointer(str(tmp_path))
+    path = ckpt.save(_tree(1.0), "ck")
+    tear_checkpoint(path)
+    with pytest.raises(CheckpointIntegrityError, match="no restorable"):
+        ckpt.restore(_tree(0.0), "ck", allow_fallback=True)
+
+
+def test_intact_manifest_restore_error_fails_fast(tmp_path):
+    """A manifest-verified version that fails to restore is a structure
+    problem, not corruption — fallback must NOT paper over it with stale
+    weights from an older version."""
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(_tree(1.0), "ck")
+    ckpt.save(_tree(2.0), "ck")
+    wrong_template = {"different": {"layout": jnp.zeros((2,))}}
+    with pytest.raises(Exception) as ei:
+        ckpt.restore(wrong_template, "ck", allow_fallback=True)
+    assert not isinstance(ei.value, CheckpointIntegrityError)
+
+
+# ---------------------------------------------------------------------------
+# keep-K retention
+# ---------------------------------------------------------------------------
+
+def test_keep_k_retention(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=2)
+    for v in range(5):
+        ckpt.save(_tree(float(v)), "ck")
+    # At most keep+1 versions transiently; older ones pruned at save time.
+    assert len(ckpt._versions("ck")) <= 3
+    assert ckpt._versions("ck")[-1] == 4
+    # One more save prunes down to the newest keep + the fresh one.
+    ckpt.save(_tree(5.0), "ck")
+    assert ckpt._versions("ck")[-2:] == [4, 5]
+
+
+def test_keep_1_matches_legacy_behavior(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), keep=1)
+    for v in range(4):
+        ckpt.save(_tree(float(v)), "ck")
+    assert len(ckpt._versions("ck")) <= 2
+    _assert_w(ckpt.restore(_tree(0.0), "ck"), 3.0)
+
+
+# ---------------------------------------------------------------------------
+# injected save faults (utils/faults.py save site)
+# ---------------------------------------------------------------------------
+
+def test_injected_save_fail_leaves_torn_dir_next_save_recovers(tmp_path):
+    inj = FaultInjector(["save_fail@1"])
+    ckpt = Checkpointer(str(tmp_path), injector=inj)
+    ckpt.save(_tree(1.0), "ck")            # save[0] commits normally
+    with pytest.raises(InjectedFaultError):
+        ckpt.save(_tree(2.0), "ck")        # save[1] dies mid-write
+    # The torn dir pollutes the version listing but fallback skips it.
+    _assert_w(ckpt.restore(_tree(0.0), "ck", allow_fallback=True), 1.0)
+    # And the next save commits a fresh working version on top.
+    ckpt.save(_tree(3.0), "ck")
+    _assert_w(ckpt.restore(_tree(0.0), "ck", allow_fallback=True), 3.0)
+
+
+def test_injected_tear_save_corrupts_committed_version(tmp_path):
+    inj = FaultInjector(["tear_save@1"])
+    ckpt = Checkpointer(str(tmp_path), injector=inj)
+    ckpt.save(_tree(1.0), "ck")
+    torn = ckpt.save(_tree(2.0), "ck")     # commits, then torn on disk
+    assert verify_manifest(torn) not in (None, "missing")
+    _assert_w(ckpt.restore(_tree(0.0), "ck", allow_fallback=True), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# async saves still get manifests (written at the next wait point)
+# ---------------------------------------------------------------------------
+
+def test_async_save_manifest_written_at_wait(tmp_path):
+    ckpt = Checkpointer(str(tmp_path))
+    path = ckpt.save(_tree(1.0), "ck", wait=False)
+    ckpt.wait_until_finished()
+    assert os.path.exists(os.path.join(path, MANIFEST_FILENAME))
+    assert verify_manifest(path) is None

@@ -46,6 +46,34 @@ def test_check_finite():
         check_finite({"a": jnp.array([float("inf")])})
 
 
+def test_check_finite_single_device_get(monkeypatch):
+    """The whole tree must come to host in ONE jax.device_get (one blocking
+    round trip), not one per leaf — and the scan raises at the first bad
+    leaf it meets."""
+    from distributed_model_parallel_tpu.train import guards
+
+    calls = []
+    real_get = jax.device_get
+
+    def counting_get(x):
+        calls.append(x)
+        return real_get(x)
+
+    monkeypatch.setattr(guards.jax, "device_get", counting_get)
+    tree = {f"leaf{i}": jnp.full((3,), float(i)) for i in range(10)}
+    check_finite(tree)
+    assert len(calls) == 1
+    calls.clear()
+    tree["leaf3"] = jnp.array([float("nan")])
+    with pytest.raises(NonFiniteError, match="leaf3"):
+        check_finite(tree)
+    assert len(calls) == 1
+    # Empty trees short-circuit without a fetch.
+    calls.clear()
+    check_finite({})
+    assert calls == []
+
+
 def test_stall_detector():
     s = StallDetector(budget_s=0.01)
     with s.step():

@@ -1,0 +1,384 @@
+"""Chaos tier: fault injection (utils/faults.py) + automatic recovery
+(train/resilience.py). Each fault class from the taxonomy — non-finite
+step, simulated preemption, stalled sync, failed save — is injected
+deterministically and shown to recover automatically: training completes
+from the last good state, with the matching ``failure`` + ``recovery``
+telemetry records visible in the dmp_report output. (The torn-checkpoint
+class lives in tests/test_checkpoint_integrity.py.)"""
+
+import dataclasses
+import json
+
+import pytest
+
+import jax
+
+from distributed_model_parallel_tpu.config import MeshConfig, RecoveryConfig
+from distributed_model_parallel_tpu.train.guards import NonFiniteError
+from distributed_model_parallel_tpu.train.resilience import Watchdog
+from distributed_model_parallel_tpu.utils import faults as faults_mod
+from distributed_model_parallel_tpu.utils.faults import (
+    FaultInjector,
+    FaultSpec,
+    parse_faults,
+)
+from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+from tests.conftest import tiny_train_config
+
+pytestmark = pytest.mark.chaos
+
+
+def _events(trainer):
+    recs = read_records(trainer.logger.jsonl_path)
+    return ([r for r in recs if r.get("kind") == "failure"],
+            [r for r in recs if r.get("kind") == "recovery"])
+
+
+# ---------------------------------------------------------------------------
+# the injector itself
+# ---------------------------------------------------------------------------
+
+def test_parse_faults_roundtrip():
+    specs = parse_faults("nan_loss@2, stall@0:0.5,preempt@7")
+    assert specs == (FaultSpec("nan_loss", 2), FaultSpec("stall", 0, 0.5),
+                     FaultSpec("preempt", 7))
+    assert specs[0].site == "step" and specs[1].site == "sync"
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_faults("explode@1")
+    with pytest.raises(ValueError, match="kind@at"):
+        parse_faults("nan_loss")
+
+
+def test_injector_fires_once_deterministically():
+    fired = []
+    inj = FaultInjector(["nan_loss@1", FaultSpec("stall", 0, 0.0)],
+                        on_fire=lambda s, site, i: fired.append((s.kind, i)))
+    assert inj.enabled
+    assert inj.poll("step") == []                      # step[0]
+    assert [s.kind for s in inj.poll("step")] == ["nan_loss"]  # step[1]
+    assert inj.poll("step") == []                      # step[2]: once only
+    assert [s.kind for s in inj.poll("sync")] == ["stall"]
+    assert fired == [("nan_loss", 1), ("stall", 0)]
+    assert [s.kind for s in inj.fired] == ["nan_loss", "stall"]
+
+
+def test_disabled_injector_is_noop():
+    inj = FaultInjector()
+    assert not inj.enabled
+    assert inj.poll("step") == []
+
+
+# ---------------------------------------------------------------------------
+# the watchdog (live logging + escalation)
+# ---------------------------------------------------------------------------
+
+class _Lines:
+    def __init__(self):
+        self.lines = []
+
+    def log_line(self, msg):
+        self.lines.append(msg)
+
+
+def test_watchdog_logs_live_and_escalates():
+    import time
+
+    log = _Lines()
+    escalations = []
+    wd = Watchdog(0.08, interval_s=0.02, logger=log,
+                  on_escalate=lambda what, dt: escalations.append(dt))
+    with wd.watch("sync"):
+        time.sleep(0.3)
+    # Live lines appeared WHILE the sync was blocked, before it returned.
+    assert any("still blocked" in ln for ln in log.lines)
+    assert wd.stalled and wd.worst_s >= 0.3
+    assert len(escalations) == 1          # escalation fires exactly once
+    with wd.watch("sync"):
+        time.sleep(0.3)
+    assert len(escalations) == 1
+    # The historical post-hoc overrun line survives for quick budgets.
+    assert any("stall budget" in ln for ln in log.lines)
+
+
+def test_watchdog_quiet_when_fast():
+    log = _Lines()
+    wd = Watchdog(5.0, interval_s=0.05, logger=log)
+    with wd.watch("sync"):
+        pass
+    assert not wd.stalled and log.lines == []
+
+
+# ---------------------------------------------------------------------------
+# fault class 1: non-finite step -> restore + retry (+ LR shrink)
+# ---------------------------------------------------------------------------
+
+def test_trainer_nan_recovery_completes(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(
+        tmp_path, epochs=2, check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=2, lr_shrink=0.5,
+                                faults=("nan_loss@1",)))
+    t = Trainer(cfg)
+    lr0 = t.config.optimizer.learning_rate
+    hist = t.fit()
+    # Training recovered and finished every epoch.
+    assert [h["epoch"] for h in hist] == [0, 1]
+    assert [s.kind for s in t.faults.fired] == ["nan_loss"]
+    assert t.resilience.retries_left == 1
+    assert t.config.optimizer.learning_rate == pytest.approx(lr0 * 0.5)
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite"]
+    assert [r["action"] for r in recoveries] == ["restored"]
+    # The report renders the failure/recovery pair on one timeline.
+    from scripts.dmp_report import build_report
+
+    report = build_report(read_records(t.logger.jsonl_path))
+    assert "== resilience (1 failures, 1 recoveries) ==" in report
+    assert "non-finite" in report and "restored" in report
+
+
+def test_trainer_nan_retry_budget_exhausts(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    # 96 samples / batch 32 = 3 steps per epoch: the second injected NaN
+    # (first step of the retried epoch) exhausts the single-retry budget.
+    cfg = tiny_train_config(
+        tmp_path, epochs=2, check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=1,
+                                faults=("nan_loss@0", "nan_loss@3")))
+    t = Trainer(cfg)
+    with pytest.raises(NonFiniteError):
+        t.fit()
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite", "non-finite"]
+    assert [r["action"] for r in recoveries] == ["restored"]
+
+
+def test_nan_fault_plan_requires_finite_checks():
+    """Injecting a NaN nothing can detect is a misconfigured chaos plan —
+    rejected loudly at supervisor construction."""
+    from distributed_model_parallel_tpu.train.resilience import (
+        RecoverySupervisor,
+    )
+
+    with pytest.raises(ValueError, match="check_finite_every"):
+        RecoverySupervisor(RecoveryConfig(faults=("nan_loss@0",)),
+                           logger=None, ckpt=None, preemption=None,
+                           check_finite_every=0)
+
+
+def test_recovery_disabled_keeps_failfast(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(tmp_path, epochs=1, check_finite_every=1,
+                            recovery=RecoveryConfig(
+                                faults=("nan_loss@0",)))
+    t = Trainer(cfg)
+    assert not t.resilience.enabled
+    with pytest.raises(NonFiniteError):
+        t.fit()
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite"]
+    assert recoveries == []        # detection recorded, no action taken
+
+
+def test_lm_trainer_nan_recovery(tmp_path):
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+
+    cfg = LMTrainConfig(
+        model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_seq_len=32),
+        batch_size=4, seq_len=16, steps_per_epoch=3, epochs=2,
+        n_tokens=2000, check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=1, lr_shrink=0.5,
+                                faults=("nan_loss@1",)),
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    t = LMTrainer(cfg)
+    lr0 = t.config.optimizer.learning_rate
+    hist = t.fit()
+    assert len(hist) == 2
+    assert t.config.optimizer.learning_rate == pytest.approx(lr0 * 0.5)
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite"]
+    assert [r["action"] for r in recoveries] == ["restored"]
+
+
+def test_pipeline_trainer_nan_recovery(tmp_path):
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    cfg = tiny_train_config(
+        tmp_path, epochs=1, mesh=MeshConfig(stage=2), check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=1, faults=("nan_loss@0",)))
+    t = PipelineTrainer(cfg)
+    hist = t.fit()
+    assert len(hist) == 1
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite"]
+    assert [r["action"] for r in recoveries] == ["restored"]
+
+
+def test_pipeline_trainer_rejects_lr_shrink(tmp_path):
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    cfg = tiny_train_config(
+        tmp_path, mesh=MeshConfig(stage=2),
+        recovery=RecoveryConfig(max_retries=1, lr_shrink=0.5))
+    with pytest.raises(ValueError, match="lr_shrink"):
+        PipelineTrainer(cfg)
+
+
+# ---------------------------------------------------------------------------
+# fault class 2: simulated preemption -> checkpoint-and-exit -> resume
+# ---------------------------------------------------------------------------
+
+def test_preempt_injection_checkpoints_and_resumes(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(tmp_path, epochs=2,
+                            recovery=RecoveryConfig(faults=("preempt@1",)))
+    t = Trainer(cfg)
+    hist = t.fit()
+    assert hist == []                      # preempted inside epoch 0
+    assert t.ckpt.exists("preempt")
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["preempted"]
+    assert [r["action"] for r in recoveries] == ["checkpoint-and-exit"]
+    # A fresh trainer resumes from the preemption save and completes.
+    t2 = Trainer(cfg.replace(resume=True,
+                             recovery=RecoveryConfig()))
+    assert t2.start_epoch == 0             # redo the interrupted epoch
+    hist2 = t2.fit()
+    assert [h["epoch"] for h in hist2] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# fault class 3: stalled sync -> live watchdog -> checkpoint-and-exit
+# ---------------------------------------------------------------------------
+
+def test_stall_injection_escalates_to_checkpoint_and_exit(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(
+        tmp_path, epochs=3, stall_budget_s=0.05,
+        recovery=RecoveryConfig(max_retries=1, stall_exit=True,
+                                watchdog_interval_s=0.02,
+                                faults=("stall@0:0.3",)))
+    t = Trainer(cfg)
+    hist = t.fit()
+    assert len(hist) < 3                   # exited early, gracefully
+    assert t.ckpt.exists("preempt")
+    failures, recoveries = _events(t)
+    assert "stall" in [f["error"] for f in failures]
+    assert "preempted" in [f["error"] for f in failures]
+    assert [r["action"] for r in recoveries] == ["checkpoint-and-exit"]
+    # The watchdog logged a live line while the sync was still blocked.
+    log_text = "".join(p.read_text() for p in (tmp_path / "log").glob("*.txt"))
+    assert "still blocked" in log_text
+    # The preempt slot makes the run resumable (resume-completes is
+    # exercised end to end by test_preempt_injection_checkpoints_and_resumes).
+    assert t.start_epoch == len(hist)
+
+
+def test_stall_without_stall_exit_only_logs(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(
+        tmp_path, epochs=1, stall_budget_s=0.05,
+        recovery=RecoveryConfig(max_retries=1, watchdog_interval_s=0.02,
+                                faults=("stall@0:0.2",)))
+    t = Trainer(cfg)
+    hist = t.fit()
+    assert len(hist) == 1                  # run completes — no escalation
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["stall"]
+    assert recoveries == []
+
+
+# ---------------------------------------------------------------------------
+# fault class 4: failed save -> retry -> training continues
+# ---------------------------------------------------------------------------
+
+def test_save_fail_retried_and_training_completes(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    # save[0] is the supervisor's initial good-slot seed: it dies
+    # mid-write, the retry succeeds, training is unaffected.
+    cfg = tiny_train_config(
+        tmp_path, epochs=1, check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=1, faults=("save_fail@0",)))
+    t = Trainer(cfg)
+    hist = t.fit()
+    assert len(hist) == 1
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["checkpoint-save-failed"]
+    assert [r["action"] for r in recoveries] == ["save-retried"]
+    # The torn directory the fault left behind is skipped on restore.
+    assert t.ckpt.exists("good")
+
+
+# ---------------------------------------------------------------------------
+# fault class 5: torn newest checkpoint -> manifest verify -> fallback
+# (unit-level coverage in tests/test_checkpoint_integrity.py; this is the
+# in-training demonstration with the telemetry pair)
+# ---------------------------------------------------------------------------
+
+def test_torn_good_slot_falls_back_during_recovery(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    # save site occurrences: 0 = begin()'s good seed (commits fine);
+    # 1 = epoch 0's best-acc save; 2 = epoch 0's good save — TORN after
+    # commit. The NaN at step 4 (epoch 1, step 1) then restores the good
+    # slot: its newest version fails manifest verification and the restore
+    # falls back to the intact epoch-0 seed.
+    cfg = tiny_train_config(
+        tmp_path, epochs=2, check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=1,
+                                faults=("tear_save@2", "nan_loss@4")))
+    t = Trainer(cfg)
+    hist = t.fit()
+    assert [h["epoch"] for h in hist] == [0, 1]     # completed despite both
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite",
+                                             "checkpoint-torn"]
+    assert [r["action"] for r in recoveries] == ["checkpoint-fallback",
+                                                 "restored"]
+    from scripts.dmp_report import build_report
+
+    report = build_report(read_records(t.logger.jsonl_path))
+    assert "checkpoint-torn" in report and "checkpoint-fallback" in report
+
+
+# ---------------------------------------------------------------------------
+# the chaos smoke entry + report timeline
+# ---------------------------------------------------------------------------
+
+def test_dmp_chaos_smoke_inprocess(tmp_path, capsys):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "dmp_chaos", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "dmp_chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--workdir", str(tmp_path), "--epochs", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== resilience" in out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["epochs_completed"] == 2
+    assert summary["faults_injected"] == ["nan_loss"]
+    assert summary["recoveries_recorded"] >= 1

@@ -303,8 +303,14 @@ def test_checkpoint_versioning_never_deletes_last_committed(tmp_path):
     restored = ckpt.restore({"w": jnp.zeros(4)}, "t")
     np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(4.0) + 1)
     p2 = ckpt.save({"w": jnp.arange(4.0) + 2}, "t")
-    assert not os.path.exists(p0)   # pruned once two newer commits exist
     assert os.path.exists(p2)
+    # keep-K retention (default K=2, the torn-newest fallback horizon —
+    # train/checkpoint.py): the oldest version is pruned only at the save
+    # AFTER K newer commits exist.
+    assert os.path.exists(p0)
+    p3 = ckpt.save({"w": jnp.arange(4.0) + 3}, "t")
+    assert not os.path.exists(p0)
+    assert all(os.path.exists(p) for p in (p1, p2, p3))
 
 
 def test_checkpoint_legacy_dir_pruned_after_versioned_commit(tmp_path):
