@@ -168,6 +168,17 @@ class RecoveryConfig:
     # Watchdog log cadence while a sync is blocked (None = budget/2, capped
     # to [0.05s, 30s]).
     watchdog_interval_s: float | None = None
+    # Hard bound on a consistency check's blocking operations: the host
+    # rendezvous before its cross-host collectives (multi-process runs)
+    # AND the fingerprint fetch itself (any run, including single-process).
+    # A wedged or missing participant then surfaces as a typed "straggler"
+    # failure record + StragglerTimeoutError — fatal unless caught — instead
+    # of hanging the very check meant to catch divergence (mesh.
+    # barrier_with_timeout). Size it well above a slow-but-healthy
+    # steady-state fetch; the FIRST check automatically gets a 10x grace
+    # for one-time compile + cross-host compile skew. None = unbounded
+    # (the stall watchdog still logs/escalates).
+    barrier_timeout_s: float | None = None
     # Deterministic fault-injection plan (utils/faults.py): FaultSpec
     # entries or "kind@at[:param]" strings, e.g. ("nan_loss@1",). Empty =
     # no chaos.
@@ -214,6 +225,16 @@ class TrainConfig:
     # forever on dist.recv, distributed_layers.py:20).
     check_finite_every: int = 0
     stall_budget_s: float | None = None
+    # Cross-replica consistency sentinel (train/consistency.py): every N
+    # steps fingerprint params + optimizer state on device (per-leaf
+    # finiteness / L2 / checksum), compare across the data-parallel axis,
+    # and repair a minority-outlier replica in place by re-broadcasting
+    # from a majority-good one (no quorum -> good-slot restore via the
+    # recovery supervisor). 0 = off. Detects the silent data corruption
+    # and replica drift the finiteness guards are blind to. Requires
+    # replicated state: strategy "fsdp" (params sharded over data) rejects
+    # it loudly.
+    consistency_every: int = 0
     # Automatic recovery policy + fault-injection plan
     # (train/resilience.py, utils/faults.py). Off by default.
     recovery: RecoveryConfig = dataclasses.field(

@@ -85,6 +85,15 @@ class MeshSpec:
         return self.config.data_axis
 
     @property
+    def data_axes(self) -> tuple[str, ...]:
+        """``data_axis`` normalized to a tuple — the spelling collectives
+        and shard_map axis lists want regardless of whether the data axis
+        is flat or dcn-factored. ``num_data`` is the replica count over
+        exactly these axes (the dcn factor included)."""
+        da = self.data_axis
+        return (da,) if isinstance(da, str) else tuple(da)
+
+    @property
     def dcn_axis(self) -> str | None:
         """The cross-host sub-axis of data parallelism (None on one host)."""
         return DCN_AXIS if self.config.dcn_data > 1 else None
@@ -215,3 +224,53 @@ def local_batch_slice(global_batch: int, spec: MeshSpec) -> int:
     if global_batch % d:
         raise ValueError(f"global batch {global_batch} not divisible by data={d}")
     return global_batch // d
+
+
+class StragglerTimeoutError(RuntimeError):
+    """A barrier/collective did not complete within its budget: one
+    participant (host or device) is wedged or gone. Raised by
+    :func:`barrier_with_timeout` so the caller reports a straggler event
+    instead of hanging forever — the reference's failure mode
+    (``dist.recv`` blocks eternally on a dead rank,
+    ``distributed_layers.py:20``)."""
+
+
+def barrier_with_timeout(fn, timeout_s: float, *, what: str = "barrier",
+                         on_timeout=None):
+    """Run the blocking rendezvous ``fn()`` with a wall-clock budget.
+
+    ``fn`` (e.g. ``ops.collectives.mesh_barrier``) runs on a daemon worker
+    thread; if it completes within ``timeout_s`` its result is returned
+    (or its exception re-raised). On timeout, ``on_timeout(what,
+    timeout_s)`` is invoked (telemetry hook) and
+    :class:`StragglerTimeoutError` is raised. The wedged call itself
+    cannot be cancelled — the worker thread is left blocked (daemonized,
+    so it never holds up process exit); the point is that the *caller*
+    gets control back to record the straggler and escalate, instead of
+    inheriting the hang.
+    """
+    import threading
+
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"dmp-barrier-{what}")
+    t.start()
+    if not done.wait(timeout_s):
+        if on_timeout is not None:
+            on_timeout(what, timeout_s)
+        raise StragglerTimeoutError(
+            f"{what} did not complete within {timeout_s:.1f}s — a "
+            f"participant is wedged or missing (straggler)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")

@@ -226,6 +226,47 @@ def hierarchical_psum_tree(tree: Any, inner_axis: str, outer_axis: str, *,
     return unflatten_like(red, tree)
 
 
+_BARRIER_CACHE: dict = {}
+
+
+def mesh_barrier(spec: Any) -> float:
+    """Device-level rendezvous over EVERY axis of ``spec.mesh``: a
+    scalar psum that cannot complete until all devices (and, on a
+    multi-process mesh, all hosts) participate — then blocks until done.
+
+    The building block the consistency sentinel's pre-check barrier uses
+    on multiprocess runs: wrapped in ``mesh.barrier_with_timeout`` it
+    turns a wedged or missing host into a reported straggler instead of
+    an eternal hang in the first cross-host collective
+    (train/consistency.py). Returns the world size (= psum of 1), which
+    doubles as a cheap sanity check.
+    """
+    import jax
+
+    mesh = spec.mesh
+    # pop + reinsert keeps insertion order = recency, so the bound below
+    # evicts the LEAST-recently-used entry, never a hot mesh's barrier.
+    fn = _BARRIER_CACHE.pop(mesh, None)
+    if fn is None:
+        names = tuple(mesh.axis_names)
+        n = int(np.prod(mesh.devices.shape))
+        record_collective("psum", names, 4, n)
+
+        def body():
+            return jax.lax.psum(jnp.ones((), jnp.float32), names)
+
+        from jax.sharding import PartitionSpec as P
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(), out_specs=P(), check_vma=False))
+    _BARRIER_CACHE[mesh] = fn
+    if len(_BARRIER_CACHE) > 8:              # bound the compiled-fn cache
+        _BARRIER_CACHE.pop(next(iter(_BARRIER_CACHE)))
+    out = fn()
+    out.block_until_ready()
+    return float(out)
+
+
 def unused_param_mask(grads: Any) -> Any:
     """Per-leaf boolean: True where a gradient is identically zero.
 

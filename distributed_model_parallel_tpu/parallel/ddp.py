@@ -18,6 +18,15 @@ GSPMD path in ``train/trainer.py`` where XLA infers the allreduce:
   DDP's bucket_cap_mb;
 * parameters stay replicated and the optimizer step runs identically on every
   replica (DDP's invariant).
+
+That last invariant — bitwise-identical params/opt_state on every replica —
+is exactly what silent data corruption breaks and what the consistency
+sentinel (train/consistency.py) polices: params and optimizer state (specs
+``P()``) are fingerprinted and compared across the data axis, while the
+per-replica BatchNorm state (spec ``P(data)``) is *legitimately* divergent
+and excluded by the sentinel's sharding filter.
+:func:`assert_ddp_replicated` is the direct, fetch-everything spelling of
+the same invariant for tests and post-mortems.
 """
 
 from __future__ import annotations
@@ -51,6 +60,18 @@ def replicate_model_state(state: Any, num_replicas: int) -> Any:
     """Give BN state a leading per-replica axis (to be sharded over 'data')."""
     return jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (num_replicas,) + x.shape), state)
+
+
+def assert_ddp_replicated(state: "TrainState") -> None:
+    """Verify DDP's replication invariant directly: params and opt_state
+    must be bitwise-identical on every device (model_state is per-replica
+    by design and skipped). The exhaustive host-side spelling of the check
+    the consistency sentinel does with on-device fingerprints — use in
+    tests and post-mortems, not hot loops (it fetches every shard)."""
+    from distributed_model_parallel_tpu.train.guards import assert_replicated
+
+    assert_replicated(state.params, name="params")
+    assert_replicated(state.opt_state, name="opt_state")
 
 
 def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,

@@ -505,6 +505,19 @@ class PipelineRunner:
         return normalize(imgs, self.mean, self.std, self.dtype)
 
     # ------------------------------------------------------------- utilities
+    def rebuild_optimizer(self, tx: optax.GradientTransformation) -> None:
+        """Swap the optimizer and re-jit every per-stage program.
+
+        The recovery-time LR-shrink hook (train/resilience.py): the stage
+        programs close over ``self.tx`` but are jitted — reassigning the
+        attribute alone would keep serving the stale traced computation
+        out of the jit cache, so the stage functions are rebuilt. Stage
+        state (params/BN/opt_state) is untouched: the new ``tx`` must
+        produce the same opt-state structure (true for a rescaled learning
+        rate — the LR lives in the schedule closure, not the state)."""
+        self.tx = tx
+        self._build_stage_fns()
+
     def merged_params(self):
         """Reassemble the full per-unit parameter tuple on host (for parity
         checks and checkpointing)."""

@@ -1,0 +1,714 @@
+"""Cross-replica consistency sentinel: detect and repair silent corruption.
+
+The recovery supervisor (train/resilience.py) catches faults that announce
+themselves — non-finite values, stalls, torn checkpoints. It is blind to
+the failure mode that dominates at fleet scale: *silent* data corruption
+and replica drift (Hochschild et al., "Cores that don't count"; Dixit et
+al., "Silent Data Corruptions at Scale"), where one data-parallel
+replica's params/optimizer state quietly diverge and poison every
+subsequent gradient allreduce. The replicate→allreduce topology this
+framework implements is exactly the one where a single lying replica
+corrupts all of them.
+
+The sentinel closes that gap on a configurable step cadence
+(``TrainConfig.consistency_every`` / ``LMTrainConfig.consistency_every``):
+
+1. **fingerprint** — one cheap on-device reduction per leaf of
+   params + optimizer state: non-finite count, L2 (sum of squares), a
+   float checksum (signed sum) and an **exact** wrap-around sum of the
+   element bit patterns (uint32 — catches a mantissa-LSB flip the float
+   sums would absorb below their precision), computed *per data-parallel
+   replica* inside a ``shard_map`` (partial blocks psum-reduced over the
+   non-data mesh axes) and ``all_gather``\\ ed over the data axis — a
+   ``[n_replicas, n_leaves, 4]`` array, a few KB regardless of model
+   size. Only the gathered fingerprint crosses to host; the parameters
+   never do.
+2. **compare** — host-side, replicas are grouped by bitwise fingerprint
+   equality. One group and finite → consistent, done. The blocking fetch
+   runs under the PR 2 Watchdog (``GuardRunner.watch``) so a divergence
+   check on a wedged mesh escalates instead of hanging the very
+   mechanism meant to catch hangs; on multi-process runs a
+   ``mesh.barrier_with_timeout`` rendezvous precedes the collectives so
+   a missing host surfaces as a typed ``straggler`` failure record
+   (StragglerTimeoutError), not an eternal hang.
+3. **repair** — with a quorum (a strict-majority group, or the unique
+   all-finite group), the outlier minority is repaired **in place**: a
+   second ``shard_map`` re-broadcasts every leaf from a majority-good
+   replica (a masked integer psum of the bit patterns — bit-exact and
+   O(1) extra memory), then the fingerprint is recomputed to verify
+   bitwise equality was restored.
+   No quorum (e.g. 1-vs-1 finite disagreement) raises
+   :class:`~distributed_model_parallel_tpu.train.guards.ReplicaDivergenceError`,
+   which the trainers route to the supervisor's good-slot restore
+   (``RecoverySupervisor.recover_divergence``) — bounded retry, same
+   budget as non-finite recovery.
+
+Every event emits typed telemetry: a ``consistency`` record
+(``divergence`` / ``repaired`` / ``no-quorum`` / ``non-finite``) plus the
+``failure``/``recovery`` pair ``scripts/dmp_report.py`` renders on the
+resilience timeline. Registry counters ``consistency_checks`` /
+``consistency_divergences`` / ``consistency_repairs`` and the
+``consistency_check_s`` histogram quantify cadence overhead.
+
+Topology notes: leaves *sharded over* the data axis (DDP per-replica BN
+state, FSDP params/optimizer) are legitimately different across replicas
+and are excluded from the fingerprint; a state with **no** replicated
+leaves (FSDP) cannot be cross-checked and is rejected loudly. With a
+single data replica (pipeline trainer, dp=1 LM runs) there is nothing to
+compare against, and the sentinel honestly degrades to its finiteness
+fingerprint only — cross-replica detection *requires* redundancy.
+
+Deterministic corruption faults for chaos-testing all of this
+(``bitflip``/``desync``/``grad_skew``) live in utils/faults.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from distributed_model_parallel_tpu.train.guards import (
+    NonFiniteError,
+    ReplicaDivergenceError,
+)
+from distributed_model_parallel_tpu.utils.faults import _spec_axes
+
+__all__ = [
+    "ConsistencySentinel",
+    "FingerprintVerdict",
+    "analyze_fingerprints",
+]
+
+# Per-leaf fingerprint statistics, in row order. "bitsum" is the exact
+# detector: a wrap-around (mod 2^32) sum of every element's BIT PATTERN,
+# computed in integer arithmetic — any single flipped bit changes it with
+# certainty, where the float l2/sum stats absorb deltas below their own
+# precision (a mantissa-LSB flip in a large leaf is invisible to an f32
+# running sum). The float stats stay for diagnosis: they say *how far*
+# a replica drifted, not just that it did.
+FINGERPRINT_STATS = ("nonfinite", "l2", "sum", "bitsum")
+
+
+@dataclasses.dataclass(frozen=True)
+class FingerprintVerdict:
+    """Host-side analysis of one ``[n_replicas, n_leaves,
+    len(FINGERPRINT_STATS)]`` fingerprint: who agrees, who lies, whether
+    a repair quorum exists."""
+
+    consistent: bool           # all replicas bitwise-identical fingerprints
+    finite: bool               # the consensus/good fingerprint is finite
+    good_replica: int | None   # representative replica to re-broadcast from
+    outliers: tuple[int, ...]  # replicas outside the good group
+    n_groups: int              # distinct fingerprint values observed
+
+    @property
+    def has_quorum(self) -> bool:
+        return self.good_replica is not None
+
+
+def analyze_fingerprints(fp: np.ndarray) -> FingerprintVerdict:
+    """Group replicas by bitwise fingerprint equality and pick the quorum.
+
+    Policy (docs/RESILIENCE.md "Silent corruption & replica divergence"):
+
+    * one group → consistent (finite iff its non-finite counts are 0);
+    * a group holding a **strict majority** of replicas and finite → the
+      quorum; everyone else is an outlier to repair;
+    * no strict majority, but exactly **one** group is all-finite → that
+      group wins (a non-finite replica is definitely bad — the tie-break
+      that saves the 1-vs-1 case when one side is NaN);
+    * otherwise → no quorum (``good_replica=None``): the caller falls
+      back to the supervisor's good-slot restore.
+    """
+    fp = np.asarray(fp)
+    n = fp.shape[0]
+    groups: dict[bytes, list[int]] = {}
+    for i in range(n):
+        groups.setdefault(fp[i].tobytes(), []).append(i)
+    finite_of = {key: bool(fp[members[0], :, 0].sum() == 0)
+                 for key, members in groups.items()}
+    if len(groups) == 1:
+        key = next(iter(groups))
+        return FingerprintVerdict(consistent=True, finite=finite_of[key],
+                                  good_replica=None, outliers=(),
+                                  n_groups=1)
+    majority = max(groups.values(), key=len)
+    good: list[int] | None = None
+    if len(majority) * 2 > n and finite_of[fp[majority[0]].tobytes()]:
+        good = majority
+    else:
+        finite_groups = [m for k, m in groups.items() if finite_of[k]]
+        if len(finite_groups) == 1:
+            good = finite_groups[0]
+    if good is None:
+        return FingerprintVerdict(consistent=False, finite=False,
+                                  good_replica=None,
+                                  outliers=tuple(range(n)),
+                                  n_groups=len(groups))
+    outliers = tuple(sorted(set(range(n)) - set(good)))
+    return FingerprintVerdict(consistent=False,
+                              finite=finite_of[fp[good[0]].tobytes()],
+                              good_replica=good[0], outliers=outliers,
+                              n_groups=len(groups))
+
+
+class ConsistencySentinel:
+    """Cadence-driven cross-replica state verification + in-place repair.
+
+    ``spec`` is the run's :class:`~distributed_model_parallel_tpu.mesh.
+    MeshSpec`, or None for meshless single-controller engines (the
+    pipeline runner) — with one data replica the sentinel runs its
+    finiteness fingerprint only. ``guards`` (a ``GuardRunner``) arms the
+    stall watchdog around the blocking fingerprint fetch;
+    ``barrier_timeout_s`` bounds the multi-process pre-check rendezvous.
+    """
+
+    def __init__(self, every: int, spec=None, *, logger,
+                 guards=None, barrier_timeout_s: float | None = None,
+                 name: str = "state"):
+        if every < 0:
+            raise ValueError(f"consistency_every must be >= 0, got {every}")
+        self.every = every
+        self.spec = spec
+        self.logger = logger
+        self.guards = guards
+        self.barrier_timeout_s = barrier_timeout_s
+        self.name = name
+        self.checks = 0
+        self.repairs = 0
+        self._seen = 0
+        self._next = every
+        self._checked_at = 0
+        self._fp_cache: dict = {}
+        self._repair_cache: dict = {}
+        self._included_cache: tuple | None = None
+        self._skip_noted = False
+        if spec is not None:
+            self._data_axes = spec.data_axes
+            self.n_replicas = spec.num_data
+            self._other_axes = tuple(n for n in spec.mesh.axis_names
+                                     if n not in self._data_axes)
+        else:
+            self._data_axes = ()
+            self._other_axes = ()
+            self.n_replicas = 1
+
+    # ------------------------------------------------------------- cadence
+    @property
+    def enabled(self) -> bool:
+        return self.every > 0
+
+    def after_sync(self, n_steps: int, tree_fn: Callable[[], Any]
+                   ) -> Any | None:
+        """Advance the step counter by ``n_steps``; when the cadence is
+        due, fingerprint+compare ``tree_fn()`` and return the repaired
+        tree (same structure) when an in-place repair happened, else
+        None. Raises ``ReplicaDivergenceError`` on no-quorum divergence
+        and ``NonFiniteError`` on a (consensus) non-finite state — both
+        routed to the recovery supervisor by the trainers."""
+        if not self.enabled:
+            return None
+        self._seen += n_steps
+        if self._seen < self._next:
+            return None
+        self._next = self._seen + self.every
+        self._checked_at = self._seen
+        return self.check(tree_fn())
+
+    def flush(self, tree_fn: Callable[[], Any]) -> Any | None:
+        """Check any steps the cadence hasn't covered yet — the trainers
+        call this at the end of every epoch, right before the supervisor
+        stamps the "good" restore slot. It closes two holes the pure
+        cadence leaves open: an epoch (or whole run) shorter than
+        ``every`` would otherwise never be checked at all, so an injected
+        corruption fault could go silently undetected — the exact
+        misconfiguration the supervisor's plan validation exists to
+        reject — and without it the "good" slot could be saved from state
+        the sentinel has never validated. No-op when disabled or when the
+        last check already covered every step seen; same return/raise
+        contract as :meth:`after_sync`."""
+        if not self.enabled or self._seen == self._checked_at:
+            return None
+        self._next = self._seen + self.every
+        self._checked_at = self._seen
+        return self.check(tree_fn())
+
+    # ------------------------------------------------------------ plumbing
+    @property
+    def _telemetry(self):
+        return self.logger.telemetry
+
+    def _log(self, msg: str) -> None:
+        self.logger.log_line(msg)
+
+    def _included(self, tree, all_leaves=None,
+                  treedef=None) -> tuple[list, list, list]:
+        """Leaves expected bitwise-identical across data replicas: numeric,
+        and not sharded over the data axis (DDP BN state / FSDP shards are
+        legitimately per-replica). Returns (leaves, labels, flat
+        positions) — positions index the full tree_flatten order, so a
+        repaired subset can be spliced back.
+
+        The filter (labels + positions) is cached by ``treedef``: tree
+        structure and shardings are invariant across a run (the same
+        jitted step produces them), so the O(n_leaves) per-leaf
+        path-string construction and sharding-spec walk run once, not on
+        every cadence hit of the hot drain path."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        if all_leaves is None:
+            all_leaves, treedef = jax.tree.flatten(tree)
+        if (self._included_cache is not None
+                and self._included_cache[0] == treedef):
+            _, labels, positions = self._included_cache
+            return [all_leaves[p] for p in positions], labels, positions
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        leaves, labels, positions, skipped = [], [], [], []
+        for pos, (path, leaf) in enumerate(flat):
+            label = jax.tree_util.keystr(path)
+            if self.spec is not None and self.n_replicas > 1:
+                sh = getattr(leaf, "sharding", None)
+                if not isinstance(sh, NamedSharding):
+                    raise ValueError(
+                        f"consistency sentinel needs NamedSharding-"
+                        f"committed state; {self.name}{label} has {sh!r}")
+                if _spec_axes(sh.spec) & set(self._data_axes):
+                    skipped.append(label)
+                    continue
+            leaves.append(leaf)
+            labels.append(label)
+            positions.append(pos)
+        if skipped and not self._skip_noted:
+            self._skip_noted = True
+            self._log(f"consistency: {len(skipped)} data-sharded "
+                      f"(per-replica) leaves excluded from the replicated "
+                      f"fingerprint, e.g. {skipped[0]}")
+        if not leaves:
+            raise ValueError(
+                "consistency sentinel: no replicated leaves to compare — "
+                "every leaf is sharded over the data axis (FSDP/ZeRO "
+                "shards state instead of replicating it; cross-replica "
+                "consistency checking requires redundancy)")
+        self._included_cache = (treedef, labels, positions)
+        return leaves, labels, positions
+
+    # -------------------------------------------------------- fingerprints
+    @staticmethod
+    def _leaf_row(x):
+        import jax.numpy as jnp
+
+        xf = x.astype(jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            bad = jnp.sum(~jnp.isfinite(x), dtype=jnp.float32)
+        else:
+            bad = jnp.zeros((), jnp.float32)
+        return jnp.stack([bad, jnp.sum(xf * xf), jnp.sum(xf)])
+
+    @staticmethod
+    def _leaf_bitsum(x):
+        """Exact mod-2^32 sum of the leaf's element bit patterns (uint32):
+        integer wrap-around addition is associative and exact, so ANY
+        single flipped bit — including a mantissa LSB far below the float
+        stats' precision — changes the result with certainty. 64-bit
+        elements fold both 32-bit halves into the sum (a plain uint32
+        cast would truncate away flips in bits 32-63)."""
+        import jax
+        import jax.numpy as jnp
+
+        nbits = x.dtype.itemsize * 8
+        if nbits >= 16:
+            u = jax.lax.bitcast_convert_type(x, jnp.dtype(f"uint{nbits}"))
+        else:
+            u = x                       # 8-bit: the value IS the pattern
+        if nbits == 64:
+            lo = jnp.sum(u.astype(jnp.uint32), dtype=jnp.uint32)
+            hi = jnp.sum((u >> jnp.uint64(32)).astype(jnp.uint32),
+                         dtype=jnp.uint32)
+            return lo + hi
+        return jnp.sum(u.astype(jnp.uint32), dtype=jnp.uint32)
+
+    def _copy_rotated_bitsum(self, x, pspec):
+        """Per-device bitsum contribution for the mesh fingerprint: the
+        local block's bitsum rotated left by the device's copy index over
+        the non-data axes the leaf is NOT sharded on (mod 32). Without
+        the rotation, identical copies of a leaf replicated over e.g. a
+        tp=2 model axis contribute the same value twice to the integer
+        psum, so a bit flip that hits every copy the same way (exactly
+        what ``corrupt_one_replica`` produces for replicated leaves) adds
+        ``2 * 2^31 ≡ 0 (mod 2^32)`` for the sign bit — and a ``0.0 →
+        -0.0`` flip is then invisible to all four stats. Distinct
+        rotations per copy make any correlated flip land on distinct
+        bits, so it cannot cancel (up to 32 copies; a flip in a single
+        copy stays visible too). Rotation amounts are a pure function of
+        mesh position — identical across data replicas — so cross-replica
+        comparison is unaffected; shards along axes the leaf IS sharded
+        on share one rotation and still psum to that copy's full
+        bitsum."""
+        import jax.numpy as jnp
+
+        from distributed_model_parallel_tpu.utils.faults import (
+            _combined_replica_index,
+        )
+
+        b = self._leaf_bitsum(x)
+        replicated = tuple(a for a in self._other_axes
+                           if a not in _spec_axes(pspec))
+        if not replicated:
+            return b
+        r = (_combined_replica_index(replicated) % 32).astype(jnp.uint32)
+        return (b << r) | (b >> ((jnp.uint32(32) - r) % jnp.uint32(32)))
+
+    @classmethod
+    def _leaf_stats(cls, x):
+        """[4] fingerprint row: the three f32 stats + the uint32 bitsum
+        carried bit-exactly in the f32 slot via bitcast (rows are compared
+        as raw bytes, never arithmetically — only column 0 is read as a
+        number)."""
+        import jax
+        import jax.numpy as jnp
+
+        bits_f = jax.lax.bitcast_convert_type(cls._leaf_bitsum(x),
+                                              jnp.float32)
+        return jnp.concatenate([cls._leaf_row(x), bits_f[None]])
+
+    def _cache_key(self, leaves) -> tuple:
+        return tuple((l.shape, str(l.dtype),
+                      getattr(l, "sharding", None) and str(l.sharding))
+                     for l in leaves)
+
+    def _fingerprint_fn(self, leaves, cache_token=None):
+        """[n_replicas, n_leaves, 4] fingerprint program over the mesh
+        (columns = FINGERPRINT_STATS). ``cache_token`` (check() passes the
+        treedef) keys the compiled-program cache without rebuilding the
+        O(n_leaves) stringified-sharding key on every cadence hit — the
+        same structure-is-run-invariant assumption ``_included``'s filter
+        cache already rests on; leave it None when calling with bare
+        leaves (tests)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_model_parallel_tpu.utils.telemetry import (
+            record_collective,
+        )
+
+        key = cache_token if cache_token is not None \
+            else self._cache_key(leaves)
+        fn = self._fp_cache.get(key)
+        if fn is not None:
+            return fn
+        specs = tuple(l.sharding.spec for l in leaves)
+        data_axes, other_axes = self._data_axes, self._other_axes
+        row_bytes = len(FINGERPRINT_STATS) * 4 * len(leaves)
+        record_collective("all_gather", data_axes,
+                          row_bytes * self.n_replicas, self.n_replicas)
+
+        def body(*ls):
+            stats = jnp.stack([self._leaf_row(x) for x in ls])    # [L, 3]
+            bits = jnp.stack([self._copy_rotated_bitsum(x, s)     # [L] u32
+                              for x, s in zip(ls, specs)])
+            if other_axes:
+                # Partial blocks of leaves sharded over non-data axes
+                # (tp/pp/sp/ep) reduce to the replica's full-tree stats;
+                # the bitsum reduces in integer arithmetic (still exact —
+                # wrap-around addition commutes), never as a float, with
+                # each replicated copy's contribution rotated by its copy
+                # index so correlated flips cannot cancel mod 2^32 (see
+                # _copy_rotated_bitsum).
+                stats = jax.lax.psum(stats, other_axes)
+                bits = jax.lax.psum(bits, other_axes)
+            fp = jnp.concatenate(
+                [stats,
+                 jax.lax.bitcast_convert_type(bits, jnp.float32)[:, None]],
+                axis=1)                                           # [L, 4]
+            return jax.lax.all_gather(fp, data_axes, axis=0, tiled=False)
+
+        fn = jax.jit(jax.shard_map(body, mesh=self.spec.mesh,
+                                   in_specs=specs, out_specs=P(),
+                                   check_vma=False))
+        self._fp_cache[key] = fn
+        return fn
+
+    def _local_fingerprint(self, leaves) -> np.ndarray:
+        """Single-replica fingerprint: one jitted reduction per device
+        group (the meshless pipeline engine places each stage's tree on
+        its own device; arrays on one mesh form a single group)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_fp_plain"):
+            self._fp_plain = jax.jit(
+                lambda *ls: jnp.stack([self._leaf_stats(x) for x in ls]))
+        by_dev: dict = {}
+        for i, leaf in enumerate(leaves):
+            try:
+                dev = frozenset(leaf.devices())
+            except Exception:
+                dev = None
+            by_dev.setdefault(dev, []).append(i)
+        rows: list = [None] * len(leaves)
+        for idxs in by_dev.values():
+            out = np.asarray(self._fp_plain(*[leaves[i] for i in idxs]))
+            for j, i in enumerate(idxs):
+                rows[i] = out[j]
+        return np.stack(rows)[None]            # [1, n_leaves, 4]
+
+    def _repair_fn(self, leaves, cache_token=None):
+        """Re-broadcast every leaf from replica ``good_idx`` (traced arg):
+        a masked psum of each leaf's BIT PATTERN over the data axis — the
+        good replica contributes its bits, everyone else zeros, and
+        integer wrap-around addition returns the good copy bit-exactly on
+        all replicas. O(1) extra memory per leaf (an all_gather-and-index
+        spelling would transiently materialize n_replicas x the state —
+        an OOM exactly when a corrupted replica needs fixing — and a
+        FLOAT psum would not even be exact: ``-0.0 + 0.0`` rounds to
+        ``+0.0``, silently breaking bitwise parity)."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_model_parallel_tpu.utils.faults import (
+            _combined_replica_index,
+        )
+        from distributed_model_parallel_tpu.utils.telemetry import (
+            record_collective,
+        )
+        from jax.sharding import PartitionSpec as P
+
+        key = cache_token if cache_token is not None \
+            else self._cache_key(leaves)
+        fn = self._repair_cache.get(key)
+        if fn is not None:
+            return fn
+        specs = tuple(l.sharding.spec for l in leaves)
+        data_axes = self._data_axes
+        payload = sum(l.size * np.dtype(l.dtype).itemsize for l in leaves)
+        record_collective("psum", data_axes, payload, self.n_replicas)
+
+        def body(good_idx, *ls):
+            sel = _combined_replica_index(data_axes) == good_idx
+            out = []
+            for x in ls:
+                nbits = x.dtype.itemsize * 8
+                uint = jnp.dtype(f"uint{nbits}")
+                bits = jax.lax.bitcast_convert_type(x, uint)
+                # Sub-32-bit payloads ride a u32 psum (exact: one nonzero
+                # contribution per element group, the rest zeros).
+                wire = bits.astype(jnp.uint32) if nbits < 32 else bits
+                summed = jax.lax.psum(
+                    jnp.where(sel, wire, jnp.zeros_like(wire)), data_axes)
+                out.append(jax.lax.bitcast_convert_type(
+                    summed.astype(uint), x.dtype))
+            return tuple(out)
+
+        fn = jax.jit(jax.shard_map(body, mesh=self.spec.mesh,
+                                   in_specs=(P(),) + specs, out_specs=specs,
+                                   check_vma=False))
+        self._repair_cache[key] = fn
+        return fn
+
+    # ----------------------------------------------------------- the check
+    def _budget(self) -> float | None:
+        """Effective straggler bound for the next blocking wait: the
+        configured ``barrier_timeout_s``, with a 10x grace on the FIRST
+        check — that one uniquely bills one-time costs (XLA compile of
+        the barrier/fingerprint programs, and on multi-process runs the
+        wait for PEER hosts still compiling theirs) that can exceed a
+        steady-state few-KB fetch by orders of magnitude. Sizing guidance
+        in config.py assumes steady state; without the grace a bound that
+        is generous for every later check would kill a healthy run at
+        check #1 with a spurious fatal StragglerTimeoutError."""
+        if self.barrier_timeout_s is None:
+            return None
+        return self.barrier_timeout_s * (10.0 if self.checks == 0 else 1.0)
+
+    def _on_straggler(self, what: str, budget: float) -> None:
+        """Shared timeout hook for every bounded rendezvous/fetch: emit
+        the typed straggler record (the failure half of the pair) and a
+        log line; the caller then raises StragglerTimeoutError."""
+        self._telemetry.failure(
+            "straggler", detail=f"{what} incomplete after {budget:.1f}s "
+            f"— a participant is wedged or missing")
+        self._log(f"consistency: {what} timed out after {budget:.1f}s "
+                  f"— straggler")
+
+    def _guarded_fetch(self, fetch: Callable[[], np.ndarray]) -> np.ndarray:
+        """Blocking fingerprint fetch — never allowed to hang the very
+        mechanism meant to catch hangs. Wraps BOTH fingerprint paths (the
+        mesh all_gather fetch via :meth:`_fetch` and the single-replica
+        device fetch in :meth:`check`'s meshless branch) — a wedged
+        device hangs a dp=1/pipeline check exactly as hard as a wedged
+        mesh hangs a replicated one. The two protections COMPOSE: with
+        the stall watchdog armed (``stall_budget_s``) the *caller's wait*
+        runs under it, so a wedged mesh gets live "still blocked" logging
+        and the stall escalation policy; with ``barrier_timeout_s`` set
+        the fetch is additionally hard-bounded (a host can die between
+        the pre-check barrier and the all_gather, and the watchdog alone
+        only logs — its preemption escalation is checked by the very
+        loop blocked inside this fetch) and a timeout raises
+        StragglerTimeoutError after emitting the straggler record. The
+        watch wraps the bounded wait on THIS thread, not the worker
+        doing the device_get: on a straggler timeout the raise exits the
+        watched region, so the watchdog stops logging and cannot keep
+        escalating an incident the straggler record already reported
+        (the abandoned daemon worker stays wedged but unwatched)."""
+
+        def bounded() -> np.ndarray:
+            budget = self._budget()
+            if budget is None:
+                return fetch()
+            from distributed_model_parallel_tpu.mesh import (
+                barrier_with_timeout,
+            )
+
+            return barrier_with_timeout(
+                fetch, budget,
+                what="consistency-fingerprint",
+                on_timeout=self._on_straggler)
+
+        if self.guards is not None and getattr(self.guards, "stall",
+                                               None) is not None:
+            with self.guards.watch(what="consistency-fingerprint"):
+                return bounded()
+        return bounded()
+
+    def _fetch(self, device_fp) -> np.ndarray:
+        """Guarded host fetch of the mesh fingerprint (see
+        :meth:`_guarded_fetch` for the watchdog/timeout contract)."""
+        import jax
+
+        return self._guarded_fetch(
+            lambda: np.asarray(jax.device_get(device_fp)))
+
+    def _pre_barrier(self) -> None:
+        """Multi-process rendezvous with a timeout before the fingerprint
+        collectives: a wedged/missing host becomes a typed ``straggler``
+        failure record + StragglerTimeoutError, not an eternal hang."""
+        import jax
+
+        if self.barrier_timeout_s is None or jax.process_count() <= 1:
+            return
+        from distributed_model_parallel_tpu.mesh import barrier_with_timeout
+        from distributed_model_parallel_tpu.ops.collectives import (
+            mesh_barrier,
+        )
+
+        barrier_with_timeout(lambda: mesh_barrier(self.spec),
+                             self._budget(),
+                             what="consistency-barrier",
+                             on_timeout=self._on_straggler)
+
+    def check(self, tree) -> Any | None:
+        """Fingerprint ``tree`` now (ignoring the cadence). Returns the
+        repaired tree after an in-place re-broadcast, else None. See
+        :meth:`after_sync` for the raise contract."""
+        import jax
+
+        from distributed_model_parallel_tpu.utils.telemetry import registry
+
+        t0 = time.perf_counter()
+        all_leaves, treedef = jax.tree.flatten(tree)
+        leaves, labels, positions = self._included(tree, all_leaves,
+                                                   treedef)
+        mesh_mode = self.spec is not None and self.n_replicas > 1
+        self._pre_barrier()
+        if mesh_mode:
+            fp = self._fetch(
+                self._fingerprint_fn(leaves, cache_token=treedef)(*leaves))
+        else:
+            fp = self._guarded_fetch(
+                lambda: self._local_fingerprint(leaves))
+        self.checks += 1
+        reg = registry()
+        reg.counter("consistency_checks").inc()
+        reg.histogram("consistency_check_s").observe(
+            time.perf_counter() - t0)
+
+        verdict = analyze_fingerprints(fp)
+        if verdict.consistent:
+            if not verdict.finite:
+                # All replicas agree — on a non-finite state (e.g. a NaN
+                # that poisoned every replica through the allreduce).
+                # Cheaper detection than the full-params host fetch the
+                # finiteness guards pay; same recovery path.
+                bad = [labels[i] for i in range(len(labels))
+                       if fp[0, i, 0] > 0]
+                self._telemetry.consistency(
+                    "non-finite", replicas=self.n_replicas,
+                    leaves=len(bad), check=self.checks)
+                raise NonFiniteError(
+                    f"consistency fingerprint: non-finite values in "
+                    f"{len(bad)} leaves (first: {self.name}{bad[0]})")
+            return None
+
+        # --- replicas disagree: silent corruption / drift detected -------
+        reg.counter("consistency_divergences").inc()
+        good_row = (fp[verdict.good_replica] if verdict.has_quorum
+                    else fp[0])
+        diverged = [labels[i] for i in range(len(labels))
+                    if any(fp[r, i].tobytes() != good_row[i].tobytes()
+                           for r in verdict.outliers)]
+        detail = (f"{len(verdict.outliers)}/{self.n_replicas} replica(s) "
+                  f"diverged on {len(diverged)} leaves "
+                  f"(first: {self.name}{diverged[0] if diverged else '?'})")
+        self._telemetry.consistency(
+            "divergence", replicas=self.n_replicas,
+            outliers=list(verdict.outliers), leaves=len(diverged),
+            check=self.checks)
+        self._telemetry.failure("replica-divergence", detail=detail)
+        self._log(f"consistency: {detail}")
+
+        if not verdict.has_quorum:
+            self._telemetry.consistency(
+                "no-quorum", replicas=self.n_replicas,
+                groups=verdict.n_groups, check=self.checks)
+            self._log("consistency: no majority-good quorum "
+                      f"({verdict.n_groups} distinct states over "
+                      f"{self.n_replicas} replicas) — falling back to the "
+                      "good-slot restore")
+            raise ReplicaDivergenceError(
+                f"no repair quorum: {verdict.n_groups} distinct replica "
+                f"states over {self.n_replicas} replicas ({detail})")
+
+        # --- quorum: repair in place by re-broadcast ---------------------
+        import jax.numpy as jnp
+
+        fixed_leaves = self._repair_fn(leaves, cache_token=treedef)(
+            jnp.asarray(verdict.good_replica, jnp.int32), *leaves)
+        # Repair out_specs pin the repaired leaves to the input shapes/
+        # shardings, so the treedef-keyed fingerprint program is reused.
+        verify = self._fetch(self._fingerprint_fn(
+            list(fixed_leaves), cache_token=treedef)(*fixed_leaves))
+        after = analyze_fingerprints(verify)
+        if not after.consistent:
+            # The re-broadcast itself came back divergent — the corruption
+            # is live (a bad core still flipping bits), not a one-off.
+            self._telemetry.failure(
+                "replica-divergence",
+                detail="re-broadcast repair did not restore consistency")
+            raise ReplicaDivergenceError(
+                "re-broadcast repair did not restore bitwise consistency "
+                "— corruption is live, not transient")
+        self.repairs += 1
+        reg.counter("consistency_repairs").inc()
+        self._telemetry.consistency(
+            "repaired", replicas=self.n_replicas,
+            outliers=list(verdict.outliers), leaves=len(diverged),
+            check=self.checks)
+        self._telemetry.recovery(
+            action="replica-rebroadcast",
+            detail=f"from replica {verdict.good_replica}: {detail}")
+        self._log(f"consistency: repaired in place — re-broadcast from "
+                  f"replica {verdict.good_replica} "
+                  f"(outliers {list(verdict.outliers)})")
+        if not after.finite:
+            raise NonFiniteError(
+                "consistency fingerprint: replicas agree after repair but "
+                "the consensus state is non-finite")
+        out = list(all_leaves)
+        for pos, new in zip(positions, fixed_leaves):
+            out[pos] = new
+        return jax.tree.unflatten(treedef, out)

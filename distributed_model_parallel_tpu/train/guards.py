@@ -39,7 +39,14 @@ class ReplicaDivergenceError(AssertionError):
 
 
 def assert_replicated(tree: Any, *, atol: float = 0.0, name: str = "tree") -> None:
-    """Check every array's shards are identical across its devices."""
+    """Check every array's shards are identical across its devices.
+
+    ``atol=0`` (the default) compares BIT PATTERNS, matching the
+    consistency sentinel's fingerprint semantics: ``-0.0`` vs ``+0.0``
+    diverges (a sign-bit SDC), while replicas that all hold the same NaN
+    bytes are identical (a non-finite incident, not a replication one —
+    ``check_finite`` is the guard for that). ``atol > 0`` falls back to
+    a value comparison via ``np.allclose``."""
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         if not hasattr(leaf, "addressable_shards"):
             continue
@@ -51,11 +58,17 @@ def assert_replicated(tree: Any, *, atol: float = 0.0, name: str = "tree") -> No
         ref = np.asarray(shards[0].data)
         for s in shards[1:]:
             got = np.asarray(s.data)
-            if not np.allclose(ref, got, atol=atol, rtol=0.0):
+            if atol == 0.0:
+                same = ref.tobytes() == got.tobytes()
+                detail = "bit patterns differ"
+            else:
+                same = np.allclose(ref, got, atol=atol, rtol=0.0)
+                detail = (f"max abs diff {np.abs(ref - got).max()}"
+                          if not same else "")
+            if not same:
                 raise ReplicaDivergenceError(
                     f"{name}{jax.tree_util.keystr(path)} diverges between "
-                    f"device {shards[0].device} and {s.device} "
-                    f"(max abs diff {np.abs(ref - got).max()})")
+                    f"device {shards[0].device} and {s.device} ({detail})")
 
 
 class NonFiniteError(FloatingPointError):
@@ -156,26 +169,34 @@ class GuardRunner:
     def enabled(self) -> bool:
         return self.every > 0 or self.stall is not None
 
-    def watch(self):
-        """Context manager wrapping a blocking sync point."""
+    def watch(self, what: str = "sync"):
+        """Context manager wrapping a blocking sync point. ``what`` labels
+        the watchdog's "still blocked" lines (the consistency sentinel
+        passes "consistency-fingerprint" so a divergence check wedged on a
+        dead mesh is attributed to the check, not a training sync)."""
         import contextlib
 
         if self.stall is None and self.injector is None:
             return contextlib.nullcontext()
-        return self._watched()
+        return self._watched(what)
 
-    def _watched(self):
+    def _watched(self, what: str):
         import contextlib
 
         @contextlib.contextmanager
         def ctx():
-            wd = (self.stall.watch("sync") if self.stall is not None
+            wd = (self.stall.watch(what) if self.stall is not None
                   else contextlib.nullcontext())
             with wd:
                 if self.injector is not None:
                     # Injected stalls sleep INSIDE the watched region, so
                     # the watchdog observes them like a real wedged sync.
-                    self.injector.maybe_stall("sync")
+                    # Polling is keyed by ``what``: the sentinel's
+                    # "consistency-fingerprint" fetches advance their own
+                    # occurrence counter, so arming the sentinel never
+                    # shifts which training drain a planned ``stall@N``
+                    # fires at (stall specs target site "sync" only).
+                    self.injector.maybe_stall(what)
                 yield
         return ctx()
 

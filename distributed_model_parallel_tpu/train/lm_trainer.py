@@ -92,6 +92,12 @@ class LMTrainConfig:
     # Guards (train/guards.py:GuardRunner) — same semantics as TrainConfig.
     check_finite_every: int = 0
     stall_budget_s: float | None = None
+    # Cross-replica consistency sentinel cadence — same semantics as
+    # TrainConfig.consistency_every (train/consistency.py). Params are
+    # replicated over the data axis under the SPMD pipeline, so dp >= 2
+    # gives real cross-replica detection; dp == 1 degrades to the
+    # finiteness fingerprint.
+    consistency_every: int = 0
     # Automatic recovery policy + fault-injection plan — same semantics as
     # TrainConfig.recovery (train/resilience.py, utils/faults.py).
     recovery: RecoveryConfig = dataclasses.field(
@@ -210,13 +216,22 @@ class LMTrainer:
         from distributed_model_parallel_tpu.utils.faults import FaultInjector
 
         self.faults = FaultInjector(config.recovery.faults)
+        from distributed_model_parallel_tpu.utils.faults import (
+            validate_corruption_plan,
+        )
+
+        # Topology validation before the supervisor: its "arm the
+        # sentinel" advice is useless on a dp=1 mesh.
+        validate_corruption_plan(self.faults.plan, self.spec.num_data,
+                                 context=f"dp={self.spec.num_data}")
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
                                  injector=self.faults)
         self.resilience = RecoverySupervisor(
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="lm-good", injector=self.faults,
-            check_finite_every=config.check_finite_every)
+            check_finite_every=config.check_finite_every,
+            consistency_every=config.consistency_every)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
@@ -224,6 +239,14 @@ class LMTrainer:
             stall_budget_s=config.stall_budget_s, logger=self.logger,
             watchdog_interval_s=config.recovery.watchdog_interval_s,
             on_stall=self.resilience.on_stall, injector=self.faults)
+        from distributed_model_parallel_tpu.train.consistency import (
+            ConsistencySentinel,
+        )
+
+        self.sentinel = ConsistencySentinel(
+            config.consistency_every, self.spec, logger=self.logger,
+            guards=self.guards,
+            barrier_timeout_s=config.recovery.barrier_timeout_s)
         self.start_epoch = 0
         if config.resume and (self.ckpt.exists("lm")
                               or self.ckpt.exists("lm-preempt")):
@@ -356,9 +379,14 @@ class LMTrainer:
     # ----------------------------------------------------------------- loop
     def _poll_step_faults(self, step_m):
         """Serve planned step-site faults (utils/faults.py): poison this
-        step's loss or the live params, or request a simulated preemption.
-        Returns the (possibly poisoned) step metrics."""
-        from distributed_model_parallel_tpu.utils.faults import poison
+        step's loss or the live params, silently corrupt one replica's
+        params, or request a simulated preemption. Returns the (possibly
+        poisoned) step metrics."""
+        from distributed_model_parallel_tpu.utils.faults import (
+            CORRUPTION_KINDS,
+            corrupt_one_replica,
+            poison,
+        )
 
         for spec in self.faults.poll("step"):
             if spec.kind == "preempt":
@@ -367,7 +395,24 @@ class LMTrainer:
                 step_m = poison(step_m)
             elif spec.kind == "nan_params":
                 self.params = poison(self.params)
+            elif spec.kind in CORRUPTION_KINDS:
+                self.params = corrupt_one_replica(
+                    self.params, self.spec, spec.kind, spec.param)
         return step_m
+
+    def _run_sentinel(self, n_steps: int, *, flush: bool = False) -> None:
+        """Advance the consistency sentinel (train/consistency.py) — or,
+        with ``flush=True``, check any steps the cadence hasn't covered
+        (end of epoch, before the good slot is stamped) — and splice a
+        repaired params/opt_state pair back in place. No-quorum
+        divergence raises into fit()'s recovery handler."""
+        tree_fn = lambda: {"params": self.params,
+                           "opt_state": self.opt_state}
+        fixed = (self.sentinel.flush(tree_fn) if flush
+                 else self.sentinel.after_sync(n_steps, tree_fn))
+        if fixed is not None:
+            self.params = fixed["params"]
+            self.opt_state = fixed["opt_state"]
 
     def _train_one_epoch(self, epoch: int, epochs: int) -> dict | None:
         """One training epoch + eval. Returns the history record, or None
@@ -394,6 +439,8 @@ class LMTrainer:
             if self.guards.enabled:
                 self.guards.after_sync({"loss": loss_host}, 1,
                                        params=self.params)
+            if self.sentinel.enabled:
+                self._run_sentinel(1)
             meter.update(loss_host)
             if "moe_drop" in step_m:
                 drop_meter.update(float(step_m["moe_drop"]))
@@ -406,6 +453,12 @@ class LMTrainer:
                 data_time_s=timer.data.last,
                 tokens_per_s=tokens_per_step
                 / max(timer.step.last, 1e-9))
+        if self.sentinel.enabled:
+            # Cover any tail steps the cadence missed before the epoch is
+            # declared clean (or a preempt checkpoint is written) — an
+            # epoch shorter than the cadence would otherwise never be
+            # checked at all (train/consistency.py flush).
+            self._run_sentinel(0, flush=True)
         if self.preemption.requested():
             # Partial epoch: save for resume at this epoch and stop
             # cleanly (train/preemption.py).
@@ -446,6 +499,7 @@ class LMTrainer:
         and-retry on non-finite detections (train/resilience.py)."""
         from distributed_model_parallel_tpu.train.guards import (
             NonFiniteError,
+            ReplicaDivergenceError,
         )
 
         epochs = epochs if epochs is not None else self.config.epochs
@@ -460,6 +514,11 @@ class LMTrainer:
                     if self.resilience.recover_nonfinite(
                             e, epoch=epoch, restore=self._restore_good,
                             shrink_lr=self._apply_lr_shrink):
+                        continue        # state restored — redo the epoch
+                    raise
+                except ReplicaDivergenceError as e:
+                    if self.resilience.recover_divergence(
+                            e, epoch=epoch, restore=self._restore_good):
                         continue        # state restored — redo the epoch
                     raise
                 if record is None:      # preempted mid-epoch

@@ -36,14 +36,6 @@ from distributed_model_parallel_tpu.train.trainer import EpochResult, eval_now
 class PipelineTrainer:
     def __init__(self, config: TrainConfig, devices=None):
         self.config = config
-        if config.recovery.lr_shrink != 1.0:
-            # Validate before the (expensive) runner build: fail fast.
-            raise ValueError(
-                "recovery.lr_shrink is implemented by the Trainer/LMTrainer "
-                "engines (they rebuild their optimizer + jitted steps); the "
-                "single-controller PipelineRunner bakes its optimizer into "
-                "per-stage programs at construction — restore-and-retry "
-                "recovery works, LR shrink does not. No silent ignores")
         if devices is None:
             devices = jax.devices()[:max(config.mesh.stage, 1)]
         if len(devices) < config.mesh.stage:
@@ -128,6 +120,13 @@ class PipelineTrainer:
         from distributed_model_parallel_tpu.utils.faults import FaultInjector
 
         self.faults = FaultInjector(config.recovery.faults)
+        from distributed_model_parallel_tpu.utils.faults import (
+            validate_corruption_plan,
+        )
+
+        validate_corruption_plan(
+            self.faults.plan, 1,
+            context="the single-controller pipeline (one copy per stage)")
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
                                  injector=self.faults)
@@ -135,7 +134,8 @@ class PipelineTrainer:
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="pipeline-good",
             injector=self.faults,
-            check_finite_every=config.check_finite_every)
+            check_finite_every=config.check_finite_every,
+            consistency_every=config.consistency_every)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
@@ -143,6 +143,18 @@ class PipelineTrainer:
             stall_budget_s=config.stall_budget_s, logger=self.logger,
             watchdog_interval_s=config.recovery.watchdog_interval_s,
             on_stall=self.resilience.on_stall, injector=self.faults)
+        from distributed_model_parallel_tpu.train.consistency import (
+            ConsistencySentinel,
+        )
+
+        # Meshless single-controller engine: one copy of every stage, so
+        # the sentinel honestly degrades to its on-device finiteness
+        # fingerprint (cross-replica detection requires redundancy —
+        # train/consistency.py topology notes).
+        self.sentinel = ConsistencySentinel(
+            config.consistency_every, None, logger=self.logger,
+            guards=self.guards,
+            barrier_timeout_s=config.recovery.barrier_timeout_s)
         self.best_acc = 0.0
         self.start_epoch = 0
         self._rng = jax.random.key(config.seed + 1)
@@ -187,6 +199,21 @@ class PipelineTrainer:
             on_fallback=self.resilience.note_fallback)
         self._push_restored(restored)
 
+    def _apply_lr_shrink(self, factor: float) -> None:
+        """Recovery-time LR shrink (mirrors Trainer._apply_lr_shrink):
+        scale the configured LR, rebuild the optimizer and have the runner
+        re-jit its per-stage programs (PipelineRunner.rebuild_optimizer).
+        Stage opt_state structure is unchanged — the schedule is a
+        closure — so the restored state carries over."""
+        import dataclasses
+
+        opt = dataclasses.replace(
+            self.config.optimizer,
+            learning_rate=self.config.optimizer.learning_rate * factor)
+        self.config = self.config.replace(optimizer=opt)
+        self.runner.rebuild_optimizer(
+            make_optimizer(opt, len(self.train_loader), self.config.epochs))
+
     def _poll_step_faults(self, pending: list) -> None:
         """Serve planned step-site faults (utils/faults.py): poison the
         just-queued step metrics or the per-stage params, or request a
@@ -202,6 +229,15 @@ class PipelineTrainer:
             elif spec.kind == "nan_params":
                 for stage in self.runner.stages:
                     stage.params = poison(stage.params)
+
+    def _sentinel_tree(self) -> dict:
+        """The per-stage state the sentinel's finiteness fingerprint
+        covers (one data replica — no cross-replica redundancy here)."""
+        return {"params": tuple(s.params for s in self.runner.stages),
+                "model_state": tuple(s.model_state
+                                     for s in self.runner.stages),
+                "opt_state": tuple(s.opt_state
+                                   for s in self.runner.stages)}
 
     def _run_epoch(self, epoch: int, train: bool) -> EpochResult:
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
@@ -231,6 +267,20 @@ class PipelineTrainer:
                 self.guards.after_sync(
                     [m for m, _ in finalized], len(finalized),
                     params=tuple(s.params for s in self.runner.stages))
+            if train and self.sentinel.enabled and finalized:
+                # Finiteness fingerprint of the per-stage state (one cheap
+                # on-device reduction per stage; raises NonFiniteError into
+                # fit()'s recovery path — train/consistency.py). The
+                # meshless sentinel (one replica) can only pass or raise —
+                # if this path ever gains replicated state, a repaired
+                # tree MUST be spliced back like Trainer._run_sentinel
+                # does, not dropped while telemetry claims "repaired".
+                fixed = self.sentinel.after_sync(len(finalized),
+                                                 self._sentinel_tree)
+                if fixed is not None:
+                    raise RuntimeError(
+                        "meshless sentinel returned a repair — splice it "
+                        "back into the stages before training on")
             for m, b in finalized:
                 update(m, b)
             pending.clear()
@@ -277,6 +327,16 @@ class PipelineTrainer:
                 update(m, m["batch"])
             timer.mark()                # dispatch time -> residual, not data
         drain()
+        if train and self.sentinel.enabled:
+            # Cover any tail steps the cadence missed before the epoch is
+            # declared clean — an epoch shorter than the cadence would
+            # otherwise never be checked (train/consistency.py flush).
+            # Same pass-or-raise contract as the drain-site check above.
+            fixed = self.sentinel.flush(self._sentinel_tree)
+            if fixed is not None:
+                raise RuntimeError(
+                    "meshless sentinel returned a repair — splice it "
+                    "back into the stages before training on")
         wall = time.perf_counter() - t_epoch
         step_avg = max(0.0, wall - timer.data.sum) / max(1, n_steps)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
@@ -288,6 +348,7 @@ class PipelineTrainer:
         and-retry on non-finite detections (train/resilience.py)."""
         from distributed_model_parallel_tpu.train.guards import (
             NonFiniteError,
+            ReplicaDivergenceError,
         )
 
         epochs = epochs if epochs is not None else self.config.epochs
@@ -300,6 +361,12 @@ class PipelineTrainer:
                     tr = self._run_epoch(epoch, train=True)
                 except NonFiniteError as e:
                     if self.resilience.recover_nonfinite(
+                            e, epoch=epoch, restore=self._restore_good,
+                            shrink_lr=self._apply_lr_shrink):
+                        continue        # state restored — redo the epoch
+                    raise
+                except ReplicaDivergenceError as e:
+                    if self.resilience.recover_divergence(
                             e, epoch=epoch, restore=self._restore_good):
                         continue        # state restored — redo the epoch
                     raise

@@ -19,7 +19,12 @@ loop:
 * **stalled sync** → the :class:`Watchdog` logs "still blocked after Ns"
   lines *while* the sync is blocked (the old ``StallDetector`` could only
   flag after the fact) and, with ``RecoveryConfig.stall_exit``, escalates to
-  a graceful checkpoint-and-exit via the preemption flag.
+  a graceful checkpoint-and-exit via the preemption flag;
+* **no-quorum replica divergence** → the consistency sentinel
+  (train/consistency.py) detects silent corruption/drift across
+  data-parallel replicas and repairs in place when a majority-good quorum
+  exists; when none does, :meth:`RecoverySupervisor.recover_divergence`
+  restores the good slot and retries on the same bounded budget.
 
 Every detection emits a typed telemetry ``failure`` record and every action
 a ``recovery`` record (utils/telemetry.py), so ``scripts/dmp_report.py``
@@ -151,7 +156,8 @@ class RecoverySupervisor:
 
     def __init__(self, config: RecoveryConfig, *, logger, ckpt, preemption,
                  slot: str = "good", injector: FaultInjector | None = None,
-                 check_finite_every: int | None = None):
+                 check_finite_every: int | None = None,
+                 consistency_every: int | None = None):
         if config.max_retries < 0:
             raise ValueError(
                 f"recovery.max_retries must be >= 0, got {config.max_retries}")
@@ -175,21 +181,49 @@ class RecoverySupervisor:
         self.lr_scale = 1.0
         self._stall_reported = False
         self._fallback_reported: set[str] = set()
+        sentinel_on = (consistency_every or 0) > 0
         if check_finite_every is not None and check_finite_every <= 0:
-            if any(s.kind in ("nan_loss", "nan_params")
-                   for s in self.injector.plan):
-                # An injected NaN nothing detects doesn't test recovery —
-                # it crashes the metrics drain on int(NaN). No silent
-                # misconfigurations.
+            # An injected NaN nothing detects doesn't test recovery — it
+            # crashes the metrics drain on int(NaN). No silent
+            # misconfigurations. The consistency sentinel's finiteness
+            # fingerprint counts as a detector for nan_params ONLY: it
+            # fingerprints params/opt state, never the step metrics, so
+            # nan_loss still needs the metrics guards. A cadence longer
+            # than the run does not reopen the hole: the trainers flush
+            # the sentinel at every epoch end (ConsistencySentinel.flush),
+            # so armed means at-least-once-per-epoch.
+            undetectable = sorted({
+                s.kind for s in self.injector.plan
+                if s.kind == "nan_loss"
+                or (s.kind == "nan_params" and not sentinel_on)})
+            if undetectable:
                 raise ValueError(
-                    "the fault plan injects NaN (nan_loss/nan_params) but "
-                    "check_finite_every is 0, so the guards would never "
-                    "detect it; set check_finite_every >= 1")
-            if self.enabled:
+                    f"the fault plan injects NaN ({', '.join(undetectable)})"
+                    f" but check_finite_every is 0, so the guards would "
+                    f"never detect it; set check_finite_every >= 1"
+                    + ("" if sentinel_on else
+                       " (or, for nan_params only, consistency_every >= 1)"))
+            if self.enabled and not sentinel_on:
                 self.logger.log_line(
                     "resilience: warning — recovery.max_retries is set but "
                     "check_finite_every is 0, so non-finite steps are never "
                     "detected (stall/preempt/save recovery still active)")
+        if not sentinel_on:
+            from distributed_model_parallel_tpu.utils.faults import (
+                CORRUPTION_KINDS,
+            )
+
+            corrupting = sorted({s.kind for s in self.injector.plan
+                                 if s.kind in CORRUPTION_KINDS})
+            if corrupting:
+                # Silent corruption is, by definition, invisible to the
+                # finiteness guards — a plan injecting it without the
+                # sentinel armed is an untestable no-op.
+                raise ValueError(
+                    f"the fault plan injects silent corruption "
+                    f"({', '.join(corrupting)}) but consistency_every is "
+                    f"0, so the cross-replica sentinel would never detect "
+                    f"it; set consistency_every >= 1")
 
     @property
     def enabled(self) -> bool:
@@ -242,22 +276,19 @@ class RecoverySupervisor:
             self.logger.log_line("resilience: good-slot save retry succeeded")
 
     # -- recovery actions ---------------------------------------------------
-    def recover_nonfinite(self, exc: BaseException, *, epoch: int,
-                          restore: Callable[[], None],
-                          shrink_lr: Callable[[float], None] | None = None
-                          ) -> bool:
-        """Handle a NonFiniteError raised out of an epoch. Returns True when
+    def _restore_and_retry(self, *, epoch: int, label: str,
+                           restore: Callable[[], None],
+                           shrink_lr: Callable[[float], None] | None
+                           ) -> bool:
+        """Shared restore-the-good-slot-and-retry policy. Returns True when
         the epoch should be retried (state restored), False when the caller
         must re-raise (recovery disabled, budget exhausted, or nothing to
         restore)."""
-        self._telemetry.failure("non-finite", epoch=epoch,
-                                detail=_short(exc),
-                                retries_left=self.retries_left)
         if not self.enabled:
             return False
         if self.retries_left <= 0:
             self.logger.log_line(
-                "resilience: non-finite retry budget exhausted — raising")
+                f"resilience: {label} retry budget exhausted — raising")
             return False
         self.retries_left -= 1
         try:
@@ -269,25 +300,51 @@ class RecoverySupervisor:
             return False
         except Exception as e:  # noqa: BLE001 - e.g. every version torn
             # (CheckpointIntegrityError). The caller re-raises the original
-            # NonFiniteError — the restore failure is context, not cause.
+            # error — the restore failure is context, not cause.
             self._telemetry.failure("recovery-restore-failed",
                                     slot=self.slot, detail=_short(e))
             self.logger.log_line(
                 f"resilience: restoring {self.slot!r} failed "
                 f"({type(e).__name__}: {str(e)[:160]}) — raising the "
-                f"original non-finite error")
+                f"original {label} error")
             return False
-        if self.config.lr_shrink != 1.0 and shrink_lr is not None:
+        if shrink_lr is not None and self.config.lr_shrink != 1.0:
             self.lr_scale *= self.config.lr_shrink
             shrink_lr(self.config.lr_shrink)
         self._telemetry.recovery(action="restored", slot=self.slot,
                                  epoch=epoch, retries_left=self.retries_left,
-                                 lr_scale=self.lr_scale)
+                                 lr_scale=self.lr_scale, detail=label)
         self.logger.log_line(
-            f"resilience: non-finite at epoch {epoch} — restored "
+            f"resilience: {label} at epoch {epoch} — restored "
             f"{self.slot!r}, lr x{self.lr_scale:g}, retrying "
             f"({self.retries_left} retries left)")
         return True
+
+    def recover_nonfinite(self, exc: BaseException, *, epoch: int,
+                          restore: Callable[[], None],
+                          shrink_lr: Callable[[float], None] | None = None
+                          ) -> bool:
+        """Handle a NonFiniteError raised out of an epoch (see
+        :meth:`_restore_and_retry` for the return contract)."""
+        self._telemetry.failure("non-finite", epoch=epoch,
+                                detail=_short(exc),
+                                retries_left=self.retries_left)
+        return self._restore_and_retry(epoch=epoch, label="non-finite",
+                                       restore=restore, shrink_lr=shrink_lr)
+
+    def recover_divergence(self, exc: BaseException, *, epoch: int,
+                           restore: Callable[[], None]) -> bool:
+        """Handle a no-quorum ReplicaDivergenceError from the consistency
+        sentinel (train/consistency.py): with no majority-good replica to
+        re-broadcast from, the only trustworthy state is the last good
+        checkpoint — restore it and retry the epoch, on the same bounded
+        budget as non-finite recovery. The sentinel already recorded the
+        ``consistency``/``failure`` detection pair; this adds the matching
+        ``recovery`` record. No LR shrink: divergence is a hardware/
+        transport lie, not an optimization instability."""
+        return self._restore_and_retry(epoch=epoch,
+                                       label="replica-divergence",
+                                       restore=restore, shrink_lr=None)
 
     def note_fallback(self, path: str, reason: str) -> None:
         """Checkpointer callback: the newest version was torn/corrupt and

@@ -419,16 +419,34 @@ class Trainer:
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )
-        from distributed_model_parallel_tpu.utils.faults import FaultInjector
+        from distributed_model_parallel_tpu.utils.faults import (
+            FaultInjector,
+            validate_corruption_plan,
+        )
 
         self.faults = FaultInjector(config.recovery.faults)
+        if config.consistency_every and config.strategy == "fsdp":
+            raise ValueError(
+                "consistency_every needs state replicated over the data "
+                "axis to compare; strategy='fsdp' shards params + "
+                "optimizer state over it — no redundancy, no cross-replica "
+                "check. No silent ignores")
+        # Topology validation first: on a topology that CANNOT arm the
+        # sentinel, the supervisor's "set consistency_every >= 1" advice
+        # would send the user into the rejection above.
+        validate_corruption_plan(
+            self.faults.plan,
+            # FSDP shards state over the data axis — zero replicated copies.
+            0 if config.strategy == "fsdp" else self.spec.num_data,
+            context=f"strategy={config.strategy!r}")
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
                                  injector=self.faults)
         self.resilience = RecoverySupervisor(
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="good", injector=self.faults,
-            check_finite_every=config.check_finite_every)
+            check_finite_every=config.check_finite_every,
+            consistency_every=config.consistency_every)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
@@ -436,6 +454,14 @@ class Trainer:
             stall_budget_s=config.stall_budget_s, logger=self.logger,
             watchdog_interval_s=config.recovery.watchdog_interval_s,
             on_stall=self.resilience.on_stall, injector=self.faults)
+        from distributed_model_parallel_tpu.train.consistency import (
+            ConsistencySentinel,
+        )
+
+        self.sentinel = ConsistencySentinel(
+            config.consistency_every, self.spec, logger=self.logger,
+            guards=self.guards,
+            barrier_timeout_s=config.recovery.barrier_timeout_s)
         self.best_acc = 0.0
         self.start_epoch = 0
         self._rng = jax.random.key(config.seed + 1)
@@ -636,7 +662,8 @@ class Trainer:
     def _prefetched(self, loader):
         return maybe_prefetch(loader, self.config.data.prefetch)
 
-    def _drain(self, pending: list, meters: dict) -> None:
+    def _drain(self, pending: list, meters: dict, *,
+               sentinel: bool = False) -> None:
         """Fetch queued device metrics and fold them into the meters.
 
         Metrics are held as device arrays between sync points so the host
@@ -648,16 +675,25 @@ class Trainer:
         This is the trainer's sync point, so the guards (when configured)
         run here: the blocking fetch sits under the stall watchdog, and the
         fetched values (plus, at the coarser cadence, the params) get
-        finiteness-checked (train/guards.py:GuardRunner).
+        finiteness-checked (train/guards.py:GuardRunner). With
+        ``sentinel=True`` (training drains only — eval never mutates
+        state) the cross-replica consistency sentinel also advances and,
+        at its cadence, fingerprints + repairs the live state
+        (train/consistency.py).
         """
         with self.guards.watch():
             host = jax.device_get(pending)
-        if self.guards.enabled and host:
+        if host and (self.guards.enabled
+                     or (sentinel and self.sentinel.enabled)):
             # Entries may stack K steps (multi-step dispatch): count real
             # steps so the every-N cadence is dispatch-shape independent.
             n_steps = sum(np.atleast_1d(m["loss"]).shape[0] for m in host)
-            self.guards.after_sync(
-                host, n_steps, params=getattr(self.state, "params", None))
+            if self.guards.enabled:
+                self.guards.after_sync(
+                    host, n_steps,
+                    params=getattr(self.state, "params", None))
+            if sentinel and self.sentinel.enabled and n_steps:
+                self._run_sentinel(n_steps)
         for metrics in host:
             loss = np.atleast_1d(metrics["loss"])
             batch = np.atleast_1d(metrics["batch"])
@@ -670,12 +706,43 @@ class Trainer:
                 meters["acc5"].update(float(c5[j]) / b * 100, int(b))
         pending.clear()
 
+    def _sentinel_tree(self) -> dict:
+        """The replicated-state subtree the consistency sentinel
+        fingerprints: params + optimizer state (+ EMA and BN stats where
+        present — per-replica DDP BN state is auto-excluded by the
+        sentinel's data-axis sharding filter). Keys are TrainState field
+        names so a repaired tree splices back via ``state.replace``."""
+        t = {"params": self.state.params,
+             "model_state": self.state.model_state,
+             "opt_state": self.state.opt_state}
+        if self.state.ema_params is not None:
+            t["ema_params"] = self.state.ema_params
+        if self.state.ema_model_state is not None:
+            t["ema_model_state"] = self.state.ema_model_state
+        return t
+
+    def _run_sentinel(self, n_steps: int, *, flush: bool = False) -> None:
+        """Advance the consistency sentinel (or, with ``flush=True``,
+        check any steps the cadence hasn't covered — end of epoch, before
+        the good slot is stamped); splice a repaired state back in place.
+        No-quorum divergence / non-finite consensus raise out of here
+        into fit()'s recovery handlers."""
+        fixed = (self.sentinel.flush(self._sentinel_tree) if flush
+                 else self.sentinel.after_sync(n_steps, self._sentinel_tree))
+        if fixed is not None:
+            self.state = self.state.replace(**fixed)
+
     def _poll_step_faults(self, pending: list) -> None:
         """Serve planned step-site faults (utils/faults.py): poison the
-        just-computed metrics or the live params, or request a simulated
-        preemption — the chaos hooks the recovery tests drive. No-op (one
-        counter bump) when no fault plan is configured."""
-        from distributed_model_parallel_tpu.utils.faults import poison
+        just-computed metrics or the live params, silently corrupt one
+        replica's params (bitflip/desync/grad_skew), or request a
+        simulated preemption — the chaos hooks the recovery tests drive.
+        No-op (one counter bump) when no fault plan is configured."""
+        from distributed_model_parallel_tpu.utils.faults import (
+            CORRUPTION_KINDS,
+            corrupt_one_replica,
+            poison,
+        )
 
         for spec in self.faults.poll("step"):
             if spec.kind == "preempt":
@@ -685,6 +752,11 @@ class Trainer:
             elif spec.kind == "nan_params":
                 self.state = self.state.replace(
                     params=poison(self.state.params))
+            elif spec.kind in CORRUPTION_KINDS:
+                self.state = self.state.replace(
+                    params=corrupt_one_replica(
+                        self.state.params, self.spec, spec.kind,
+                        spec.param))
 
     def train_epoch(self, epoch: int) -> EpochResult:
         if getattr(self, "_multi_step", None) is not None:
@@ -705,7 +777,7 @@ class Trainer:
             log_now = i % self.config.log_every_n_steps == 0
             if log_now or len(pending) >= self._max_inflight:
                 n = len(pending)
-                self._drain(pending, meters)    # blocks: sync point
+                self._drain(pending, meters, sentinel=True)  # sync point
                 timer.window_done(n)
             if log_now:
                 # Per-WINDOW samples (meter .last, set by window_done), not
@@ -720,8 +792,10 @@ class Trainer:
                     samples_per_s=self.config.data.batch_size
                     / max(timer.step.last, 1e-9))
         n = len(pending)
-        self._drain(pending, meters)
+        self._drain(pending, meters, sentinel=True)
         timer.window_done(n)
+        if self.sentinel.enabled:
+            self._run_sentinel(0, flush=True)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
                            meters["acc5"].avg, timer.step.avg, timer.data.avg)
 
@@ -761,7 +835,7 @@ class Trainer:
             # per-batch path.
             log_now = (-i) % self.config.log_every_n_steps < chunk.shape[0]
             if log_now or len(pending) >= self._max_inflight:
-                self._drain(pending, meters)
+                self._drain(pending, meters, sentinel=True)
                 timer.window_done(inflight)
                 inflight = 0
             if log_now:
@@ -773,8 +847,10 @@ class Trainer:
                     data_time_s=timer.data.last,
                     samples_per_s=self.config.data.batch_size
                     / max(timer.step.last, 1e-9))
-        self._drain(pending, meters)
+        self._drain(pending, meters, sentinel=True)
         timer.window_done(inflight)
+        if self.sentinel.enabled:
+            self._run_sentinel(0, flush=True)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
                            meters["acc5"].avg, timer.step.avg, timer.data.avg)
 
@@ -811,10 +887,13 @@ class Trainer:
         NonFiniteError raised by the guards restores the supervisor's
         per-epoch "last good" checkpoint, optionally shrinks the LR, and
         retries the epoch — bounded by the retry budget
-        (train/resilience.py).
+        (train/resilience.py). A no-quorum replica divergence from the
+        consistency sentinel (train/consistency.py) takes the same
+        restore-and-retry path, without the LR shrink.
         """
         from distributed_model_parallel_tpu.train.guards import (
             NonFiniteError,
+            ReplicaDivergenceError,
         )
 
         epochs = epochs if epochs is not None else self.config.epochs
@@ -829,6 +908,11 @@ class Trainer:
                     if self.resilience.recover_nonfinite(
                             e, epoch=epoch, restore=self._restore_good,
                             shrink_lr=self._apply_lr_shrink):
+                        continue        # state restored — redo the epoch
+                    raise
+                except ReplicaDivergenceError as e:
+                    if self.resilience.recover_divergence(
+                            e, epoch=epoch, restore=self._restore_good):
                         continue        # state restored — redo the epoch
                     raise
                 if self.preemption.requested():

@@ -27,12 +27,30 @@ kind           site    effect when fired
 ``tear_save``  save    let the save commit, then truncate its files — the
                        torn-newest-checkpoint scenario a crashed writer or
                        partial copy leaves on disk
+``bitflip``    step    flip one bit of one element of leaf ``param`` on ONE
+                       data-parallel replica (a silent data corruption, the
+                       "cores that don't count" failure mode) — detectable
+                       only by cross-replica comparison
+                       (train/consistency.py)
+``desync``     step    multiply every float leaf of one replica's params by
+                       ``1 + param`` (default 1e-3): replica drift, as a
+                       slowly-diverging core or torn HBM write produces
+``grad_skew``  step    add ``param`` (default 1e-3) to every float leaf of
+                       one replica's params — the accumulated effect of one
+                       replica applying a skewed gradient
 =============  ======  =====================================================
 
 Sites are consulted by the trainers (``step``), ``GuardRunner.watch``
 (``sync``) and ``Checkpointer.save`` (``save``). Each ``poll(site)`` call
 advances that site's occurrence counter; a spec fires when its ``at`` index
 matches — once, deterministically, independent of wall clock.
+
+The three CORRUPTION_KINDS perturb exactly one data-parallel replica (the
+highest replica index) via :func:`corrupt_one_replica` — a ``shard_map``
+over the live mesh, so the corrupted copy exists only in that replica's
+device buffers, exactly like real silent corruption. They therefore
+require ``>= 2`` data-parallel replicas; trainers whose topology has no
+replicated state reject them loudly at construction.
 """
 
 from __future__ import annotations
@@ -43,10 +61,12 @@ import time
 from typing import Any, Callable, Sequence
 
 __all__ = [
+    "CORRUPTION_KINDS",
     "FAULT_SITES",
     "FaultInjector",
     "FaultSpec",
     "InjectedFaultError",
+    "corrupt_one_replica",
     "parse_faults",
     "poison",
     "tear_checkpoint",
@@ -64,18 +84,29 @@ FAULT_SITES = {
     "stall": "sync",
     "save_fail": "save",
     "tear_save": "save",
+    "bitflip": "step",
+    "desync": "step",
+    "grad_skew": "step",
 }
+
+# Faults that silently corrupt ONE data-parallel replica's state (served by
+# corrupt_one_replica); they need >= 2 replicas to be meaningful — and to be
+# detectable at all.
+CORRUPTION_KINDS = frozenset({"bitflip", "desync", "grad_skew"})
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """One planned fault: ``kind`` fires at the ``at``-th occurrence
     (0-based) of its hook site; ``param`` is the kind-specific knob
-    (sleep seconds for ``stall``)."""
+    (sleep seconds for ``stall``). ``None`` means "not given" — each
+    consumer applies its own documented default, and an EXPLICIT value
+    is never silently replaced (``desync@5:0`` is rejected, not bumped
+    to the default magnitude)."""
 
     kind: str
     at: int
-    param: float = 0.0
+    param: float | None = None
 
     def __post_init__(self):
         if self.kind not in FAULT_SITES:
@@ -105,7 +136,7 @@ def parse_faults(spec: str) -> tuple[FaultSpec, ...]:
         kind, _, rest = entry.partition("@")
         at_s, _, param_s = rest.partition(":")
         out.append(FaultSpec(kind=kind.strip(), at=int(at_s),
-                             param=float(param_s) if param_s else 0.0))
+                             param=float(param_s) if param_s else None))
     return tuple(out)
 
 
@@ -158,7 +189,7 @@ class FaultInjector:
         inside the watchdog-guarded region so the delay is observed."""
         for spec in self.poll(site):
             if spec.kind == "stall":
-                time.sleep(spec.param)
+                time.sleep(spec.param or 0.0)
 
 
 def poison(tree: Any) -> Any:
@@ -171,6 +202,173 @@ def poison(tree: Any) -> Any:
         lambda x: (jnp.full_like(x, jnp.nan)
                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
                    else x), tree)
+
+
+def validate_corruption_plan(plan: Sequence[FaultSpec], n_replicas: int,
+                             *, context: str) -> None:
+    """Reject a fault plan that injects silent corruption into a run with
+    no replicated data axis to diverge (``n_replicas < 2``) — the shared
+    fail-fast check every trainer constructor runs. ``context`` names the
+    topology for the error message (e.g. ``"strategy='fsdp'"``)."""
+    corrupting = sorted({s.kind for s in plan if s.kind in CORRUPTION_KINDS})
+    if corrupting and n_replicas < 2:
+        raise ValueError(
+            f"corruption faults {corrupting} perturb one data-parallel "
+            f"replica relative to the others, but {context} has "
+            f"{n_replicas} replicated replica(s) — nothing to diverge "
+            f"from, and no redundancy for the consistency sentinel to "
+            f"detect it with")
+
+
+def _spec_axes(pspec) -> set:
+    """Mesh axis names a PartitionSpec shards over (also used by the
+    consistency sentinel's sharding filter — train/consistency.py)."""
+    out: set = set()
+    for entry in tuple(pspec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(entry)
+        else:
+            out.add(entry)
+    return out
+
+
+def _combined_replica_index(axis_names) -> "Any":
+    """Flat replica index over the (possibly hierarchical) data axes,
+    row-major in axis order — must match the all_gather row order the
+    consistency sentinel reads (pinned by tests/test_psum_canary.py)."""
+    import jax
+
+    idx = None
+    for name in axis_names:
+        i = jax.lax.axis_index(name)
+        n = jax.lax.psum(1, name)
+        idx = i if idx is None else idx * n + i
+    return idx
+
+
+def corrupt_one_replica(tree: Any, mesh_spec: Any, kind: str,
+                        param: float | None = None, *,
+                        replica: int | None = None) -> Any:
+    """Silently corrupt ONE data-parallel replica's copy of ``tree``.
+
+    Runs a ``shard_map`` over ``mesh_spec.mesh`` in which only the target
+    replica (default: the highest replica index) perturbs its local block —
+    the returned arrays carry divergent per-device buffers under a sharding
+    that still *claims* replication over the data axis, exactly the state a
+    flipped bit or drifting core leaves behind. Every leaf must be a
+    committed ``jax.Array`` with a ``NamedSharding`` on that mesh.
+
+    Effects (see the module fault table): ``bitflip`` flips the lowest
+    exponent bit of element 0 of float leaf ``int(param)`` (default 0);
+    ``desync`` multiplies every float leaf by ``1 + param``; ``grad_skew``
+    adds ``param`` to every float leaf (both default to magnitude 1e-3
+    when ``param`` is omitted; an explicit 0 is rejected — a
+    zero-magnitude "corruption" corrupts nothing, so the drill would
+    claim an injection that never happened).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    if kind not in CORRUPTION_KINDS:
+        raise ValueError(f"not a corruption fault kind: {kind!r} "
+                         f"(known: {sorted(CORRUPTION_KINDS)})")
+    data_axes = mesh_spec.data_axes
+    n_replicas = mesh_spec.num_data
+    if n_replicas < 2:
+        raise ValueError(
+            f"corruption fault {kind!r} perturbs one replica relative to "
+            f"the others, but the mesh has {n_replicas} data-parallel "
+            f"replica(s) — nothing to diverge from")
+    target = n_replicas - 1 if replica is None else int(replica)
+    if not 0 <= target < n_replicas:
+        # An out-of-range index matches no device in the shard_map mask,
+        # so the "corruption" would silently touch nothing — the drill
+        # would claim an injection that never happened (same
+        # no-silent-no-op rule as the zero-magnitude rejection below).
+        raise ValueError(
+            f"corrupt_one_replica: replica index {target} out of range "
+            f"for {n_replicas} data-parallel replicas")
+    leaves, treedef = jax.tree.flatten(tree)
+    for i, leaf in enumerate(leaves):
+        if not isinstance(getattr(leaf, "sharding", None), NamedSharding):
+            raise ValueError(
+                f"corrupt_one_replica needs NamedSharding-committed leaves; "
+                f"leaf {i} has {getattr(leaf, 'sharding', None)!r}")
+    specs = tuple(leaf.sharding.spec for leaf in leaves)
+    float_idx = [i for i, leaf in enumerate(leaves)
+                 if jnp.issubdtype(leaf.dtype, jnp.floating)]
+    if not float_idx:
+        raise ValueError("corrupt_one_replica: tree has no float leaves")
+    if kind == "bitflip":
+        leaf_i = 0 if param is None else param
+        if leaf_i != int(leaf_i):
+            # "kind@at:param" parses params as floats; a fractional leaf
+            # index silently truncated would corrupt a different tensor
+            # than the drill asserts on — same no-silent-replacement rule
+            # as the explicit-zero rejection for desync/grad_skew.
+            raise ValueError(
+                f"bitflip leaf index must be a whole number, got {param}")
+        leaf_i = int(leaf_i)
+        if not 0 <= leaf_i < len(float_idx):
+            # A plan naming a leaf that doesn't exist would otherwise
+            # corrupt some other tensor than the drill asserts on.
+            raise ValueError(
+                f"bitflip leaf index {leaf_i} out of range: the tree "
+                f"has {len(float_idx)} float leaves")
+        flip_leaf = float_idx[leaf_i]
+        # The shard_map body flips element 0 of the LOCAL block, so a leaf
+        # sharded over non-data axes (tp/pp) would otherwise get one flip
+        # per shard — not the documented "one bit of one element". Gate
+        # the flip to shard index 0 of those axes; copies along axes the
+        # leaf is replicated over all flip (one logical element, kept
+        # consistent within the replica).
+        flip_sharded_other = tuple(
+            a for a in _spec_axes(specs[flip_leaf]) if a not in data_axes)
+    if param == 0 and kind in ("desync", "grad_skew"):
+        raise ValueError(
+            f"{kind} with explicit magnitude 0 perturbs nothing — omit "
+            f"the param for the 1e-3 default or give a nonzero magnitude")
+    scale = 1e-3 if param is None else param
+
+    def body(*ls):
+        bad = _combined_replica_index(data_axes) == target
+        out = []
+        for i, x in enumerate(ls):
+            if i not in float_idx:
+                out.append(x)
+                continue
+            if kind == "bitflip":
+                if i != flip_leaf:
+                    out.append(x)
+                    continue
+                # Flip the lowest exponent bit of element 0 — a large but
+                # finite change (mantissa flips near zero can land on
+                # denormals the CPU backend flushes back to zero).
+                nbits = x.dtype.itemsize * 8
+                uint = jnp.dtype(f"uint{nbits}")
+                flat = x.reshape(-1)
+                u = jax.lax.bitcast_convert_type(flat[0], uint)
+                bit = jnp.asarray(1 << jnp.finfo(x.dtype).nmant, uint)
+                flipped = jax.lax.bitcast_convert_type(u ^ bit, x.dtype)
+                hit = bad
+                if flip_sharded_other:
+                    hit = jnp.logical_and(
+                        bad,
+                        _combined_replica_index(flip_sharded_other) == 0)
+                out.append(flat.at[0].set(
+                    jnp.where(hit, flipped, flat[0])).reshape(x.shape))
+            elif kind == "desync":
+                out.append(jnp.where(bad, x * (1.0 + scale), x))
+            else:                                        # grad_skew
+                out.append(jnp.where(bad, x + jnp.asarray(scale, x.dtype), x))
+        return tuple(out)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh_spec.mesh, in_specs=specs,
+                               out_specs=specs, check_vma=False))
+    return jax.tree.unflatten(treedef, fn(*leaves))
 
 
 def tear_checkpoint(path: str) -> None:

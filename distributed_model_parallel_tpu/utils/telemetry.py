@@ -44,6 +44,12 @@ recovery   action, plus context (slot, epoch, retries_left, lr_scale) —
            every failure record the supervisor handles gets a matching
            recovery record, and scripts/dmp_report.py renders the pair
            timeline
+consistency status (divergence | repaired | no-quorum | non-finite),
+           plus context (replicas, outliers, leaves, check index) — one
+           cross-replica consistency-sentinel event
+           (train/consistency.py); a
+           divergence gets a matching ``recovery`` record
+           (replica-rebroadcast or restored) on the same timeline
 ========== ==========================================================
 """
 
@@ -489,6 +495,15 @@ class TelemetryRun:
         """One recovery action (restore, fallback, checkpoint-and-exit,
         save retry) — the matching half of a ``failure`` record."""
         self.record("recovery", action=action, **fields)
+
+    def consistency(self, status: str, **fields) -> None:
+        """One cross-replica consistency-sentinel event
+        (train/consistency.py): ``divergence`` when replicas disagree,
+        ``repaired`` after an in-place re-broadcast, ``no-quorum`` when no
+        majority-good replica exists and the supervisor's good-slot
+        restore takes over, ``non-finite`` when replicas agree on a
+        non-finite state (routed to the NonFiniteError recovery path)."""
+        self.record("consistency", status=status, **fields)
 
     def memory(self) -> list[dict] | None:
         """Record device memory watermarks (no-op record skipped when the

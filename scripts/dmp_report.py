@@ -12,8 +12,10 @@ directory (utils/xplane op breakdown) and prints:
 * communication volume per collective kind x mesh axis (trace-time
   estimates from ops/collectives.py);
 * device memory watermarks and recompilation counts;
-* the failure/recovery timeline (injected faults, non-finite restores,
-  stall escalations, torn-checkpoint fallbacks — train/resilience.py);
+* the failure/recovery/divergence timeline (injected faults, non-finite
+  restores, stall escalations, torn-checkpoint fallbacks, cross-replica
+  divergence detections + repairs — train/resilience.py,
+  train/consistency.py);
 * top-N device ops + per-category device time from the xplane trace
   (``--trace``), degrading to an actionable one-liner when the tensorflow
   proto bindings are absent.
@@ -187,25 +189,37 @@ def _memory_section(lines: list[str], by_kind: dict) -> None:
 
 
 def _resilience_section(lines: list[str], by_kind: dict) -> None:
-    """Failure / recovery timeline: every detected failure (non-finite,
-    stall, torn checkpoint, failed save, preemption) next to the recovery
-    action the supervisor took (train/resilience.py), in event order."""
+    """Failure / recovery / divergence timeline: every detected failure
+    (non-finite, stall, torn checkpoint, failed save, preemption, replica
+    divergence) next to the recovery action the supervisor or consistency
+    sentinel took (train/resilience.py, train/consistency.py), in event
+    order."""
     fails = by_kind.get("failure") or []
     recs = by_kind.get("recovery") or []
-    if not fails and not recs:
+    cons = by_kind.get("consistency") or []
+    if not fails and not recs and not cons:
         return
     starts = by_kind.get("run_start") or []
     t0 = starts[-1].get("ts") if starts else None
     if t0 is None:
-        t0 = min((r.get("ts") for r in fails + recs
+        t0 = min((r.get("ts") for r in fails + recs + cons
                   if isinstance(r.get("ts"), (int, float))), default=0.0)
-    lines.append(f"== resilience ({len(fails)} failures, "
-                 f"{len(recs)} recoveries) ==")
-    events = sorted(fails + recs,
+    header = f"== resilience ({len(fails)} failures, {len(recs)} recoveries"
+    lines.append(header + (f", {len(cons)} consistency) =="
+                           if cons else ") =="))
+    events = sorted(fails + recs + cons,
                     key=lambda r: r.get("ts") or 0.0)
     for r in events:
         dt = (r["ts"] - t0) if isinstance(r.get("ts"), (int, float)) else 0.0
-        if r.get("kind") == "failure" or "error" in r:
+        if r.get("kind") == "consistency":
+            extra = " ".join(
+                f"{k}={r[k]}" for k in ("replicas", "groups", "outliers",
+                                        "leaves", "check")
+                if r.get(k) is not None)
+            lines.append(f"  [+{dt:7.1f}s] consistency "
+                         f"{str(r.get('status')):<22}"
+                         + (f" {extra}" if extra else ""))
+        elif r.get("kind") == "failure" or "error" in r:
             extra = " ".join(
                 f"{k}={r[k]}" for k in ("epoch", "stage", "attempts",
                                         "retries_left")

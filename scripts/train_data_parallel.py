@@ -104,6 +104,19 @@ def parse_args():
                         "every N steps for NaN/Inf (0 = off)")
     p.add_argument("--stall-budget", default=None, type=float, metavar="S",
                    help="arm the live stall watchdog around blocking syncs")
+    p.add_argument("--consistency-every", default=0, type=int, metavar="N",
+                   help="cross-replica consistency sentinel: every N steps "
+                        "fingerprint params+opt state on device, compare "
+                        "across the data axis, and repair a minority-bad "
+                        "replica by re-broadcast (0 = off; "
+                        "train/consistency.py)")
+    p.add_argument("--barrier-timeout", default=None, type=float,
+                   metavar="S",
+                   help="hard bound (seconds) on each consistency check's "
+                        "blocking ops — the multi-host rendezvous AND the "
+                        "fingerprint fetch (any run) — so a wedged/missing "
+                        "participant is reported as a straggler instead of "
+                        "hanging")
     p.add_argument("--recovery-retries", default=0, type=int,
                    help="automatic recovery: restore the last good "
                         "checkpoint and retry the epoch on non-finite "
@@ -159,6 +172,7 @@ def main():
         max_retries=args.recovery_retries,
         lr_shrink=args.recovery_lr_shrink,
         stall_exit=args.stall_exit,
+        barrier_timeout_s=args.barrier_timeout,
         faults=parse_faults(args.inject_faults) if args.inject_faults
         else ())
     config = TrainConfig(
@@ -189,6 +203,7 @@ def main():
         ddp_allreduce=args.allreduce,
         check_finite_every=args.check_finite_every,
         stall_budget_s=args.stall_budget,
+        consistency_every=args.consistency_every,
         recovery=recovery,
         log_name=args.log_name or f"data_para_{args.batch_size}",
     )

@@ -83,6 +83,19 @@ def parse_args():
     p.add_argument("--check-finite-every", default=0, type=int,
                    help="check loss every step and params every N steps "
                         "for NaN/Inf (0 = off)")
+    p.add_argument("--consistency-every", default=0, type=int, metavar="N",
+                   help="cross-replica consistency sentinel: every N steps "
+                        "fingerprint params+opt state on device, compare "
+                        "across the dp axis, and repair a minority-bad "
+                        "replica by re-broadcast (0 = off; "
+                        "train/consistency.py)")
+    p.add_argument("--barrier-timeout", default=None, type=float,
+                   metavar="S",
+                   help="hard bound (seconds) on each consistency check's "
+                        "blocking ops — the multi-host rendezvous AND the "
+                        "fingerprint fetch (any run) — so a wedged/missing "
+                        "participant is reported as a straggler instead of "
+                        "hanging")
     p.add_argument("--recovery-retries", default=0, type=int,
                    help="restore the last good checkpoint and retry the "
                         "epoch on non-finite detections, up to N times")
@@ -150,9 +163,11 @@ def main():
         virtual_stages=args.virtual_stages,
         steps_per_epoch=args.steps, epochs=args.epochs, resume=args.resume,
         check_finite_every=args.check_finite_every,
+        consistency_every=args.consistency_every,
         recovery=RecoveryConfig(
             max_retries=args.recovery_retries,
             lr_shrink=args.recovery_lr_shrink,
+            barrier_timeout_s=args.barrier_timeout,
             faults=parse_faults(args.inject_faults) if args.inject_faults
             else ()),
     )

@@ -193,6 +193,7 @@ SLOW_TESTS = {
     "test_zoo_params.py::test_regnetx_200mf_param_count",
     "test_zoo_params.py::test_shufflenetg2_param_count",
     "test_zoo_params.py::test_shufflenetv2_param_count",
+    "test_consistency.py::test_trainer_bitflip_repaired_with_bitwise_parity",
     "test_auto_partition.py::test_pipeline_trainer_accepts_auto_partition",
     "test_auto_partition.py::test_unit_costs_mobilenet_track_flops",
     "test_baseline_configs.py::test_config1_dataparallel_resnet18_cpu_2dev",

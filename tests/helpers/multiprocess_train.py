@@ -1,4 +1,4 @@
-"""Subprocess body for the multi-process CPU-cluster test.
+"""Subprocess body for the multi-process CPU-cluster tests.
 
 Runs one epoch of the GSPMD Trainer over a data=4 mesh, either as a single
 process owning 4 virtual CPU devices or as one of two processes owning 2
@@ -10,8 +10,17 @@ reproduces single-controller math (VERDICT r2 item 2; the reference's
 real-multi-process analog is ``mp.spawn`` + ``init_process_group``,
 ``model_parallel.py:57,162``).
 
+Mode ``sentinel`` additionally arms the cross-replica consistency
+sentinel (train/consistency.py) with a ``bitflip`` corruption fault
+injected into the highest data replica — which lives on the LAST process
+in the 2-process topology, so the run exercises the genuinely
+cross-process path: host-side comparison of the all-gathered fingerprint
+on every process, the ``barrier_with_timeout`` rendezvous before each
+check, and an identical repair decision on both hosts. The JSON line
+gains ``consistency`` (record statuses) and ``repairs``.
+
 Usage: multiprocess_train.py <process_id> <num_processes> <port> \
-           <local_device_count> <workdir>
+           <local_device_count> <workdir> [plain|sentinel]
 """
 
 import json
@@ -22,6 +31,7 @@ import sys
 def main():
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     port, devcount, workdir = sys.argv[3], int(sys.argv[4]), sys.argv[5]
+    mode = sys.argv[6] if len(sys.argv) > 6 else "plain"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={devcount}")
@@ -45,10 +55,20 @@ def main():
         MeshConfig,
         ModelConfig,
         OptimizerConfig,
+        RecoveryConfig,
         TrainConfig,
     )
     from distributed_model_parallel_tpu.train.trainer import Trainer
 
+    recovery = RecoveryConfig()
+    extra = {}
+    if mode == "sentinel":
+        # Every process runs the same deterministic plan; the corrupted
+        # replica (data index 3) is addressable only on the last process,
+        # so detection *requires* the cross-host fingerprint gather.
+        recovery = RecoveryConfig(max_retries=1, barrier_timeout_s=120.0,
+                                  faults=("bitflip@1",))
+        extra = dict(consistency_every=1, max_inflight_steps=1)
     cfg = TrainConfig(
         model=ModelConfig(name="tinycnn"),
         data=DataConfig(name="synthetic", batch_size=32, eval_batch_size=32,
@@ -56,16 +76,39 @@ def main():
         optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=2),
         mesh=MeshConfig(data=4),
         epochs=1,
+        recovery=recovery,
         log_dir=os.path.join(workdir, f"log{pid}"),
         checkpoint_dir=os.path.join(workdir, f"ckpt{pid}"),
         log_every_n_steps=1000,
+        **extra,
     )
     t = Trainer(cfg)
     res = t.train_epoch(0)
     ev = t.evaluate()
+    if mode == "sentinel" and nproc > 1:
+        # A wedged or missing peer must surface as a straggler, not an
+        # eternal hang: the same timed rendezvous the sentinel runs before
+        # each fingerprint, used here as the end-of-run sync.
+        from distributed_model_parallel_tpu.mesh import barrier_with_timeout
+        from distributed_model_parallel_tpu.ops.collectives import (
+            mesh_barrier,
+        )
+
+        barrier_with_timeout(lambda: mesh_barrier(t.spec), 120.0,
+                             what="end-of-run")
     if jax.process_index() == 0:
-        print(json.dumps({"loss": res.loss, "acc1": res.acc1,
-                          "eval_loss": ev.loss, "nproc": nproc}))
+        out = {"loss": res.loss, "acc1": res.acc1,
+               "eval_loss": ev.loss, "nproc": nproc}
+        if mode == "sentinel":
+            from distributed_model_parallel_tpu.utils.telemetry import (
+                read_records,
+            )
+
+            recs = read_records(t.logger.jsonl_path)
+            out["consistency"] = [r.get("status") for r in recs
+                                  if r.get("kind") == "consistency"]
+            out["repairs"] = t.sentinel.repairs
+        print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,724 @@
+"""Chaos tier: the cross-replica consistency sentinel
+(train/consistency.py) against the silent-corruption faults
+(utils/faults.py CORRUPTION_KINDS). Covers: fingerprint determinism
+across replicas, outlier identification under a 2-of-3 quorum, repair
+restoring bitwise equality, no-quorum falling back to the good-slot
+restore, the end-to-end bitflip-parity drill, and the straggler barrier.
+The multiprocess half lives in tests/test_multiprocess.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.config import (
+    MeshConfig,
+    RecoveryConfig,
+)
+from distributed_model_parallel_tpu.mesh import make_mesh
+from distributed_model_parallel_tpu.train.consistency import (
+    ConsistencySentinel,
+    analyze_fingerprints,
+)
+from distributed_model_parallel_tpu.train.guards import (
+    NonFiniteError,
+    ReplicaDivergenceError,
+)
+from distributed_model_parallel_tpu.utils.faults import (
+    CORRUPTION_KINDS,
+    FaultSpec,
+    corrupt_one_replica,
+    parse_faults,
+)
+from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+from tests.conftest import tiny_train_config
+
+pytestmark = pytest.mark.chaos
+
+
+class _Telemetry:
+    def __init__(self):
+        self.records = []
+
+    def __getattr__(self, kind):
+        def rec(*a, **kw):
+            self.records.append((kind, a[0] if a else kw.get("action")
+                                 or kw.get("status"), kw))
+        return rec
+
+
+class _Logger:
+    def __init__(self):
+        self.lines = []
+        self.telemetry = _Telemetry()
+
+    def log_line(self, msg):
+        self.lines.append(msg)
+
+
+def _recorded(logger, kind):
+    """Primary values (status/action) of the fake-telemetry records."""
+    return [head for k, head, _ in logger.telemetry.records if k == kind]
+
+
+def _replicated_tree(spec, seed=0):
+    rng = np.random.default_rng(seed)
+    repl = NamedSharding(spec.mesh, P())
+    return {
+        "w": jax.device_put(
+            jnp.asarray(rng.normal(size=(4, 8)), jnp.float32), repl),
+        "b": jax.device_put(
+            jnp.asarray(rng.normal(size=(8,)), jnp.float32), repl),
+        "step": jax.device_put(jnp.asarray(3, jnp.int32), repl),
+    }
+
+
+def _sentinel(spec, every=1):
+    return ConsistencySentinel(every, spec, logger=_Logger())
+
+
+# ---------------------------------------------------------------------------
+# fault registry extensions
+# ---------------------------------------------------------------------------
+
+def test_corruption_kinds_parse_and_site():
+    specs = parse_faults("bitflip@2:1,desync@3,grad_skew@4:0.01")
+    assert specs == (FaultSpec("bitflip", 2, 1.0), FaultSpec("desync", 3),
+                     FaultSpec("grad_skew", 4, 0.01))
+    assert all(s.site == "step" for s in specs)
+    assert {s.kind for s in specs} == set(CORRUPTION_KINDS)
+
+
+@pytest.mark.parametrize("kind", sorted(CORRUPTION_KINDS))
+def test_corrupt_one_replica_diverges_exactly_one(kind):
+    spec = make_mesh(MeshConfig(data=8))
+    tree = _replicated_tree(spec)
+    bad = corrupt_one_replica(tree, spec, kind)
+    # Exactly the last replica's buffers differ from the original; all
+    # others are bitwise-untouched.
+    diverged = set()
+    for key in ("w", "b"):
+        ref = np.asarray(tree[key])
+        for shard in bad[key].addressable_shards:
+            if not np.array_equal(np.asarray(shard.data), ref):
+                diverged.add(shard.device.id)
+    assert diverged == {7}, diverged
+    # int leaves pass through untouched
+    for shard in bad["step"].addressable_shards:
+        assert int(shard.data) == 3
+
+
+def test_corrupt_one_replica_rejects_out_of_range_replica():
+    """An explicit replica index beyond the mesh matches no device in the
+    shard_map mask — the injection would silently touch nothing."""
+    spec = make_mesh(MeshConfig(data=2))
+    tree = _replicated_tree(spec)
+    with pytest.raises(ValueError, match="out of range"):
+        corrupt_one_replica(tree, spec, "desync", replica=7)
+
+
+def test_corrupt_one_replica_needs_replicas():
+    spec = make_mesh(MeshConfig(data=1))
+    tree = _replicated_tree(spec)
+    with pytest.raises(ValueError, match="replica"):
+        corrupt_one_replica(tree, spec, "bitflip")
+
+
+def test_bitflip_rejects_fractional_leaf_index():
+    """parse_faults yields float params; a fractional bitflip leaf index
+    must be rejected, not silently truncated onto a different tensor
+    than the drill asserts on."""
+    spec = make_mesh(MeshConfig(data=2))
+    tree = _replicated_tree(spec)
+    with pytest.raises(ValueError, match="whole number"):
+        corrupt_one_replica(tree, spec, "bitflip", 2.7)
+
+
+@pytest.mark.parametrize("kind", ["desync", "grad_skew"])
+def test_corrupt_one_replica_rejects_zero_magnitude(kind):
+    """An EXPLICIT magnitude of 0 (e.g. ``desync@5:0``) is rejected, not
+    silently bumped to the 1e-3 default: a zero-magnitude 'corruption'
+    corrupts nothing, so the drill would claim an injection that never
+    happened."""
+    spec = make_mesh(MeshConfig(data=2))
+    tree = _replicated_tree(spec)
+    with pytest.raises(ValueError, match="magnitude 0"):
+        corrupt_one_replica(tree, spec, kind, 0.0)
+    # Omitted param (None) still gets the documented default.
+    from distributed_model_parallel_tpu.utils.faults import parse_faults
+    assert parse_faults(f"{kind}@5")[0].param is None
+    assert parse_faults(f"{kind}@5:0.01")[0].param == 0.01
+
+
+# ---------------------------------------------------------------------------
+# fingerprint determinism + quorum analysis
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_deterministic_and_identical_across_replicas():
+    spec = make_mesh(MeshConfig(data=8))
+    s = _sentinel(spec)
+    leaves, _labels, _pos = s._included(_replicated_tree(spec))
+    fp1 = np.asarray(s._fingerprint_fn(leaves)(*leaves))
+    fp2 = np.asarray(s._fingerprint_fn(leaves)(*leaves))
+    assert fp1.shape == (8, 3, 4)          # [replicas, leaves, stats]
+    # Bitwise-identical rows across replicas AND across repeated checks —
+    # the property that makes exact comparison (not tolerance) valid.
+    assert len({fp1[i].tobytes() for i in range(8)}) == 1
+    assert fp1.tobytes() == fp2.tobytes()
+
+
+def test_bitsum_detects_sub_ulp_mantissa_flip():
+    """The exact bit-pattern checksum catches the textbook SDC the float
+    stats cannot: a mantissa-LSB flip whose value delta (~1e-7 on a ~1.0
+    element) vanishes below the precision of an f32 running sum over a
+    large leaf. Detection, repair, and restored bitwise equality must all
+    still work for it."""
+    spec = make_mesh(MeshConfig(data=4))
+    big = jax.device_put(jnp.ones((100, 100), jnp.float32),
+                         NamedSharding(spec.mesh, P()))
+    tree = {"w": big}
+
+    def flip_lsb(x):
+        idx = jax.lax.axis_index("data")
+        flat = x.reshape(-1)
+        u = jax.lax.bitcast_convert_type(flat[0], jnp.uint32)
+        flipped = jax.lax.bitcast_convert_type(u ^ jnp.uint32(1),
+                                               jnp.float32)
+        return flat.at[0].set(
+            jnp.where(idx == 3, flipped, flat[0])).reshape(x.shape)
+
+    bad = {"w": jax.jit(jax.shard_map(
+        flip_lsb, mesh=spec.mesh, in_specs=P(), out_specs=P(),
+        check_vma=False))(big)}
+    # Sanity: the float sums really do absorb the delta...
+    s = _sentinel(spec)
+    leaves, _labels, _pos = s._included(bad)
+    fp = np.asarray(s._fingerprint_fn(leaves)(*leaves))
+    assert fp[0, 0, 1] == fp[3, 0, 1] and fp[0, 0, 2] == fp[3, 0, 2]
+    # ...and the bitsum still convicts replica 3, and repair restores
+    # bitwise equality.
+    fixed = s.check(bad)
+    assert fixed is not None and s.repairs == 1
+    for shard in fixed["w"].addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      np.asarray(big))
+
+
+def test_bitsum_detects_correlated_sign_flip_on_tp_replicated_leaf():
+    """A leaf replicated over a tensor-parallel axis contributes one
+    bitsum per copy to the non-data psum; without the per-copy rotation
+    (_copy_rotated_bitsum) a sign-bit flip applied to BOTH tp copies of
+    one replica — exactly what corrupt_one_replica produces for
+    replicated leaves — sums to 2 * 2^31 ≡ 0 mod 2^32, and a 0.0 → -0.0
+    flip is invisible to the nonfinite/l2/sum stats too. The rotated
+    bitsum must still convict the replica, and repair must restore
+    bitwise equality."""
+    spec = make_mesh(MeshConfig(data=4, model=2))
+    zeros = jax.device_put(jnp.zeros((4, 4), jnp.float32),
+                           NamedSharding(spec.mesh, P()))
+
+    def sign_flip_all_copies_of_last_replica(x):
+        bad = jax.lax.axis_index("data") == 3  # both model copies flip
+        flat = x.reshape(-1)
+        u = jax.lax.bitcast_convert_type(flat[0], jnp.uint32)
+        flipped = jax.lax.bitcast_convert_type(u ^ jnp.uint32(1 << 31),
+                                               jnp.float32)
+        return flat.at[0].set(
+            jnp.where(bad, flipped, flat[0])).reshape(x.shape)
+
+    bad = {"w": jax.jit(jax.shard_map(
+        sign_flip_all_copies_of_last_replica, mesh=spec.mesh,
+        in_specs=P(), out_specs=P(), check_vma=False))(zeros)}
+    s = _sentinel(spec)
+    # Sanity: the float stats really are blind to 0.0 -> -0.0 ...
+    leaves, _labels, _pos = s._included(bad)
+    fp = np.asarray(s._fingerprint_fn(leaves)(*leaves))
+    assert np.array_equal(fp[0, 0, :3], fp[3, 0, :3])
+    # ... and the rotated bitsum still differs (no mod-2^32 cancellation).
+    assert fp[0, 0, 3].tobytes() != fp[3, 0, 3].tobytes()
+    fixed = s.check(bad)
+    assert fixed is not None and s.repairs == 1
+    for shard in fixed["w"].addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      np.zeros((4, 4), np.float32))
+
+
+def test_bitflip_on_tp_sharded_leaf_flips_one_global_element():
+    """bitflip's documented SDC model is ONE bit of ONE element on one
+    replica: for a leaf sharded over the model axis the flip must land
+    in exactly one shard (index 0 of the sharded non-data axes), not one
+    element per shard — and the sentinel must still detect and repair
+    it on the mixed mesh."""
+    spec = make_mesh(MeshConfig(data=4, model=2))
+    tree = {
+        "b": jax.device_put(jnp.zeros((8,), jnp.float32),
+                            NamedSharding(spec.mesh, P())),
+        "w": jax.device_put(jnp.ones((4, 8), jnp.float32),
+                            NamedSharding(spec.mesh, P(None, "model"))),
+    }
+    bad = corrupt_one_replica(tree, spec, "bitflip", 1.0)  # float leaf "w"
+    ref = np.asarray(tree["w"])
+    diffs = sum(
+        int((np.asarray(shard.data) != ref[shard.index]).sum())
+        for shard in bad["w"].addressable_shards)
+    assert diffs == 1, diffs
+    s = _sentinel(spec)
+    fixed = s.check(bad)
+    assert fixed is not None and s.repairs == 1
+    for shard in fixed["w"].addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      ref[shard.index])
+
+
+def test_analyze_quorum_2_of_3():
+    good = np.zeros((3, 2, 3), np.float32)
+    good[2, 1, 2] = 7.0                    # replica 2 lies on one checksum
+    v = analyze_fingerprints(good)
+    assert not v.consistent and v.has_quorum
+    assert v.good_replica in (0, 1) and v.outliers == (2,)
+
+
+def test_analyze_nonfinite_loses_tiebreak():
+    fp = np.zeros((2, 1, 3), np.float32)
+    fp[1, 0, 0] = 4.0                      # replica 1 has non-finite leaves
+    fp[1, 0, 2] = 9.0
+    v = analyze_fingerprints(fp)
+    # 1-vs-1, but only replica 0 is finite -> it wins the tie-break.
+    assert v.has_quorum and v.good_replica == 0 and v.outliers == (1,)
+
+
+def test_analyze_no_quorum_when_finite_sides_tie():
+    fp = np.zeros((2, 1, 3), np.float32)
+    fp[1, 0, 2] = 1.0                      # both finite, different
+    v = analyze_fingerprints(fp)
+    assert not v.consistent and not v.has_quorum
+
+
+def test_analyze_consistent_nonfinite():
+    fp = np.ones((4, 1, 3), np.float32)    # all agree, all non-finite
+    v = analyze_fingerprints(fp)
+    assert v.consistent and not v.finite
+
+
+# ---------------------------------------------------------------------------
+# detection + repair on the mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", sorted(CORRUPTION_KINDS))
+def test_repair_restores_bitwise_equality(kind):
+    spec = make_mesh(MeshConfig(data=8))
+    s = _sentinel(spec)
+    tree = _replicated_tree(spec)
+    fixed = s.check(corrupt_one_replica(tree, spec, kind))
+    assert fixed is not None and s.repairs == 1
+    for key in ("w", "b"):
+        ref = np.asarray(tree[key])
+        for shard in fixed[key].addressable_shards:
+            np.testing.assert_array_equal(np.asarray(shard.data), ref)
+    # A follow-up check sees a consistent state (and emits nothing new).
+    assert s.check(fixed) is None
+    assert _recorded(s.logger, "consistency") == ["divergence", "repaired"]
+    assert _recorded(s.logger, "recovery") == ["replica-rebroadcast"]
+
+
+def test_no_quorum_raises_divergence_error():
+    spec = make_mesh(MeshConfig(data=2))
+    s = _sentinel(spec)
+    tree = _replicated_tree(spec)
+    with pytest.raises(ReplicaDivergenceError, match="no repair quorum"):
+        s.check(corrupt_one_replica(tree, spec, "desync"))
+    assert _recorded(s.logger, "consistency") == ["divergence", "no-quorum"]
+
+
+def test_consensus_nonfinite_raises_nonfinite():
+    from distributed_model_parallel_tpu.utils.faults import poison
+
+    spec = make_mesh(MeshConfig(data=8))
+    s = _sentinel(spec)
+    with pytest.raises(NonFiniteError, match="non-finite"):
+        s.check(poison(_replicated_tree(spec)))
+
+
+def test_data_sharded_leaves_excluded():
+    spec = make_mesh(MeshConfig(data=8))
+    s = _sentinel(spec)
+    tree = _replicated_tree(spec)
+    # A per-replica leaf (DDP BN state layout): legitimately divergent.
+    tree["bn"] = jax.device_put(
+        jnp.arange(8, dtype=jnp.float32).reshape(8, 1),
+        NamedSharding(spec.mesh, P("data")))
+    leaves, labels, _pos = s._included(tree)
+    assert len(leaves) == 3 and not any("bn" in l for l in labels)
+    assert s.check(tree) is None           # per-replica variation != SDC
+
+
+def test_all_sharded_rejected_loudly():
+    spec = make_mesh(MeshConfig(data=8))
+    s = _sentinel(spec)
+    only_sharded = {"p": jax.device_put(
+        jnp.zeros((8, 2), jnp.float32), NamedSharding(spec.mesh, P("data")))}
+    with pytest.raises(ValueError, match="no replicated leaves"):
+        s.check(only_sharded)
+
+
+def test_cadence_counts_steps():
+    spec = make_mesh(MeshConfig(data=2))
+    s = _sentinel(spec, every=10)
+    tree = _replicated_tree(spec)
+    assert s.after_sync(9, lambda: tree) is None and s.checks == 0
+    assert s.after_sync(1, lambda: tree) is None and s.checks == 1
+    assert s.after_sync(9, lambda: tree) is None and s.checks == 1
+    assert s.after_sync(5, lambda: tree) is None and s.checks == 2
+
+
+def test_flush_checks_uncovered_tail_only():
+    """flush() (the trainers' end-of-epoch call) checks steps the cadence
+    hasn't covered and no-ops when the last check is already current —
+    the mechanism that keeps an epoch shorter than the cadence from
+    going entirely unchecked."""
+    spec = make_mesh(MeshConfig(data=2))
+    s = _sentinel(spec, every=10)
+    tree = _replicated_tree(spec)
+    assert s.flush(lambda: tree) is None and s.checks == 0  # nothing seen
+    assert s.after_sync(4, lambda: tree) is None and s.checks == 0
+    assert s.flush(lambda: tree) is None and s.checks == 1  # tail covered
+    assert s.flush(lambda: tree) is None and s.checks == 1  # already current
+    assert s.after_sync(10, lambda: tree) is None and s.checks == 2
+    assert s.flush(lambda: tree) is None and s.checks == 2  # check just ran
+
+
+# ---------------------------------------------------------------------------
+# end to end through the trainers
+# ---------------------------------------------------------------------------
+
+def test_trainer_bitflip_repaired_with_bitwise_parity(tmp_path):
+    """The acceptance drill: a bitflip injected into one replica at step 1
+    is detected within one sentinel cadence, repaired by re-broadcast, and
+    the final params match an uninjected run bitwise."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    kw = dict(epochs=2, consistency_every=1, max_inflight_steps=1,
+              log_every_n_steps=1)
+    clean = Trainer(tiny_train_config(
+        tmp_path / "clean", recovery=RecoveryConfig(max_retries=1), **kw))
+    clean.fit()
+    t = Trainer(tiny_train_config(
+        tmp_path / "chaos",
+        recovery=RecoveryConfig(max_retries=1, faults=("bitflip@1",)), **kw))
+    hist = t.fit()
+    assert [h["epoch"] for h in hist] == [0, 1]
+    assert [s.kind for s in t.faults.fired] == ["bitflip"]
+    assert t.sentinel.repairs == 1
+    for a, b in zip(jax.tree.leaves(jax.device_get(clean.state.params)),
+                    jax.tree.leaves(jax.device_get(t.state.params))):
+        np.testing.assert_array_equal(a, b)
+    recs = read_records(t.logger.jsonl_path)
+    statuses = [r["status"] for r in recs if r.get("kind") == "consistency"]
+    assert statuses == ["divergence", "repaired"]
+    assert [r["action"] for r in recs if r.get("kind") == "recovery"] == \
+        ["replica-rebroadcast"]
+    from scripts.dmp_report import build_report
+
+    report = build_report(recs)
+    assert "consistency" in report and "replica-rebroadcast" in report
+
+
+def test_trainer_flush_covers_epoch_shorter_than_cadence(tmp_path):
+    """A cadence longer than the whole run must NOT turn a corruption
+    drill into a silent no-op: the end-of-epoch flush checks the tail
+    steps before the good slot is stamped, so the bitflip is still
+    detected and repaired."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    t = Trainer(tiny_train_config(
+        tmp_path, epochs=1, consistency_every=10_000,
+        max_inflight_steps=1, log_every_n_steps=1,
+        recovery=RecoveryConfig(max_retries=1, faults=("bitflip@1",))))
+    hist = t.fit()
+    assert len(hist) == 1
+    assert [s.kind for s in t.faults.fired] == ["bitflip"]
+    assert t.sentinel.checks >= 1 and t.sentinel.repairs == 1
+    recs = read_records(t.logger.jsonl_path)
+    assert [r["status"] for r in recs if r.get("kind") == "consistency"] \
+        == ["divergence", "repaired"]
+
+
+def test_trainer_no_quorum_falls_back_to_good_slot(tmp_path):
+    """2 replicas drift apart (both finite): no quorum -> the supervisor
+    restores the good slot and the run completes."""
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    t = Trainer(tiny_train_config(
+        tmp_path, epochs=2, mesh=MeshConfig(data=2), consistency_every=1,
+        max_inflight_steps=1, log_every_n_steps=1,
+        recovery=RecoveryConfig(max_retries=2, faults=("desync@1",))))
+    hist = t.fit()
+    assert [h["epoch"] for h in hist] == [0, 1]
+    recs = read_records(t.logger.jsonl_path)
+    assert "no-quorum" in [r.get("status") for r in recs
+                           if r.get("kind") == "consistency"]
+    assert [r["error"] for r in recs if r.get("kind") == "failure"] == \
+        ["replica-divergence"]
+    assert [r["action"] for r in recs if r.get("kind") == "recovery"] == \
+        ["restored"]
+
+
+def test_trainer_divergence_without_recovery_raises(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    t = Trainer(tiny_train_config(
+        tmp_path, epochs=1, mesh=MeshConfig(data=2), consistency_every=1,
+        max_inflight_steps=1,
+        recovery=RecoveryConfig(faults=("desync@1",))))
+    with pytest.raises(ReplicaDivergenceError):
+        t.fit()
+
+
+def test_trainer_rejects_sentinel_on_fsdp(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="fsdp"):
+        Trainer(tiny_train_config(tmp_path, strategy="fsdp",
+                                  consistency_every=1))
+
+
+def test_corruption_plan_requires_sentinel(tmp_path):
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="consistency_every"):
+        Trainer(tiny_train_config(
+            tmp_path, recovery=RecoveryConfig(max_retries=1,
+                                              faults=("bitflip@1",))))
+
+
+def test_pipeline_trainer_rejects_corruption_faults(tmp_path):
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    cfg = tiny_train_config(
+        tmp_path, mesh=MeshConfig(stage=2), consistency_every=1,
+        recovery=RecoveryConfig(max_retries=1, faults=("desync@0",)))
+    with pytest.raises(ValueError, match="replica"):
+        PipelineTrainer(cfg)
+
+
+def test_lm_trainer_desync_no_quorum_restores(tmp_path):
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+
+    cfg = LMTrainConfig(
+        model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=1, d_ff=64, max_seq_len=32),
+        mesh=MeshConfig(data=2),
+        batch_size=4, seq_len=16, steps_per_epoch=3, epochs=2,
+        n_tokens=2000, consistency_every=1,
+        recovery=RecoveryConfig(max_retries=1, faults=("desync@1",)),
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"))
+    t = LMTrainer(cfg)
+    hist = t.fit()
+    assert len(hist) == 2
+    recs = read_records(t.logger.jsonl_path)
+    assert "no-quorum" in [r.get("status") for r in recs
+                           if r.get("kind") == "consistency"]
+    assert "restored" in [r.get("action") for r in recs
+                          if r.get("kind") == "recovery"]
+
+
+def test_pipeline_sentinel_finiteness_fingerprint(tmp_path):
+    """Meshless single-controller path: the sentinel's cheap on-device
+    fingerprint catches a poisoned stage (nan_params) without the full
+    host params fetch, and the supervisor restore completes the run."""
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    cfg = tiny_train_config(
+        tmp_path, epochs=1, mesh=MeshConfig(stage=2), consistency_every=1,
+        max_inflight_steps=1,
+        recovery=RecoveryConfig(max_retries=1, faults=("nan_params@0",),
+                                barrier_timeout_s=60.0))
+    t = PipelineTrainer(cfg)
+    # The straggler bound reaches the meshless sentinel too (its local
+    # fingerprint fetch blocks on devices just like the mesh path).
+    assert t.sentinel.barrier_timeout_s == 60.0
+    hist = t.fit()
+    assert len(hist) == 1
+    recs = read_records(t.logger.jsonl_path)
+    assert "non-finite" in [r.get("status") for r in recs
+                            if r.get("kind") == "consistency"]
+    assert "restored" in [r.get("action") for r in recs
+                          if r.get("kind") == "recovery"]
+
+
+# ---------------------------------------------------------------------------
+# straggler barrier
+# ---------------------------------------------------------------------------
+
+def test_barrier_with_timeout_paths():
+    import time
+
+    from distributed_model_parallel_tpu.mesh import (
+        StragglerTimeoutError,
+        barrier_with_timeout,
+    )
+    from distributed_model_parallel_tpu.ops.collectives import mesh_barrier
+
+    spec = make_mesh(MeshConfig(data=4, stage=2))
+    # Fast path: the device barrier completes and reports the world size.
+    assert barrier_with_timeout(lambda: mesh_barrier(spec), 60.0) == 8.0
+    # Straggler path: a wedged rendezvous raises (after the hook fires)
+    # instead of hanging forever.
+    hooks = []
+    with pytest.raises(StragglerTimeoutError, match="straggler"):
+        barrier_with_timeout(lambda: time.sleep(10), 0.1, what="sync",
+                             on_timeout=lambda w, t: hooks.append((w, t)))
+    assert hooks == [("sync", 0.1)]
+    # An exception inside the barrier propagates unchanged.
+    with pytest.raises(KeyError):
+        barrier_with_timeout(lambda: {}["missing"], 5.0)
+
+
+def test_nan_loss_plan_not_excused_by_sentinel():
+    """The sentinel fingerprints params/opt state, never step metrics —
+    so a nan_loss plan still demands the metrics guards even with the
+    sentinel armed (a chaos plan nothing detects is a silent no-op)."""
+    from distributed_model_parallel_tpu.train.resilience import (
+        RecoverySupervisor,
+    )
+
+    with pytest.raises(ValueError, match="nan_loss"):
+        RecoverySupervisor(RecoveryConfig(faults=("nan_loss@0",)),
+                           logger=None, ckpt=None, preemption=None,
+                           check_finite_every=0, consistency_every=1)
+    # nan_params IS visible to the sentinel's finiteness fingerprint.
+    RecoverySupervisor(RecoveryConfig(faults=("nan_params@0",)),
+                       logger=_Logger(), ckpt=None, preemption=None,
+                       check_finite_every=0, consistency_every=1)
+
+
+def test_fetch_bounded_without_watchdog(monkeypatch):
+    """With no stall watchdog armed, barrier_timeout_s bounds the
+    fingerprint fetch itself: a device_get wedged past the budget raises
+    StragglerTimeoutError (after the straggler record) instead of hanging
+    the check forever."""
+    import time
+
+    from distributed_model_parallel_tpu.mesh import StragglerTimeoutError
+
+    spec = make_mesh(MeshConfig(data=2))
+    s = ConsistencySentinel(1, spec, logger=_Logger(),
+                            barrier_timeout_s=0.1)
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: time.sleep(10))
+    with pytest.raises(StragglerTimeoutError):
+        s._fetch(jnp.zeros((2, 1, 3)))
+    assert _recorded(s.logger, "failure") == ["straggler"]
+
+
+def test_local_fingerprint_fetch_bounded(monkeypatch):
+    """The dp=1/pipeline finiteness path blocks on a device fetch too:
+    the straggler bound (and watchdog) must wrap it just like the mesh
+    all_gather fetch — a wedged device raises instead of hanging the
+    check (ConsistencySentinel._guarded_fetch)."""
+    import time
+
+    from distributed_model_parallel_tpu.mesh import StragglerTimeoutError
+
+    s = ConsistencySentinel(1, None, logger=_Logger(),
+                            barrier_timeout_s=0.1)
+    monkeypatch.setattr(s, "_local_fingerprint",
+                        lambda leaves: time.sleep(10))
+    with pytest.raises(StragglerTimeoutError):
+        s.check({"w": jnp.ones((2, 2), jnp.float32)})
+    assert _recorded(s.logger, "failure") == ["straggler"]
+
+
+def test_fetch_straggler_timeout_disarms_watchdog(monkeypatch):
+    """With BOTH protections armed, the watch wraps the caller's bounded
+    wait: a straggler timeout raises THROUGH the watch region, disarming
+    the watchdog — it must not keep logging "still blocked" (or keep
+    escalating) for the abandoned worker thread after the straggler
+    record already reported the incident."""
+    import time
+
+    from distributed_model_parallel_tpu.mesh import StragglerTimeoutError
+    from distributed_model_parallel_tpu.train.guards import GuardRunner
+
+    spec = make_mesh(MeshConfig(data=2))
+    logger = _Logger()
+    guards = GuardRunner(stall_budget_s=0.05, watchdog_interval_s=0.02,
+                         logger=logger)
+    s = ConsistencySentinel(1, spec, logger=logger, guards=guards,
+                            barrier_timeout_s=0.15)
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: time.sleep(10))
+    with pytest.raises(StragglerTimeoutError):
+        s._fetch(jnp.zeros((2, 1, 3)))
+    assert _recorded(s.logger, "failure") == ["straggler"]
+    # The raise exited the watch context -> monitor disarmed; the wedged
+    # daemon worker is unwatched.
+    assert guards.stall._armed_at is None
+    # The caller-side wait DID overrun the stall budget and the watchdog
+    # observed it live (composition, not either/or).
+    assert guards.stall.stalled
+
+
+def test_dmp_chaos_desync_scenario_inprocess(tmp_path, capsys):
+    """The chaos CLI's no-quorum drill end to end: nonzero exit would mean
+    an unrepaired divergence."""
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "dmp_chaos", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "dmp_chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--workdir", str(tmp_path), "--scenario", "desync",
+                   "--epochs", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== resilience" in out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["epochs_completed"] == 2
+    assert "no-quorum" in summary["consistency"]
+    assert "restored" in summary["recoveries"]
+
+
+def test_dmp_chaos_bitflip_rejects_cadence_gt_1(tmp_path, capsys):
+    """Cadence > 1 lets corrupted gradients reach the allreduce before
+    the next check, so the drill's bitwise-parity gate can never pass —
+    reject the flag loudly instead of exiting 1 for a working sentinel."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "dmp_chaos_flags", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "dmp_chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--workdir", str(tmp_path), "--scenario", "bitflip",
+                   "--consistency-every", "3"])
+    assert rc == 2
+    assert "bitwise-parity" in capsys.readouterr().err
+
+
+def test_ddp_assert_replicated_helper(tmp_path):
+    from distributed_model_parallel_tpu.parallel.ddp import (
+        assert_ddp_replicated,
+    )
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    t = Trainer(tiny_train_config(tmp_path, strategy="ddp", epochs=1))
+    assert_ddp_replicated(t.state)         # fresh state: invariant holds

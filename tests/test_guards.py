@@ -38,6 +38,30 @@ def test_assert_replicated_ignores_sharded(mesh8):
     assert_replicated({"x": x})  # sharded arrays are skipped, no raise
 
 
+def _per_device_replicated(mesh8, shards):
+    devs = list(mesh8.mesh.devices.ravel())
+    return jax.make_array_from_single_device_arrays(
+        shards[0].shape,
+        jax.sharding.NamedSharding(mesh8.mesh, jax.sharding.PartitionSpec()),
+        [jax.device_put(s, d) for s, d in zip(shards, devs)])
+
+
+def test_assert_replicated_default_is_bitwise(mesh8):
+    """atol=0 compares BIT PATTERNS (the sentinel's semantics): a
+    sign-bit flip turning -0.0 into +0.0 diverges even though the values
+    compare equal, while replicas all holding the same NaN bytes are
+    identical — a non-finite incident, not a replication one."""
+    n = len(mesh8.mesh.devices.ravel())
+    zeros = [jnp.full((2,), -0.0)] * (n - 1) + [jnp.full((2,), 0.0)]
+    with pytest.raises(ReplicaDivergenceError, match="bit patterns"):
+        assert_replicated({"w": _per_device_replicated(mesh8, zeros)})
+    nans = [jnp.full((2,), jnp.nan)] * n
+    assert_replicated({"w": _per_device_replicated(mesh8, nans)})  # no raise
+    # atol > 0 keeps the value comparison: -0.0 == +0.0 passes.
+    assert_replicated({"w": _per_device_replicated(mesh8, zeros)},
+                      atol=1e-9)
+
+
 def test_check_finite():
     check_finite({"a": jnp.ones(3)})
     with pytest.raises(NonFiniteError):

@@ -64,6 +64,95 @@ def test_psum_transpose_inflates_replicated_cotangent():
             "tests/test_spmd_1f1b.py."))
 
 
+def test_all_gather_rows_follow_axis_index_order():
+    """PINNED SEMANTICS the consistency sentinel relies on
+    (train/consistency.py): ``lax.all_gather(x, axis, tiled=False)``
+    inside ``shard_map(check_vma=False)`` stacks participants' values in
+    AXIS-INDEX order. The sentinel's fingerprint rows are read as
+    "row i = replica i" when it identifies the outlier to repair and the
+    good replica to re-broadcast from (its ``good_idx`` dynamic index,
+    and utils/faults._combined_replica_index's target) — if gather order
+    ever decouples from axis_index, the sentinel would repair FROM a
+    corrupted replica while reporting the wrong one. Fix site:
+    ConsistencySentinel._fingerprint_fn/_repair_fn row indexing."""
+    mesh = _mesh()
+
+    def body(_):
+        mine = jax.lax.axis_index("data").astype(jnp.float32)[None]
+        return jax.lax.all_gather(mine, "data", axis=0, tiled=False)
+
+    out = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                        out_specs=P(), check_vma=False)(
+        jnp.zeros((AXIS_SIZE,)))
+    np.testing.assert_array_equal(
+        np.asarray(out).ravel(), np.arange(AXIS_SIZE, dtype=np.float32),
+        err_msg="PINNED SEMANTICS MOVED: all_gather row order != "
+                "axis_index order; the consistency sentinel's replica "
+                "identification and re-broadcast source are now wrong.")
+
+
+def test_all_gather_rows_follow_combined_index_order_hierarchical():
+    """Same pin as above for the dcn-factored DATA AXIS TUPLE: gathering
+    over ("dcn", "data") must stack rows in the row-major combined index
+    order ``axis_index(dcn) * |data| + axis_index(data)`` — the exact
+    arithmetic of utils/faults._combined_replica_index and the sentinel's
+    replica-row addressing. If multi-axis gather order ever decouples
+    from it, the sentinel on a multi-host (dcn_data > 1) mesh convicts
+    the wrong replica and re-broadcasts FROM the corrupted one. Fix
+    site: ConsistencySentinel._fingerprint_fn/_repair_fn +
+    _combined_replica_index."""
+    from distributed_model_parallel_tpu.mesh import make_mesh as mk
+
+    spec = mk(MeshConfig(data=4, dcn_data=2))
+    axes = ("dcn", "data")
+
+    def body(_):
+        mine = (jax.lax.axis_index("dcn") * jax.lax.psum(1, "data")
+                + jax.lax.axis_index("data")).astype(jnp.float32)[None]
+        return jax.lax.all_gather(mine, axes, axis=0, tiled=False)
+
+    out = jax.shard_map(body, mesh=spec.mesh, in_specs=P(axes),
+                        out_specs=P(), check_vma=False)(jnp.zeros((4,)))
+    np.testing.assert_array_equal(
+        np.asarray(out).ravel(), np.arange(4, dtype=np.float32),
+        err_msg="PINNED SEMANTICS MOVED: tuple-axis all_gather row order "
+                "!= row-major combined axis_index order; the consistency "
+                "sentinel's outlier identification and re-broadcast "
+                "source are wrong on dcn-factored meshes.")
+
+
+def test_claimed_replicated_output_keeps_divergent_shards():
+    """PINNED SEMANTICS the corruption faults and the sentinel's whole
+    detection premise rely on: a ``shard_map(..., out_specs=P(),
+    check_vma=False)`` output whose per-device values DIFFER keeps each
+    device's own buffer — no hidden re-broadcast or canonicalization
+    "fixes" the divergence. This is what lets utils/faults.
+    corrupt_one_replica materialize a lying replica for chaos tests, and
+    what makes a real silently-corrupted buffer observable to the
+    fingerprint at the next check instead of being silently papered over.
+    If this fails after a JAX upgrade, the corruption faults inject
+    nothing and every consistency test passes vacuously — fix site:
+    utils/faults.corrupt_one_replica + train/consistency.py."""
+    mesh = _mesh()
+
+    def body(x):
+        idx = jax.lax.axis_index("data")
+        return jnp.where(idx == AXIS_SIZE - 1, x + 100.0, x)
+
+    y = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                              out_specs=P(), check_vma=False))(
+        jnp.arange(4, dtype=jnp.float32))
+    vals = {}
+    for s in y.addressable_shards:
+        vals[s.device.id] = np.asarray(s.data)[0]
+    diverged = [d for d, v in vals.items() if v != 0.0]
+    assert len(vals) == AXIS_SIZE and len(diverged) == 1, (
+        "PINNED SEMANTICS MOVED: per-device divergence under a "
+        "replicated out_spec no longer survives to the jax.Array "
+        "shards — corrupt_one_replica can no longer simulate SDC and "
+        "the sentinel's detection premise is void.")
+
+
 def test_psum_transpose_sums_device_varying_cotangent():
     mesh = _mesh()
 

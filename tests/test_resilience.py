@@ -228,16 +228,29 @@ def test_pipeline_trainer_nan_recovery(tmp_path):
     assert [r["action"] for r in recoveries] == ["restored"]
 
 
-def test_pipeline_trainer_rejects_lr_shrink(tmp_path):
+def test_pipeline_trainer_lr_shrink_recovers(tmp_path):
+    """recovery.lr_shrink on the single-controller pipeline: the runner
+    rebuilds its optimizer + per-stage jitted programs
+    (PipelineRunner.rebuild_optimizer) instead of rejecting the knob —
+    training recovers from the injected NaN at the halved LR and
+    completes."""
     from distributed_model_parallel_tpu.train.pipeline_trainer import (
         PipelineTrainer,
     )
 
     cfg = tiny_train_config(
-        tmp_path, mesh=MeshConfig(stage=2),
-        recovery=RecoveryConfig(max_retries=1, lr_shrink=0.5))
-    with pytest.raises(ValueError, match="lr_shrink"):
-        PipelineTrainer(cfg)
+        tmp_path, epochs=1, mesh=MeshConfig(stage=2), check_finite_every=1,
+        recovery=RecoveryConfig(max_retries=1, lr_shrink=0.5,
+                                faults=("nan_loss@0",)))
+    t = PipelineTrainer(cfg)
+    lr0 = t.config.optimizer.learning_rate
+    hist = t.fit()
+    assert len(hist) == 1
+    assert t.config.optimizer.learning_rate == pytest.approx(lr0 * 0.5)
+    assert t.resilience.lr_scale == pytest.approx(0.5)
+    failures, recoveries = _events(t)
+    assert [f["error"] for f in failures] == ["non-finite"]
+    assert [r["action"] for r in recoveries] == ["restored"]
 
 
 # ---------------------------------------------------------------------------
