@@ -207,6 +207,21 @@ class TrainConfig:
     log_name: str = "train"
     checkpoint_dir: str = "./checkpoint"
     resume: bool = False                    # reference data_parallel.py:21-22,80-87
+    # Elastic resume (train/elastic.py): step-cadence "emergency" checkpoint
+    # slot carrying the full resume state — train state, loader position
+    # (epoch + batch cursor), global step, recovery budgets — so a
+    # preempted run continues at the exact step instead of replaying the
+    # epoch. The preemption save writes the same tree. 0 = only preemption/
+    # epoch-boundary saves; N > 0 also saves every N steps. The slot is
+    # distinct from the per-epoch best/good slots and exempt from their
+    # keep-K rotation (per-slot retention, train/checkpoint.py).
+    emergency_every: int = 0
+    # On startup, shrink the mesh's data axis to the largest degree the
+    # live device count and batch size allow (a preempted TPU job often
+    # comes back on a degraded slice); resume then reshards the checkpoint
+    # onto the new mesh (Checkpointer.restore_resharded). Non-data axes
+    # never shrink — too few devices for them is still an error.
+    elastic: bool = False
     # Asynchronous checkpointing: persist on a background thread so the next
     # epoch doesn't stall behind filesystem writes; fit() drains at the end.
     async_checkpoint: bool = False

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterable, Iterator
+from typing import Iterable, Iterator, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,22 @@ class BatchLoader:
 
     ``use_native=True`` assembles batches with the C++ row-gather
     (data/native.py); falls back to numpy fancy indexing transparently.
+
+    Shuffle order is **stateless**: epoch ``e``'s permutation is derived
+    from ``default_rng((seed, e))``, never from a consumed rng stream —
+    epoch N's batch order is identical whether or not epochs 0..N-1 were
+    ever iterated. That makes the loader's position a two-integer resume
+    state (``state_dict``/``load_state_dict``: epoch + batch cursor), the
+    property elastic resume (train/elastic.py) is built on: a run killed
+    mid-epoch restarts at the exact next batch with nothing replayed or
+    skipped.
+
+    Position protocol: iteration itself never moves the persistent cursor
+    (with a PrefetchLoader in front, the producer runs ahead of what the
+    trainer actually consumed) except at clean exhaustion, which advances
+    to the next epoch. The epoch drivers call :meth:`set_epoch` at epoch
+    start and :meth:`position` after each *consumed* batch, so the cursor
+    always reflects training progress, not prefetch progress.
     """
 
     def __init__(self, ds: ArrayDataset, batch_size: int, *,
@@ -45,7 +61,9 @@ class BatchLoader:
         self.drop_last = drop_last
         self.use_native = use_native
         self.num_workers = num_workers
-        self._rng = np.random.default_rng(seed)
+        self.seed = seed
+        self._epoch = 0
+        self._cursor = 0          # batches of self._epoch already consumed
         # Multi-process feeding: every process draws the *same* global batch
         # order (the rng seed is config-fixed, so permutations agree), but
         # materializes only its contiguous slice of each batch — the local
@@ -63,12 +81,60 @@ class BatchLoader:
         n = len(self.ds)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
-    def epoch_indices(self) -> np.ndarray:
-        """The (possibly shuffled) sample order for the next epoch. Shared
-        by the materializing iterator below and the device-resident fast
-        path (train/trainer.py), so both see identical batch composition."""
+    # -- resume position ----------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def cursor(self) -> int:
+        return self._cursor
+
+    def set_epoch(self, epoch: int) -> None:
+        """Position at the start of ``epoch`` — unless already positioned
+        *inside* that epoch (a mid-epoch ``load_state_dict``), in which
+        case the loaded cursor is preserved. Epoch drivers call this at
+        the top of every training epoch."""
+        if epoch != self._epoch:
+            self._epoch, self._cursor = int(epoch), 0
+
+    def position(self, epoch: int, batch_cursor: int) -> None:
+        """Authoritative position update from the consumer: ``batch_cursor``
+        batches of ``epoch`` have been consumed. Called by the epoch
+        drivers after each dispatched step — the iterator cannot track this
+        itself because a PrefetchLoader produces ahead of consumption."""
+        self._epoch, self._cursor = int(epoch), int(batch_cursor)
+
+    def state_dict(self) -> dict:
+        """Resume state. A fully-consumed epoch is normalized to the start
+        of the next one, so "end of epoch e" and "start of epoch e+1" are
+        the same position."""
+        ep, cur = self._epoch, self._cursor
+        if cur >= len(self):
+            ep, cur = ep + 1, 0
+        return {"epoch": int(ep), "batch_cursor": int(cur)}
+
+    def load_state_dict(self, state: Mapping) -> None:
+        ep, cur = int(state["epoch"]), int(state["batch_cursor"])
+        if ep < 0 or cur < 0 or cur > len(self):
+            raise ValueError(
+                f"invalid loader state epoch={ep} batch_cursor={cur} "
+                f"(epoch has {len(self)} batches)")
+        if cur >= len(self):
+            ep, cur = ep + 1, 0
+        self._epoch, self._cursor = ep, cur
+
+    def epoch_indices(self, epoch: int | None = None) -> np.ndarray:
+        """The (possibly shuffled) sample order for ``epoch`` (default: the
+        current position's epoch). Shared by the materializing iterator
+        below and the device-resident fast path (train/trainer.py), so both
+        see identical batch composition. Stateless: derived from
+        ``(seed, epoch)`` only."""
         n = len(self.ds)
-        return self._rng.permutation(n) if self.shuffle else np.arange(n)
+        if not self.shuffle:
+            return np.arange(n)
+        e = self._epoch if epoch is None else int(epoch)
+        return np.random.default_rng((self.seed, e)).permutation(n)
 
     def _local_slice(self, sel: np.ndarray) -> np.ndarray:
         """This process's contiguous rows of one global batch's indices."""
@@ -88,33 +154,55 @@ class BatchLoader:
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         n = len(self.ds)
-        idx = self.epoch_indices()
+        epoch, start = self._epoch, self._cursor
+        idx = self.epoch_indices(epoch)
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
         # The native row-gather operates on materialized arrays; for a lazy
         # (file-backed) dataset, fancy indexing IS the batch decode
         # (LazyImageArray thread pool), so use_native does not apply.
         if self.use_native and not getattr(self.ds, "is_lazy", False):
             from distributed_model_parallel_tpu.data import native
-            for lo in range(0, stop, self.batch_size):
+            for lo in range(start * self.batch_size, stop, self.batch_size):
                 sel = self._local_slice(idx[lo:lo + self.batch_size])
                 yield (native.gather_rows(self.ds.images, sel,
                                           n_threads=self.num_workers),
                        self.ds.labels[sel])
         else:
-            for lo in range(0, stop, self.batch_size):
+            for lo in range(start * self.batch_size, stop, self.batch_size):
                 sel = self._local_slice(idx[lo:lo + self.batch_size])
                 yield self.ds.images[sel], self.ds.labels[sel]
+        # Clean exhaustion: advance to the next epoch, so a plain
+        # for-each-epoch consumer (benchmarks) reshuffles per epoch without
+        # calling set_epoch. Abandoned iterations never reach this line —
+        # the consumer's position() calls stay authoritative.
+        if epoch == self._epoch and start == self._cursor:
+            self._epoch, self._cursor = epoch + 1, 0
 
 
 class PrefetchLoader:
     """Background-thread prefetch over any batch iterable — the capability of
     the reference's ``num_workers``/pinned-memory DataLoader settings
     (``data_parallel.py:44-51``) in single-controller form: batch k+1 is
-    assembled on a host thread while the accelerator runs batch k."""
+    assembled on a host thread while the accelerator runs batch k.
 
-    def __init__(self, loader: Iterable, depth: int = 2):
+    Shutdown/failure contract (the preemption path depends on it):
+
+    * a consumer that **abandons** iteration mid-epoch (preemption break,
+      exception in the train step) signals the worker immediately and waits
+      only ``join_timeout_s`` for it — a worker wedged inside the underlying
+      loader (slow disk, dead NFS) is left behind as a daemon instead of
+      hanging the trainer's graceful checkpoint-and-exit;
+    * a worker **exception** propagates to the consumer (after any batches
+      already buffered), and a worker that dies without managing to enqueue
+      its sentinel is detected by liveness-checking ``get`` — the consumer
+      raises instead of blocking forever.
+    """
+
+    def __init__(self, loader: Iterable, depth: int = 2, *,
+                 join_timeout_s: float = 5.0):
         self.loader = loader
         self.depth = depth
+        self.join_timeout_s = join_timeout_s
 
     def __len__(self):
         return len(self.loader)
@@ -138,26 +226,63 @@ class PrefetchLoader:
             return False
 
         def worker():
+            it = iter(self.loader)
             try:
-                for item in self.loader:
+                for item in it:
                     if not put(item):
                         return
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
+                # Propagate the abandon to the SOURCE: a generator-backed
+                # loader gets its close()/GeneratorExit now (releasing file
+                # handles, decode pools), not at some later GC.
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:   # noqa: BLE001 - already shutting down
+                        pass
                 put(sentinel)
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(target=worker, daemon=True,
+                             name="dmp-prefetch")
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=0.5)
+                except queue.Empty:
+                    # Liveness check: a worker that died without enqueueing
+                    # its sentinel (killed thread, interpreter teardown)
+                    # must not leave the consumer blocked forever. The
+                    # worker may also have enqueued its final item/sentinel
+                    # and exited BETWEEN our timeout and this check — drain
+                    # before declaring it dead (TOCTOU).
+                    if not t.is_alive():
+                        try:
+                            item = q.get_nowait()
+                        except queue.Empty:
+                            if err:
+                                raise err[0]
+                            raise RuntimeError(
+                                "prefetch worker died without a result "
+                                "or sentinel") from None
+                        if item is sentinel:
+                            break
+                        yield item
+                    continue
                 if item is sentinel:
                     break
                 yield item
         finally:
             stop.set()
-            t.join()
+            # Bounded join: the worker observes `stop` within one put poll
+            # (~0.1s) unless it is wedged inside the underlying loader
+            # itself — in that case it stays behind as a daemon thread
+            # rather than blocking the consumer's exit path (the preemption
+            # checkpoint must not wait on a dead disk).
+            t.join(self.join_timeout_s)
             if err:
                 raise err[0]
 

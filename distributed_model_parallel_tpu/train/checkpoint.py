@@ -29,14 +29,42 @@ from distributed_model_parallel_tpu.utils.faults import (
 
 # Per-checkpoint integrity manifest, written into each version directory
 # once its save has committed: relative path -> {size, crc32} for every
-# file. A torn/truncated/partially-copied version fails verification and
-# ``restore(..., allow_fallback=True)`` skips it. Absence of a manifest is
-# "unverifiable" (legacy / foreign checkpoint), not "bad".
+# file, plus an optional ``meta`` stamp (saving mesh shape/axis names,
+# global step — the topology record elastic resume reads,
+# train/elastic.py). A torn/truncated/partially-copied version fails
+# verification and ``restore(..., allow_fallback=True)`` skips it. Absence
+# of a manifest is "unverifiable" (legacy / foreign checkpoint), not "bad".
 MANIFEST_FILENAME = "dmp_manifest.json"
 
 
 class CheckpointIntegrityError(RuntimeError):
     """No committed checkpoint version survived verification/restore."""
+
+
+class TopologyMismatchError(RuntimeError):
+    """A checkpoint's *global* array shapes conflict with the restore
+    target's — state that genuinely depends on the saving topology (e.g.
+    the DDP engine's per-replica BatchNorm stats carry a leading
+    ``num_replicas`` axis) cannot be resharded onto a mesh of a different
+    degree. Carries both shapes per conflicting leaf; deliberately NOT a
+    ``ValueError`` so the trainers' template-layout retry loops don't
+    misread it as an EMA-layout mismatch."""
+
+    def __init__(self, conflicts: list, *, saved_mesh=None,
+                 current_mesh=None):
+        self.conflicts = list(conflicts)
+        self.saved_mesh = saved_mesh
+        self.current_mesh = current_mesh
+        detail = "; ".join(
+            f"{path}: checkpoint {tuple(saved)} vs target {tuple(want)}"
+            for path, saved, want in self.conflicts[:8])
+        mesh = ""
+        if saved_mesh or current_mesh:
+            mesh = (f" (saved on mesh {saved_mesh}, restoring on "
+                    f"{current_mesh})")
+        super().__init__(
+            f"checkpoint global shapes conflict with the restore target on "
+            f"{len(self.conflicts)} leaves{mesh}: {detail}")
 
 
 def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
@@ -49,9 +77,11 @@ def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
             crc = zlib.crc32(block, crc)
 
 
-def write_manifest(path: str) -> str:
+def write_manifest(path: str, meta: dict | None = None) -> str:
     """Write the integrity manifest for a committed checkpoint directory
-    (atomic: temp file + rename). Returns the manifest path."""
+    (atomic: temp file + rename). ``meta`` is the caller's stamp (mesh
+    shape/axis names, global step); it is recorded verbatim and never
+    participates in verification. Returns the manifest path."""
     entries: dict[str, dict] = {}
     for root, _dirs, files in os.walk(path):
         for fn in files:
@@ -64,8 +94,47 @@ def write_manifest(path: str) -> str:
     out = os.path.join(path, MANIFEST_FILENAME)
     tmp = out + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"created": time.time(), "files": entries}, f)
+        json.dump({"created": time.time(), "files": entries,
+                   "meta": dict(meta or {})}, f)
     os.replace(tmp, out)
+    return out
+
+
+def read_manifest_meta(path: str) -> dict:
+    """The ``meta`` stamp of one checkpoint version directory; ``{}`` when
+    there is no manifest or no stamp (legacy/foreign checkpoint)."""
+    try:
+        with open(os.path.join(path, MANIFEST_FILENAME)) as f:
+            return dict(json.load(f).get("meta") or {})
+    except (OSError, json.JSONDecodeError, ValueError, TypeError):
+        return {}
+
+
+def _keystr(path) -> str:
+    """Normalize a jax keypath so a flax-struct attribute, a dict key and a
+    tuple index spell the same as orbax's metadata dict-tree paths."""
+    parts = []
+    for k in path:
+        if hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:                    # pragma: no cover - future key types
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tree_shape_map(tree: Any) -> dict[str, tuple]:
+    """``normalized path -> global shape`` for every leaf that has one."""
+    import jax.tree_util as jtu
+
+    out = {}
+    for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            out[_keystr(path)] = tuple(shape)
     return out
 
 
@@ -128,15 +197,26 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str, *, keep: int = 2,
-                 injector: FaultInjector | None = None):
+                 injector: FaultInjector | None = None,
+                 meta_fn: Callable[[], dict] | None = None):
         self.directory = os.path.abspath(directory)
         self.keep = max(1, int(keep))
         os.makedirs(self.directory, exist_ok=True)
         self._ckpt = ocp.StandardCheckpointer()
         self._injector = injector
-        # Version paths whose manifest still needs writing once the
+        # Stamp every committed version's manifest with this callable's
+        # dict (mesh shape, global step — captured at save() call time,
+        # not at async commit time): the topology record
+        # restore_resharded / train/elastic.py read back.
+        self.meta_fn = meta_fn
+        # (path, meta) pairs whose manifest still needs writing once the
         # (possibly asynchronous) save commits.
-        self._pending_manifest: list[str] = []
+        self._pending_manifest: list[tuple[str, dict]] = []
+        # Version directory the last restore_resharded actually read —
+        # may be an OLDER version than the slot's newest after a
+        # torn-newest fallback, so provenance (read_manifest_meta) must
+        # come from here, not from manifest_meta(name).
+        self.last_restored_path: str | None = None
 
     def _path(self, name: str, version: int | None = None) -> str:
         leaf = name if version is None else f"{name}-{version}"
@@ -171,11 +251,18 @@ class Checkpointer:
         return out
 
     def save(self, tree: Any, name: str = "ckpt", *, force: bool = True,
-             wait: bool = True) -> str:
+             wait: bool = True, keep: int | None = None,
+             meta: dict | None = None) -> str:
         del force  # kept for API compatibility; versioning never overwrites
         self.wait_until_finished()  # the previous save has committed...
         versions = self._versions(name)
-        for v in versions[:-self.keep]:   # ...keep the newest K, prune older
+        # Retention is strictly per-slot: the version scan matches
+        # ``{name}-{v}`` exactly, so rotating one slot (the per-epoch
+        # "ckpt"/"good" saves) can never garbage-collect another (the
+        # emergency slot) — tests/test_elastic.py pins this. ``keep``
+        # overrides the default for this slot's own rotation.
+        keep_n = max(1, int(keep)) if keep is not None else self.keep
+        for v in versions[:-keep_n]:      # ...keep the newest K, prune older
             shutil.rmtree(self._path(name, v), ignore_errors=True)
         if versions and os.path.exists(self._path(name)):
             # A versioned save has committed, so a bare legacy `{name}` dir
@@ -194,7 +281,10 @@ class Checkpointer:
             raise InjectedFaultError(f"injected save failure for {path}")
         tear = any(s.kind == "tear_save" for s in faults)
         self._ckpt.save(path, tree)
-        self._pending_manifest.append(path)
+        stamp = dict(self.meta_fn() or {}) if self.meta_fn is not None else {}
+        if meta:
+            stamp.update(meta)
+        self._pending_manifest.append((path, stamp))
         if wait or tear:
             self.wait_until_finished()
         if tear:
@@ -206,9 +296,16 @@ class Checkpointer:
         write the integrity manifests for the newly committed versions."""
         self._ckpt.wait_until_finished()
         while self._pending_manifest:
-            path = self._pending_manifest.pop()
+            path, stamp = self._pending_manifest.pop()
             if os.path.isdir(path):
-                write_manifest(path)
+                write_manifest(path, meta=stamp)
+
+    def manifest_meta(self, name: str = "ckpt") -> dict:
+        """The newest committed version's manifest ``meta`` stamp (saving
+        mesh, global step); ``{}`` when absent."""
+        self.wait_until_finished()
+        path = self._latest_path(name)
+        return read_manifest_meta(path) if path is not None else {}
 
     def restore(self, target: Any, name: str = "ckpt", *,
                 allow_fallback: bool = False,
@@ -251,6 +348,101 @@ class Checkpointer:
             try:
                 return self._ckpt.restore(path, abstract)
             except Exception as e:  # noqa: BLE001 - fall back on any failure
+                detail = f"restore failed: {type(e).__name__}: {e}"
+                rejected.append((path, detail))
+                if on_fallback is not None:
+                    on_fallback(path, detail)
+        raise CheckpointIntegrityError(
+            f"no restorable version of {name!r} in {self.directory}: "
+            + "; ".join(f"{os.path.basename(p)} ({r[:160]})"
+                        for p, r in rejected))
+
+    def _check_topology(self, path: str, target: Any) -> None:
+        """Raise :class:`TopologyMismatchError` when the checkpoint's
+        *global* leaf shapes conflict with ``target``'s. Global shapes are
+        mesh-independent for replicated/DDP/FSDP leaves (sharding splits a
+        fixed global array), so a conflict means the state itself encodes
+        the saving topology and cannot be resharded. Structure differences
+        (missing/extra leaves) are left for the restore itself to report —
+        they are template-layout problems, not topology ones. A metadata
+        read failure is ignored here: the restore attempt will surface it
+        through the normal fallback machinery."""
+        try:
+            meta = ocp.PyTreeCheckpointer().metadata(path)
+            saved = tree_shape_map(meta)
+        except Exception:  # noqa: BLE001 - torn version, fallback handles it
+            return
+        want = tree_shape_map(target)
+        conflicts = [(k, saved[k], want[k]) for k in sorted(want)
+                     if k in saved and tuple(saved[k]) != tuple(want[k])]
+        if conflicts:
+            raise TopologyMismatchError(
+                conflicts, saved_mesh=read_manifest_meta(path).get("mesh"))
+
+    def restore_resharded(self, target: Any, name: str = "ckpt", *,
+                          allow_fallback: bool = True,
+                          on_fallback: Callable[[str, str], None] | None = None,
+                          verify_memo: dict | None = None) -> Any:
+        """Topology-change-resilient restore: bring the newest committed
+        version into the shardings of ``target`` — the *current* mesh's —
+        regardless of the mesh it was saved under (a dp=8 checkpoint
+        restores onto the degraded dp=4 slice a preempted TPU job got
+        back). Mechanically: explicit per-leaf restore args carrying the
+        target's shardings, so orbax never consults the sharding file
+        written at save time (whose devices need not exist anymore).
+
+        Global shapes must agree leaf-by-leaf; a genuine conflict (state
+        that encodes the saving topology, e.g. DDP per-replica BN stats)
+        raises :class:`TopologyMismatchError` with both shapes — and raises
+        it *through* the fallback loop, because every version of the same
+        run shares the conflict. Torn versions fall back exactly like
+        :meth:`restore`.
+
+        ``verify_memo`` caches per-path manifest verification (a full-file
+        CRC sweep) across calls: elastic resume tries several template
+        layouts against the same slot and must not re-read a multi-GB
+        checkpoint directory once per layout (train/elastic.py).
+        """
+        self.wait_until_finished()
+        candidates = self._candidate_paths(name)
+        if not candidates:
+            raise FileNotFoundError(self._path(name))
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+
+        def _verify(path):
+            if verify_memo is None:
+                return verify_manifest(path)
+            if path not in verify_memo:
+                verify_memo[path] = verify_manifest(path)
+            return verify_memo[path]
+
+        def _restore(path):
+            out = ocp.PyTreeCheckpointer().restore(
+                path, args=ocp.args.PyTreeRestore(item=abstract,
+                                                  restore_args=restore_args))
+            self.last_restored_path = path
+            return out
+
+        if not allow_fallback:
+            self._check_topology(candidates[0], target)
+            return _restore(candidates[0])
+        rejected: list[tuple[str, str]] = []
+        for path in candidates:
+            reason = _verify(path)
+            if reason is not None and reason != "missing":
+                rejected.append((path, reason))
+                if on_fallback is not None:
+                    on_fallback(path, reason)
+                continue
+            self._check_topology(path, target)
+            if reason is None:
+                # Verified intact: a restore failure here is structural
+                # (wrong config/template), not corruption — fail fast.
+                return _restore(path)
+            try:
+                return _restore(path)
+            except Exception as e:  # noqa: BLE001 - unverifiable version
                 detail = f"restore failed: {type(e).__name__}: {e}"
                 rejected.append((path, detail))
                 if on_fallback is not None:
@@ -306,17 +498,20 @@ class Checkpointer:
         self.wait_until_finished()
         return self._latest_path(name) is not None
 
-    def newest_name(self, names: tuple[str, ...]) -> str | None:
-        """The name whose latest committed version is most recent on disk
-        (by mtime) — used to resume from the newer of the best-accuracy and
-        preemption checkpoint slots. None if none exist."""
+    def names_by_recency(self, names: tuple[str, ...]) -> list[str]:
+        """The subset of ``names`` with a committed version on disk,
+        ordered newest-first by the latest version's mtime — the slot
+        preference order elastic resume walks (train/elastic.py)."""
         self.wait_until_finished()
-        best: tuple[float, str] | None = None
+        stamped = []
         for name in names:
             path = self._latest_path(name)
-            if path is None:
-                continue
-            mtime = os.path.getmtime(path)
-            if best is None or mtime > best[0]:
-                best = (mtime, name)
-        return best[1] if best else None
+            if path is not None:
+                stamped.append((os.path.getmtime(path), name))
+        return [name for _, name in sorted(stamped, reverse=True)]
+
+    def newest_name(self, names: tuple[str, ...]) -> str | None:
+        """The name whose latest committed version is most recent on disk
+        (by mtime); None if none exist."""
+        ordered = self.names_by_recency(names)
+        return ordered[0] if ordered else None

@@ -1,0 +1,269 @@
+"""Elastic restart supervisor: topology-change-resilient resume.
+
+Production TPU pods get maintenance-preempted constantly, and the slice a
+job gets back is frequently *smaller* than the one it lost. Before this
+module, a restart had three gaps: restore was epoch-granular (a mid-epoch
+kill replayed the whole epoch), the replayed epoch saw a *different* data
+order (the loader's rng was consumed statefully), and a checkpoint saved
+on a dp=8 mesh could not restore onto a dp=4 slice at all. This module +
+its collaborators close all three:
+
+* **exact mid-epoch resume** — ``BatchLoader.state_dict`` (epoch + batch
+  cursor over a stateless ``(seed, epoch)`` permutation, data/loader.py),
+  a per-step rng derived from the global step, and the step-cadence
+  **emergency checkpoint slot** (:class:`EmergencyCheckpointer`,
+  ``TrainConfig.emergency_every``) that ``checkpoint_on_preempt`` also
+  writes — so "kill -TERM mid-epoch, restart, converge identically" is a
+  tested property (tests/test_elastic.py, ``dmp_chaos.py preempt``);
+* **topology-change-resilient restore** — every save stamps the saving
+  mesh + global step into the integrity manifest, and
+  ``Checkpointer.restore_resharded`` restores any checkpoint into the
+  *current* mesh's shardings (replicated, DDP, FSDP/ZeRO leaves), raising
+  a typed ``TopologyMismatchError`` when global shapes genuinely conflict
+  (train/checkpoint.py);
+* **restart supervision** — :func:`fit_mesh_to_devices` rebuilds the mesh
+  at the largest compatible dp degree for the live device count
+  (``TrainConfig.elastic``), and :func:`elastic_restore` picks the
+  newest-valid of the {emergency, preempt, epoch} slots — walking past
+  torn versions via the integrity-manifest fallback, and past a fully
+  torn slot to the next-newest one — then the trainers resume at the
+  exact global step.
+
+Every resume emits a typed telemetry ``resume`` record
+(utils/telemetry.py) that ``scripts/dmp_report.py`` renders on the
+resilience timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+from distributed_model_parallel_tpu.config import MeshConfig
+from distributed_model_parallel_tpu.train.checkpoint import (
+    Checkpointer,
+    CheckpointIntegrityError,
+    TopologyMismatchError,
+)
+
+__all__ = [
+    "ElasticDecision",
+    "EmergencyCheckpointer",
+    "TopologyMismatchError",
+    "elastic_restore",
+    "fit_mesh_to_devices",
+]
+
+
+def build_resume_tree(epoch: int, cursor: int, epoch_len: int,
+                      global_step: int, budgets: dict) -> dict:
+    """The exact-continuation subtree every trainer checkpoint carries:
+    normalized loader position (a fully-consumed epoch is the start of the
+    next one), global step, and the supervisor's live budgets. One schema,
+    one place — the trainers differ only in where the position lives."""
+    import jax.numpy as jnp
+
+    ep, cur = int(epoch), int(cursor)
+    if cur >= epoch_len:
+        ep, cur = ep + 1, 0
+    return {"loader_epoch": jnp.asarray(ep, jnp.int32),
+            "batch_cursor": jnp.asarray(cur, jnp.int32),
+            "global_step": jnp.asarray(global_step, jnp.int32),
+            "retries_left": jnp.asarray(budgets["retries_left"], jnp.int32),
+            "lr_scale": jnp.asarray(budgets["lr_scale"], jnp.float32)}
+
+
+def unpack_resume_tree(ri: dict) -> tuple[int, int, int, int, float]:
+    """``(epoch, cursor, global_step, retries_left, lr_scale)`` from a
+    restored resume subtree."""
+    return (int(ri["loader_epoch"]), int(ri["batch_cursor"]),
+            int(ri["global_step"]), int(ri["retries_left"]),
+            float(ri["lr_scale"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticDecision:
+    """What :func:`fit_mesh_to_devices` decided and why — logged by the
+    trainers so a degraded restart is visible, never silent."""
+
+    n_devices: int
+    requested: dict           # requested axis sizes (+ dcn_data)
+    resolved: dict
+    changed: bool
+
+    def describe(self) -> str:
+        if not self.changed:
+            return (f"elastic: mesh {self.resolved} fits the "
+                    f"{self.n_devices} live devices unchanged")
+        return (f"elastic: rebuilt mesh for {self.n_devices} live devices: "
+                f"{self.requested} -> {self.resolved}")
+
+
+def fit_mesh_to_devices(mesh: MeshConfig, n_devices: int, *,
+                        batch_size: int | None = None
+                        ) -> tuple[MeshConfig, ElasticDecision]:
+    """Shrink the data axis to the largest degree the live device count
+    (and global batch divisibility) supports.
+
+    Only data parallelism is elastic: dp replicas are interchangeable, so
+    shedding some changes throughput, not math. The other axes partition
+    the *model* — a pipeline that lost a stage's devices cannot run at
+    all, so too few devices for ``stage*model*seq*expert`` raises instead
+    of silently training a different model. The dcn factor is kept when it
+    still divides the resolved degree and dropped to 1 otherwise (the
+    degraded slice's host layout is unknown).
+    """
+    if n_devices < 1:
+        raise ValueError(f"need at least one live device, got {n_devices}")
+    other = mesh.stage * mesh.model * mesh.seq * mesh.expert
+    if other > n_devices:
+        raise ValueError(
+            f"non-data mesh axes need {other} devices "
+            f"(stage={mesh.stage}, model={mesh.model}, seq={mesh.seq}, "
+            f"expert={mesh.expert}) but only {n_devices} are live — "
+            f"model-partitioning axes are not elastic")
+    dp = min(mesh.data, n_devices // other)
+    while dp > 1 and batch_size is not None and batch_size % dp:
+        dp -= 1
+    dcn = mesh.dcn_data if mesh.dcn_data > 1 and dp % mesh.dcn_data == 0 \
+        else 1
+    resolved = dataclasses.replace(mesh, data=dp, dcn_data=dcn)
+    requested_sizes = {**mesh.axis_sizes(), "dcn_data": mesh.dcn_data}
+    resolved_sizes = {**resolved.axis_sizes(), "dcn_data": resolved.dcn_data}
+    return resolved, ElasticDecision(
+        n_devices=n_devices, requested=requested_sizes,
+        resolved=resolved_sizes, changed=resolved_sizes != requested_sizes)
+
+
+def elastic_restore(ckpt: Checkpointer, templates: Sequence[Any],
+                    names: Sequence[str], *,
+                    on_fallback: Callable[[str, str], None] | None = None
+                    ) -> tuple[str, Any]:
+    """Newest-valid-slot restore: walk ``names`` newest-first (by latest
+    committed version mtime), trying each template layout against each
+    slot via ``restore_resharded``.
+
+    Fallback ladder, from cheapest to last-resort:
+
+    1. a torn *version* of a slot → previous committed version (the PR 2
+       integrity-manifest fallback inside ``restore_resharded``);
+    2. a slot where EVERY template layout hit ``CheckpointIntegrityError``
+       → the next-newest slot (an intact epoch checkpoint beats a torn
+       emergency save). Every template must get its try first: on a
+       manifest-less version (pre-manifest checkpoint, bare legacy dir,
+       async save killed before its manifest) a template mismatch is
+       indistinguishable from a tear, so giving up on the slot after
+       template 1 would skip the very legacy layouts templates 2..N exist
+       for;
+    3. a structural mismatch against every template on the newest valid
+       slot → raise (resuming under the wrong config must not silently
+       fall back to a *stale* slot that happens to match);
+    4. ``TopologyMismatchError`` propagates immediately — every version
+       and slot of the same run shares the conflict.
+
+    Manifest verification (full-file CRC sweeps) is memoized across
+    template attempts, and ``on_fallback`` fires once per rejected
+    version, not once per template.
+
+    Returns ``(slot_name, restored_tree)``; ``FileNotFoundError`` when no
+    slot exists at all.
+    """
+    ordered = ckpt.names_by_recency(tuple(names))
+    if not ordered:
+        raise FileNotFoundError(
+            f"no checkpoint under any of {tuple(names)} in {ckpt.directory}")
+    verify_memo: dict = {}
+    seen_fallbacks: set[str] = set()
+
+    def _on_fallback(path: str, reason: str) -> None:
+        if path in seen_fallbacks:
+            return                  # same version, next template attempt
+        seen_fallbacks.add(path)
+        if on_fallback is not None:
+            on_fallback(path, reason)
+
+    slot_errors: list[tuple[str, BaseException]] = []
+    for name in ordered:
+        last: BaseException | None = None
+        integrity: BaseException | None = None
+        for tmpl in templates:
+            try:
+                return name, ckpt.restore_resharded(
+                    tmpl, name, allow_fallback=True,
+                    on_fallback=_on_fallback, verify_memo=verify_memo)
+            except TopologyMismatchError:
+                raise
+            except CheckpointIntegrityError as e:
+                integrity = e       # torn — or a template mismatch on an
+                continue            # unverifiable version; try them all
+            except (ValueError, KeyError, TypeError) as e:
+                last = e            # layout mismatch — try the next template
+        if last is not None:
+            # The newest valid slot exists but matches no template: that is
+            # a configuration error, not corruption — do NOT fall back to
+            # an older slot (silently resuming stale state is worse).
+            raise ValueError(
+                f"checkpoint slot {name!r} does not match any of the "
+                f"{len(templates)} resume template layouts — resuming "
+                f"requires the same model and optimizer as the saving run"
+            ) from last
+        slot_errors.append((name, integrity))
+    raise CheckpointIntegrityError(
+        "no restorable slot among "
+        + ", ".join(f"{n} ({type(e).__name__})" for n, e in slot_errors))
+
+
+class EmergencyCheckpointer:
+    """Step-cadence writer for the emergency checkpoint slot.
+
+    Counts consumed steps across drains and, every ``every`` steps, saves
+    the trainer's full resume tree (train state + loader position + global
+    step + recovery budgets) under the dedicated slot. The slot keeps its
+    own two newest committed versions (torn-newest fallback) and is never
+    touched by other slots' keep-K rotation. Disabled (``every=0``) it
+    costs one integer compare per call.
+    """
+
+    def __init__(self, ckpt: Checkpointer, slot: str, every: int, *,
+                 logger=None, wait: bool = True, keep: int = 2):
+        if every < 0:
+            raise ValueError(f"emergency_every must be >= 0, got {every}")
+        self.ckpt = ckpt
+        self.slot = slot
+        self.every = int(every)
+        self.logger = logger
+        self.wait = wait
+        self.keep = keep
+        self.saves = 0
+        self._since = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.every > 0
+
+    def after_step(self, n_steps: int, tree_fn: Callable[[], Any]) -> bool:
+        """Advance by ``n_steps`` consumed steps; save when the cadence
+        elapses. Returns whether a save was written."""
+        if not self.enabled or n_steps <= 0:
+            return False
+        self._since += int(n_steps)
+        if self._since < self.every:
+            return False
+        # Carry the overshoot (multi-step dispatches land past the cadence
+        # boundary) so the average interval stays `every`, modulo so a
+        # single K > every dispatch doesn't queue up back-to-back saves of
+        # the same state.
+        self._since %= self.every
+        self.ckpt.save(tree_fn(), self.slot, wait=self.wait, keep=self.keep)
+        self.saves += 1
+        if self.logger is not None:
+            from distributed_model_parallel_tpu.utils.telemetry import (
+                registry,
+            )
+
+            registry().counter("emergency_saves").inc()
+            self.logger.telemetry.record("event",
+                                         message=f"emergency checkpoint "
+                                                 f"#{self.saves} -> "
+                                                 f"{self.slot}")
+        return True

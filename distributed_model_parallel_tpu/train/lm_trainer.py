@@ -89,6 +89,13 @@ class LMTrainConfig:
     log_name: str = "lm"
     checkpoint_dir: str = "./checkpoint"
     resume: bool = False
+    # Elastic resume — same semantics as TrainConfig.emergency_every /
+    # TrainConfig.elastic (train/elastic.py): a step-cadence emergency
+    # checkpoint slot carrying the exact continuation state (step cursor,
+    # global step, recovery budgets), and startup mesh refit to the live
+    # device count with resharded restore.
+    emergency_every: int = 0
+    elastic: bool = False
     # Guards (train/guards.py:GuardRunner) — same semantics as TrainConfig.
     check_finite_every: int = 0
     stall_budget_s: float | None = None
@@ -106,6 +113,19 @@ class LMTrainConfig:
 
 class LMTrainer:
     def __init__(self, config: LMTrainConfig, spec: MeshSpec | None = None):
+        self.elastic_decision = None
+        if config.elastic and spec is None:
+            # Elastic restart: refit the data axis to the live device count
+            # (train/elastic.py); resume then reshards the checkpoint onto
+            # the rebuilt mesh.
+            from distributed_model_parallel_tpu.train.elastic import (
+                fit_mesh_to_devices,
+            )
+
+            mesh_cfg, self.elastic_decision = fit_mesh_to_devices(
+                config.mesh, len(jax.devices()),
+                batch_size=config.batch_size)
+            config = dataclasses.replace(config, mesh=mesh_cfg)
         self.config = config
         self.spec = spec if spec is not None else make_mesh(config.mesh)
         cfg = config.model
@@ -226,7 +246,8 @@ class LMTrainer:
                                  context=f"dp={self.spec.num_data}")
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
-                                 injector=self.faults)
+                                 injector=self.faults,
+                                 meta_fn=self._ckpt_meta)
         self.resilience = RecoverySupervisor(
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="lm-good", injector=self.faults,
@@ -247,15 +268,45 @@ class LMTrainer:
             config.consistency_every, self.spec, logger=self.logger,
             guards=self.guards,
             barrier_timeout_s=config.recovery.barrier_timeout_s)
+        from distributed_model_parallel_tpu.train.elastic import (
+            EmergencyCheckpointer,
+        )
+
+        self.emergency = EmergencyCheckpointer(
+            self.ckpt, "lm-emergency", config.emergency_every,
+            logger=self.logger)
         self.start_epoch = 0
-        if config.resume and (self.ckpt.exists("lm")
-                              or self.ckpt.exists("lm-preempt")):
+        # Exact-continuation position: the next (epoch, step) the training
+        # loop will sample. Batches are derived statelessly from
+        # (seed, epoch, step), so this pair IS the data-loader state
+        # (train/elastic.py).
+        self._pos_epoch = 0
+        self._pos_step = 0
+        self._global_step = 0
+        if self.elastic_decision is not None and self.elastic_decision.changed:
+            self.logger.log_line(self.elastic_decision.describe())
+            self.logger.telemetry.event(self.elastic_decision.describe())
+        if config.resume and any(self.ckpt.exists(n)
+                                 for n in ("lm", "lm-preempt",
+                                           "lm-emergency")):
             self._resume()
 
     # ------------------------------------------------------------------ data
-    def sample_batch(self) -> tuple[np.ndarray, np.ndarray]:
+    def sample_batch(self, epoch: int | None = None,
+                     step: int | None = None
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """One training batch. With ``(epoch, step)`` the batch is derived
+        statelessly from ``(seed, epoch, step)`` — the training loop's
+        path, so a resumed run draws exactly the batches an uninterrupted
+        run would have (train/elastic.py). Without them, the legacy
+        consumed-rng stream (ad-hoc/interactive use)."""
         b, t = self.config.batch_size, self.config.seq_len
-        starts = self._rng.integers(0, self._n_train - t - 1, size=b)
+        if epoch is None or step is None:
+            rng = self._rng
+        else:
+            rng = np.random.default_rng(
+                (self.config.seed + 1, int(epoch), int(step)))
+        starts = rng.integers(0, self._n_train - t - 1, size=b)
         idx = starts[:, None] + np.arange(t + 1)[None]
         chunk = self.tokens[idx]
         return chunk[:, :-1], chunk[:, 1:]
@@ -309,6 +360,22 @@ class LMTrainer:
         return total / max(1, n)
 
     # ----------------------------------------------------------- checkpoint
+    def _ckpt_meta(self):
+        """Manifest stamp: saving topology + exact position
+        (train/checkpoint.py, train/elastic.py)."""
+        return {"workload": "lm",
+                "mesh": {**self.config.mesh.axis_sizes(),
+                         "dcn_data": self.config.mesh.dcn_data},
+                "n_devices": int(np.asarray(self.spec.mesh.devices).size),
+                "global_step": self._global_step}
+
+    def _resume_tree(self):
+        from distributed_model_parallel_tpu.train import elastic
+
+        return elastic.build_resume_tree(
+            self._pos_epoch, self._pos_step, self.config.steps_per_epoch,
+            self._global_step, self.resilience.budgets())
+
     def _ckpt_tree(self):
         # virtual_stages is part of the checkpoint identity: params AND
         # optimizer state rows live in the interleaved storage order, so a
@@ -317,26 +384,39 @@ class LMTrainer:
         return {"params": self.params, "opt_state": self.opt_state,
                 "epoch": jnp.asarray(self.start_epoch, jnp.int32),
                 "virtual_stages": jnp.asarray(
-                    self.config.virtual_stages, jnp.int32)}
+                    self.config.virtual_stages, jnp.int32),
+                "resume": self._resume_tree()}
+
+    def _apply_resume_tree(self, restored: dict, *, budgets: bool) -> None:
+        """Adopt the exact-continuation position; see Trainer for the
+        ``budgets`` contract (False on in-run recovery restores)."""
+        from distributed_model_parallel_tpu.train import elastic
+
+        ri = restored.get("resume")
+        if ri is None:
+            return
+        (self._pos_epoch, self._pos_step, self._global_step,
+         retries, lr_scale) = elastic.unpack_resume_tree(ri)
+        if budgets:
+            self.resilience.restore_budgets(retries, lr_scale)
+            if lr_scale != 1.0:
+                self._apply_lr_shrink(lr_scale)
 
     def _resume(self):
-        # Prefer whichever save is newest: the end-of-epoch "lm" slot or the
-        # dedicated "lm-preempt" slot — the partial-epoch preemption save
-        # must never supersede a full-epoch save under versioning.
-        name = self.ckpt.newest_name(("lm", "lm-preempt")) or "lm"
-        try:
-            # allow_fallback: skip a torn newest version (crash window /
-            # partial copy) for the previous committed one.
-            restored = self.ckpt.restore(
-                self._ckpt_tree(), name, allow_fallback=True,
-                on_fallback=self.resilience.note_fallback)
-        except Exception:
-            # Pre-round-5 checkpoints lack the virtual_stages marker and
-            # orbax rejects a template with the extra leaf — retry with
-            # the legacy tree; absence of the marker means V=1.
-            legacy = {k: v for k, v in self._ckpt_tree().items()
-                      if k != "virtual_stages"}
-            restored = self.ckpt.restore(legacy, name)
+        from distributed_model_parallel_tpu.train import elastic
+
+        # Newest-valid slot wins: end-of-epoch "lm", the preemption save,
+        # or a step-cadence emergency save — restored through
+        # restore_resharded so a checkpoint from a different mesh degree
+        # lands in this mesh's shardings. Template ladder: current tree,
+        # then pre-elastic (no "resume" subtree), then pre-round-5 (no
+        # virtual_stages marker either; its absence means V=1).
+        tmpl = self._ckpt_tree()
+        t2 = {k: v for k, v in tmpl.items() if k != "resume"}
+        t3 = {k: v for k, v in t2.items() if k != "virtual_stages"}
+        name, restored = elastic.elastic_restore(
+            self.ckpt, (tmpl, t2, t3), ("lm", "lm-preempt", "lm-emergency"),
+            on_fallback=self.resilience.note_fallback)
         ckpt_v = int(restored.get("virtual_stages", 1))
         if ckpt_v != self.config.virtual_stages:
             raise ValueError(
@@ -350,15 +430,39 @@ class LMTrainer:
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.start_epoch = int(restored["epoch"])
+        self._apply_resume_tree(restored, budgets=True)
+        self.start_epoch = max(self.start_epoch, self._pos_epoch)
+        # Provenance from the version actually read (a torn-newest
+        # fallback may have restored an older one).
+        from distributed_model_parallel_tpu.train.checkpoint import (
+            read_manifest_meta,
+        )
+
+        saved_mesh = (read_manifest_meta(self.ckpt.last_restored_path)
+                      if self.ckpt.last_restored_path else {}).get("mesh")
+        current_mesh = self._ckpt_meta()["mesh"]
+        self.logger.telemetry.resume(
+            slot=name, epoch=self.start_epoch,
+            loader_epoch=self._pos_epoch, batch_cursor=self._pos_step,
+            global_step=self._global_step, mesh=current_mesh,
+            **({"saved_mesh": saved_mesh}
+               if saved_mesh and saved_mesh != current_mesh else {}))
+        self.logger.log_line(
+            f"resume: slot {name!r} -> epoch {self.start_epoch} "
+            f"step {self._pos_step} (global step {self._global_step})"
+            + (f", resharded from mesh {saved_mesh}"
+               if saved_mesh and saved_mesh != current_mesh else ""))
 
     def _restore_good(self):
         """Recovery restore from the supervisor's "last good" slot
-        (train/resilience.py), with torn-version fallback."""
+        (train/resilience.py), with torn-version fallback. Position rides
+        along; budgets stay live (see Trainer._restore_good)."""
         restored = self.ckpt.restore(
             self._ckpt_tree(), self.resilience.slot, allow_fallback=True,
             on_fallback=self.resilience.note_fallback)
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
+        self._apply_resume_tree(restored, budgets=False)
 
     def _apply_lr_shrink(self, factor: float) -> None:
         """Recovery-time LR shrink: rebuild the optimizer and the jitted
@@ -423,10 +527,16 @@ class LMTrainer:
         timer = StepTimer()
         tokens_per_step = (self.config.batch_size
                            * self.config.seq_len)
-        for step_i in range(self.config.steps_per_epoch):
+        # Start of `epoch`, or the mid-epoch cursor a resumed run loaded
+        # (train/elastic.py). Batches are stateless in (epoch, step), so
+        # the continuation draws exactly what the uninterrupted run would.
+        if epoch != self._pos_epoch:
+            self._pos_epoch, self._pos_step = epoch, 0
+        start = self._pos_step
+        for step_i in range(start, self.config.steps_per_epoch):
             if self.preemption.requested():
                 break
-            toks, tgts = self.sample_batch()
+            toks, tgts = self.sample_batch(epoch, step_i)
             timer.data_ready()
             self.params, self.opt_state, step_m = self._step(
                 self.params, self.opt_state, jnp.asarray(toks),
@@ -444,6 +554,8 @@ class LMTrainer:
             meter.update(loss_host)
             if "moe_drop" in step_m:
                 drop_meter.update(float(step_m["moe_drop"]))
+            self._pos_step = step_i + 1
+            self._global_step += 1
             timer.step_done()
             # Per-step telemetry (the LM loop syncs every step, so
             # the per-step timing is real, not a window average).
@@ -453,6 +565,7 @@ class LMTrainer:
                 data_time_s=timer.data.last,
                 tokens_per_s=tokens_per_step
                 / max(timer.step.last, 1e-9))
+            self.emergency.after_step(1, self._ckpt_tree)
         if self.sentinel.enabled:
             # Cover any tail steps the cadence missed before the epoch is
             # declared clean (or a preempt checkpoint is written) — an
@@ -469,7 +582,8 @@ class LMTrainer:
             self.start_epoch = epoch
             checkpoint_on_preempt(self.preemption, self.ckpt,
                                   self._ckpt_tree(), "lm-preempt",
-                                  self.logger, epoch)
+                                  self.logger, epoch,
+                                  global_step=self._global_step)
             return None
         from distributed_model_parallel_tpu.train.trainer import (
             eval_now,

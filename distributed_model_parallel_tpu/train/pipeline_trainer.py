@@ -129,7 +129,8 @@ class PipelineTrainer:
             context="the single-controller pipeline (one copy per stage)")
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
-                                 injector=self.faults)
+                                 injector=self.faults,
+                                 meta_fn=self._ckpt_meta)
         self.resilience = RecoverySupervisor(
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="pipeline-good",
@@ -155,49 +156,136 @@ class PipelineTrainer:
             config.consistency_every, None, logger=self.logger,
             guards=self.guards,
             barrier_timeout_s=config.recovery.barrier_timeout_s)
+        from distributed_model_parallel_tpu.train.elastic import (
+            EmergencyCheckpointer,
+        )
+
+        self.emergency = EmergencyCheckpointer(
+            self.ckpt, "pipeline-emergency", config.emergency_every,
+            logger=self.logger)
         self.best_acc = 0.0
         self.start_epoch = 0
-        self._rng = jax.random.key(config.seed + 1)
-        if config.resume and (self.ckpt.exists("pipeline")
-                              or self.ckpt.exists("pipeline-preempt")):
+        # Stateless per-step augmentation rng (base key x global step) +
+        # host-side step counter — the exact-continuation pair
+        # (train/elastic.py).
+        self._rng_base = jax.random.key(config.seed + 1)
+        self._global_step = 0
+        # Trainer-authoritative loader position (epoch, consumed batches);
+        # see Trainer._resume_tree for why the loader's own state is not
+        # trusted (prefetch-worker auto-advance race).
+        self._loader_pos = (0, 0)
+        if config.resume and any(self.ckpt.exists(n)
+                                 for n in ("pipeline", "pipeline-preempt",
+                                           "pipeline-emergency")):
             self._resume()
 
+    def _ckpt_meta(self):
+        """Manifest stamp: saving topology + exact position
+        (train/checkpoint.py, train/elastic.py)."""
+        return {"workload": "cnn-pipeline",
+                "mesh": {**self.config.mesh.axis_sizes(),
+                         "dcn_data": self.config.mesh.dcn_data},
+                "n_devices": len(self.devices),
+                "global_step": self._global_step}
+
+    def _resume_tree(self):
+        # Trainer-side position, loader re-synced — see
+        # Trainer._resume_tree for the prefetch-worker race this avoids.
+        from distributed_model_parallel_tpu.train import elastic
+
+        ep, cur = self._loader_pos
+        tree = elastic.build_resume_tree(ep, cur, len(self.train_loader),
+                                         self._global_step,
+                                         self.resilience.budgets())
+        self.train_loader.position(int(tree["loader_epoch"]),
+                                   int(tree["batch_cursor"]))
+        return tree
+
     def _ckpt_tree(self):
+        # opt_state is stored per chunk (optax wraps each chunk's
+        # unit-tuple in its own state structure, so a flat merge like
+        # params' is not possible); exact continuation needs it — momentum
+        # buffers lost on resume silently change the trajectory.
         return {"params": self.runner.merged_params(),
                 "model_state": self.runner.merged_model_state(),
+                "opt_state": tuple(jax.device_get(st.opt_state)
+                                   for st in self.runner.stages),
                 "best_acc": jnp.asarray(self.best_acc, jnp.float32),
-                "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
+                "epoch": jnp.asarray(self.start_epoch, jnp.int32),
+                "resume": self._resume_tree()}
+
+    def _apply_resume_tree(self, restored: dict, *, budgets: bool) -> None:
+        """Adopt the exact-continuation position; ``budgets=False`` on
+        in-run recovery restores (see Trainer._restore_good)."""
+        from distributed_model_parallel_tpu.train import elastic
+
+        ri = restored.get("resume")
+        if ri is None:
+            return
+        ep, cur, gs, retries, lr_scale = elastic.unpack_resume_tree(ri)
+        self.train_loader.load_state_dict({"epoch": ep, "batch_cursor": cur})
+        self._loader_pos = (self.train_loader.epoch,
+                            self.train_loader.cursor)
+        self._global_step = gs
+        if budgets:
+            self.resilience.restore_budgets(retries, lr_scale)
+            if lr_scale != 1.0:
+                self._apply_lr_shrink(lr_scale)
 
     def _push_restored(self, restored) -> None:
         """Scatter a restored checkpoint tree back onto the per-stage
-        devices."""
+        devices (chunk c lives on device c % S — matches PipelineRunner's
+        round-robin virtual-stage placement)."""
         params, state = restored["params"], restored["model_state"]
+        opt = restored.get("opt_state")   # absent in legacy checkpoints
         for s, (lo, hi) in enumerate(self.runner.slices):
-            dev = self.runner.devices[s]
+            dev = self.runner.devices[s % self.runner.num_stages]
             self.runner.stages[s].params = jax.device_put(
                 tuple(params[lo:hi]), dev)
             self.runner.stages[s].model_state = jax.device_put(
                 tuple(state[lo:hi]), dev)
+            if opt is not None:
+                self.runner.stages[s].opt_state = jax.device_put(
+                    opt[s], dev)
         self.best_acc = float(restored["best_acc"])
 
     def _resume(self):
-        name = (self.ckpt.newest_name(("pipeline", "pipeline-preempt"))
-                or "pipeline")
-        # allow_fallback: a torn newest version (crash window / partial
-        # copy) is skipped for the previous committed one.
-        restored = self.ckpt.restore(
-            self._ckpt_tree(), name, allow_fallback=True,
+        from distributed_model_parallel_tpu.train import elastic
+
+        # Newest-valid slot wins (best-acc / preemption / emergency), with
+        # torn-version and torn-slot fallback; pre-elastic checkpoints
+        # (no "resume" subtree) restore through the legacy template.
+        tmpl = self._ckpt_tree()
+        legacy = {k: v for k, v in tmpl.items()
+                  if k not in ("resume", "opt_state")}
+        name, restored = elastic.elastic_restore(
+            self.ckpt, (tmpl, legacy),
+            ("pipeline", "pipeline-preempt", "pipeline-emergency"),
             on_fallback=self.resilience.note_fallback)
         self._push_restored(restored)
         self.start_epoch = int(restored["epoch"])
+        self._apply_resume_tree(restored, budgets=True)
+        self.start_epoch = max(self.start_epoch, self.train_loader.epoch)
+        self.logger.telemetry.resume(
+            slot=name, epoch=self.start_epoch,
+            loader_epoch=self.train_loader.epoch,
+            batch_cursor=self.train_loader.cursor,
+            global_step=self._global_step,
+            mesh=self._ckpt_meta()["mesh"])
+        self.logger.log_line(
+            f"resume: slot {name!r} -> epoch {self.start_epoch} "
+            f"batch {self.train_loader.cursor} "
+            f"(global step {self._global_step})")
 
     def _restore_good(self):
         """Recovery restore from the supervisor's "last good" slot
-        (train/resilience.py), with torn-version fallback."""
+        (train/resilience.py), with torn-version fallback. Position rides
+        along; budgets stay live (see Trainer._restore_good)."""
         restored = self.ckpt.restore(
             self._ckpt_tree(), self.resilience.slot, allow_fallback=True,
             on_fallback=self.resilience.note_fallback)
         self._push_restored(restored)
+        self._apply_resume_tree(restored, budgets=False)
 
     def _apply_lr_shrink(self, factor: float) -> None:
         """Recovery-time LR shrink (mirrors Trainer._apply_lr_shrink):
@@ -242,6 +330,15 @@ class PipelineTrainer:
     def _run_epoch(self, epoch: int, train: bool) -> EpochResult:
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
         timer = StepTimer()
+        base = 0
+        if train:
+            # Start of `epoch`, or the mid-epoch cursor a resumed run
+            # loaded; position() after each dispatched step keeps the
+            # persistent cursor in lockstep with the stage state
+            # (train/elastic.py).
+            self.train_loader.set_epoch(epoch)
+            base = self.train_loader.cursor
+            self._loader_pos = (epoch, base)
         loader = self.train_loader if train else self.eval_loader
         loader = maybe_prefetch(loader, self.config.data.prefetch)
         # Metrics stay on device between sync points (train path): a
@@ -299,15 +396,19 @@ class PipelineTrainer:
             timer.data_ready()          # pure loader-fetch time
             n_steps += 1
             if train:
-                self._rng, sub = jax.random.split(self._rng)
+                gi = base + i
+                sub = jax.random.fold_in(self._rng_base, self._global_step)
                 pending.append(
                     (self.runner.train_step_device(sub, images, labels),
                      float(labels.shape[0])))
+                self._global_step += 1
+                self._loader_pos = (epoch, gi + 1)
                 if self.faults.enabled:
                     self._poll_step_faults(pending)
-                log_now = i % self.config.log_every_n_steps == 0
+                log_now = gi % self.config.log_every_n_steps == 0
                 if log_now or len(pending) >= max_inflight:
                     drain()
+                self.emergency.after_step(1, self._ckpt_tree)
                 if log_now:
                     now = time.perf_counter()
                     d_data = timer.data.sum - win_data
@@ -316,7 +417,7 @@ class PipelineTrainer:
                     win_wall, win_data, win_steps = (now, timer.data.sum,
                                                      n_steps)
                     self.logger.log_step(
-                        epoch, i, loss=meters["loss"].avg,
+                        epoch, gi, loss=meters["loss"].avg,
                         acc1=meters["acc1"].avg,
                         step_time_s=run_step,
                         data_time_s=timer.data.last,
@@ -382,7 +483,8 @@ class PipelineTrainer:
                     checkpoint_on_preempt(self.preemption, self.ckpt,
                                           self._ckpt_tree(),
                                           "pipeline-preempt", self.logger,
-                                          epoch)
+                                          epoch,
+                                          global_step=self._global_step)
                     break
                 ev = (self._run_epoch(epoch, train=False)
                       if eval_now(epoch, epochs, self.config.eval_every)

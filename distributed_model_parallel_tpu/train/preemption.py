@@ -22,24 +22,34 @@ DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 
 def checkpoint_on_preempt(guard: "PreemptionGuard", ckpt, tree, name: str,
-                          logger, epoch: int) -> None:
+                          logger, epoch: int, *,
+                          global_step: int | None = None) -> None:
     """The shared honor-a-preemption sequence used by every epoch driver:
     durable save under the dedicated slot, event line, consume the request
     (so a later fit() trains normally). Callers set their resume epoch
     before building ``tree`` and ``break`` after.
+
+    ``tree`` is the trainer's full checkpoint tree, which carries the
+    exact-continuation "resume" subtree (loader position, global step,
+    recovery budgets — train/elastic.py): the preemption save IS an
+    emergency checkpoint, so a restart continues at the interrupted step
+    instead of replaying the epoch.
 
     Emits the typed ``failure`` / ``recovery`` telemetry pair (a preemption
     — real SIGTERM, injected fault, or watchdog stall escalation — is a
     failure whose recovery action is this graceful checkpoint-and-exit), so
     ``scripts/dmp_report.py`` shows it on the resilience timeline."""
     telemetry = getattr(logger, "telemetry", None)
+    extra = {} if global_step is None else {"global_step": int(global_step)}
     if telemetry is not None:
-        telemetry.failure("preempted", stage=name, epoch=epoch)
+        telemetry.failure("preempted", stage=name, epoch=epoch, **extra)
     ckpt.save(tree, name, wait=True)
-    logger.log_line(f"preempted: checkpoint saved at epoch {epoch}")
+    logger.log_line(f"preempted: checkpoint saved at epoch {epoch}"
+                    + (f", global step {global_step}"
+                       if global_step is not None else ""))
     if telemetry is not None:
         telemetry.recovery(action="checkpoint-and-exit", slot=name,
-                           epoch=epoch)
+                           epoch=epoch, **extra)
     guard.reset()
 
 

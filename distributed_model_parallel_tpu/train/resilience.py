@@ -238,6 +238,22 @@ class RecoverySupervisor:
         self.logger.log_line(
             f"chaos: injected fault {spec.kind} at {site}[{index}]")
 
+    # -- budget persistence (elastic resume, train/elastic.py) --------------
+    def budgets(self) -> dict:
+        """The budgets a checkpoint carries so a restarted run cannot
+        launder its retry allowance or silently drop an applied LR shrink:
+        ``retries_left`` and the cumulative ``lr_scale``."""
+        return {"retries_left": self.retries_left, "lr_scale": self.lr_scale}
+
+    def restore_budgets(self, retries_left: int, lr_scale: float) -> None:
+        """Adopt checkpointed budgets on resume. ``retries_left`` is
+        clamped to the configured budget (a config that *lowered*
+        max_retries wins); the caller re-applies ``lr_scale`` to its
+        optimizer (the supervisor only tracks it)."""
+        self.retries_left = max(0, min(int(retries_left),
+                                       self.config.max_retries))
+        self.lr_scale = float(lr_scale)
+
     # -- good-state bookkeeping ---------------------------------------------
     def begin(self, tree_fn: Callable[[], Any]) -> None:
         """Seed the good slot at fit() start so an epoch-0 failure has a
@@ -311,6 +327,18 @@ class RecoverySupervisor:
         if shrink_lr is not None and self.config.lr_shrink != 1.0:
             self.lr_scale *= self.config.lr_shrink
             shrink_lr(self.config.lr_shrink)
+        elif label == "non-finite":
+            # Elastic resume made retries deterministic: the restored
+            # position replays the exact batch order and rng stream, so
+            # without an LR shrink a DATA-deterministic NaN will recur
+            # identically and burn the whole budget. (For transient
+            # hardware faults — the common case — exact replay is the
+            # point.) Say so instead of failing mysteriously N times.
+            self.logger.log_line(
+                "resilience: retrying with lr_shrink=1.0 replays the "
+                "identical batch/rng trajectory — a deterministic "
+                "non-finite will recur; set recovery.lr_shrink < 1.0 to "
+                "perturb the retry")
         self._telemetry.recovery(action="restored", slot=self.slot,
                                  epoch=epoch, retries_left=self.retries_left,
                                  lr_scale=self.lr_scale, detail=label)

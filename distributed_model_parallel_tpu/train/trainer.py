@@ -194,6 +194,20 @@ class Trainer:
     def __init__(self, config: TrainConfig, spec: MeshSpec | None = None,
                  *, train_ds: ArrayDataset | None = None,
                  eval_ds: ArrayDataset | None = None):
+        self.elastic_decision = None
+        if config.elastic and spec is None:
+            # Elastic restart: rebuild the mesh at the largest dp degree
+            # the live device count supports (train/elastic.py) — the
+            # degraded-slice restart path. An explicit `spec` means the
+            # caller already chose a topology.
+            from distributed_model_parallel_tpu.train.elastic import (
+                fit_mesh_to_devices,
+            )
+
+            mesh_cfg, self.elastic_decision = fit_mesh_to_devices(
+                config.mesh, len(jax.devices()),
+                batch_size=config.data.batch_size)
+            config = config.replace(mesh=mesh_cfg)
         self.config = config
         self.spec = spec if spec is not None else make_mesh(config.mesh)
         if train_ds is None or eval_ds is None:
@@ -441,7 +455,8 @@ class Trainer:
             context=f"strategy={config.strategy!r}")
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
-                                 injector=self.faults)
+                                 injector=self.faults,
+                                 meta_fn=self._ckpt_meta)
         self.resilience = RecoverySupervisor(
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="good", injector=self.faults,
@@ -462,10 +477,29 @@ class Trainer:
             config.consistency_every, self.spec, logger=self.logger,
             guards=self.guards,
             barrier_timeout_s=config.recovery.barrier_timeout_s)
+        from distributed_model_parallel_tpu.train.elastic import (
+            EmergencyCheckpointer,
+        )
+
+        self.emergency = EmergencyCheckpointer(
+            self.ckpt, "emergency", config.emergency_every,
+            logger=self.logger, wait=not config.async_checkpoint)
         self.best_acc = 0.0
         self.start_epoch = 0
-        self._rng = jax.random.key(config.seed + 1)
-        if config.resume and (self.ckpt.exists() or self.ckpt.exists("preempt")):
+        # Per-step augmentation rng is derived from (base key, global step)
+        # — stateless, so a resumed run replays the exact stream an
+        # uninterrupted run would have used (train/elastic.py). The host
+        # mirrors the on-device TrainState.step counter.
+        self._rng_base = jax.random.key(config.seed + 1)
+        self._global_step = 0
+        # Trainer-authoritative loader position (epoch, consumed batches);
+        # see _resume_tree for why the loader's own state is not trusted.
+        self._loader_pos = (0, 0)
+        if self.elastic_decision is not None and self.elastic_decision.changed:
+            self.logger.log_line(self.elastic_decision.describe())
+            self.logger.telemetry.event(self.elastic_decision.describe())
+        if config.resume and any(self.ckpt.exists(n)
+                                 for n in ("ckpt", "preempt", "emergency")):
             self._resume()
 
     def _build_steps(self) -> None:
@@ -560,20 +594,75 @@ class Trainer:
     def _ckpt_tree(self):
         return {"state": self.state,
                 "best_acc": jnp.asarray(self.best_acc, jnp.float32),
-                "epoch": jnp.asarray(self.start_epoch, jnp.int32)}
+                "epoch": jnp.asarray(self.start_epoch, jnp.int32),
+                "resume": self._resume_tree()}
+
+    def _ckpt_meta(self):
+        """Manifest stamp written with every committed version: the saving
+        topology + exact position, readable without restoring anything
+        (train/checkpoint.py, train/elastic.py)."""
+        return {"workload": "cnn",
+                "mesh": {**self.config.mesh.axis_sizes(),
+                         "dcn_data": self.config.mesh.dcn_data},
+                "n_devices": int(np.asarray(self.spec.mesh.devices).size),
+                "global_step": self._global_step}
+
+    def _resume_tree(self):
+        """The exact-continuation state riding along in every checkpoint:
+        loader position, global step, and the supervisor's live budgets —
+        what turns an epoch-granular restore into a mid-epoch one
+        (train/elastic.py).
+
+        The position comes from the TRAINER's own (epoch, consumed)
+        bookkeeping, not the loader's: a prefetch worker that exhausts the
+        underlying iterator before the consumer has dispatched anything
+        auto-advances the loader's epoch on its own thread (data/loader.py)
+        — only the trainer knows what was actually consumed. The loader is
+        re-synced here so its state matches every checkpoint written."""
+        from distributed_model_parallel_tpu.train import elastic
+
+        ep, cur = self._loader_pos
+        tree = elastic.build_resume_tree(ep, cur, len(self.train_loader),
+                                         self._global_step,
+                                         self.resilience.budgets())
+        self.train_loader.position(int(tree["loader_epoch"]),
+                                   int(tree["batch_cursor"]))
+        return tree
+
+    def _apply_resume_tree(self, restored: dict, *, budgets: bool) -> None:
+        """Adopt a restored checkpoint's exact-continuation state. Legacy
+        checkpoints (no "resume" subtree) degrade to the historical
+        epoch-granular resume. ``budgets=False`` for in-run recovery
+        restores: the LIVE retry budget/LR scale must not be refilled from
+        a checkpoint written before the failure."""
+        from distributed_model_parallel_tpu.train import elastic
+
+        ri = restored.get("resume")
+        if ri is None:
+            self._global_step = int(jax.device_get(restored["state"].step))
+            return
+        ep, cur, gs, retries, lr_scale = elastic.unpack_resume_tree(ri)
+        self.train_loader.load_state_dict({"epoch": ep, "batch_cursor": cur})
+        self._loader_pos = (self.train_loader.epoch,
+                            self.train_loader.cursor)
+        self._global_step = gs
+        if budgets:
+            self.resilience.restore_budgets(retries, lr_scale)
+            if lr_scale != 1.0:
+                # Re-apply the cumulative recovery LR shrink the saving run
+                # had in effect (the optimizer was rebuilt at base LR).
+                self._apply_lr_shrink(lr_scale)
 
     def _resume(self):
-        # Prefer whichever slot is newer: the best-accuracy checkpoint or a
-        # preemption save (which lives under its own name so it never
-        # evicts the best-model weights).
-        name = self.ckpt.newest_name(("ckpt", "preempt")) or "ckpt"
+        from distributed_model_parallel_tpu.train import elastic
+
         tmpl = self._ckpt_tree()
         # The checkpoint's TrainState may differ from the current config in
         # the optional EMA subtrees: runs resumed with ema_decay toggled,
         # and checkpoints from before ema_model_state existed (params-only
         # EMA layout). Try the current template first, then each alternate
-        # layout; a genuinely broken checkpoint exhausts them and raises
-        # with the original error chained.
+        # layout; pre-elastic checkpoints additionally lack the "resume"
+        # subtree, so every layout also gets a legacy template without it.
         st = tmpl["state"]
         layouts, seen = [], set()
         for layout in (
@@ -586,34 +675,16 @@ class Trainer:
             if key not in seen:          # the candidates overlap with tmpl
                 seen.add(key)
                 layouts.append(layout)
-        from distributed_model_parallel_tpu.train.checkpoint import (
-            CheckpointIntegrityError,
-        )
-
-        restored = None
-        for i, layout in enumerate(layouts):
-            try:
-                # allow_fallback: a torn newest version (crash window,
-                # partial copy) is skipped for the previous committed one —
-                # manifest-verified versions that fail keep raising (a
-                # structure mismatch is a config problem, not corruption).
-                restored = self.ckpt.restore(
-                    {**tmpl, "state": layout}, name, allow_fallback=True,
-                    on_fallback=self.resilience.note_fallback)
-                break
-            except (ValueError, KeyError, TypeError,
-                    CheckpointIntegrityError) as e:
-                if i == len(layouts) - 1:
-                    if isinstance(e, CheckpointIntegrityError):
-                        # Every version is torn/corrupt: that is a disk
-                        # problem, not a config mismatch — don't misdiagnose.
-                        raise
-                    raise ValueError(
-                        f"checkpoint {name!r} does not match the current "
-                        f"configuration's train-state structure — resuming "
-                        f"requires the same model and optimizer as the "
-                        f"saving run (only the EMA setting may toggle)"
-                    ) from e
+        templates = [{**tmpl, "state": lo} for lo in layouts]
+        legacy = {k: v for k, v in tmpl.items() if k != "resume"}
+        templates += [{**legacy, "state": lo} for lo in layouts]
+        # Newest-valid slot wins — best-accuracy, preemption, or
+        # step-cadence emergency save — restored through restore_resharded
+        # so a checkpoint from a different mesh degree lands in THIS mesh's
+        # shardings; torn versions/slots fall back (train/elastic.py).
+        name, restored = elastic.elastic_restore(
+            self.ckpt, templates, ("ckpt", "preempt", "emergency"),
+            on_fallback=self.resilience.note_fallback)
         rs = restored["state"]
         want_ema = self.config.optimizer.ema_decay is not None
         if want_ema:
@@ -629,6 +700,34 @@ class Trainer:
         self.state = jax.device_put(rs, self._state_sh)
         self.best_acc = float(restored["best_acc"])
         self.start_epoch = int(restored["epoch"])
+        self._apply_resume_tree(restored, budgets=True)
+        # The best-acc slot's "epoch" leaf lags when later epochs brought
+        # no accuracy improvement; the loader position is authoritative
+        # for where training actually stood.
+        self.start_epoch = max(self.start_epoch, self.train_loader.epoch)
+        # Provenance from the version actually read (a torn-newest
+        # fallback may have restored an older one).
+        from distributed_model_parallel_tpu.train.checkpoint import (
+            read_manifest_meta,
+        )
+
+        saved_mesh = (read_manifest_meta(self.ckpt.last_restored_path)
+                      if self.ckpt.last_restored_path else {}).get("mesh")
+        current_mesh = self._ckpt_meta()["mesh"]
+        self.logger.telemetry.resume(
+            slot=name, epoch=self.start_epoch,
+            loader_epoch=self.train_loader.epoch,
+            batch_cursor=self.train_loader.cursor,
+            global_step=self._global_step,
+            mesh=current_mesh,
+            **({"saved_mesh": saved_mesh}
+               if saved_mesh and saved_mesh != current_mesh else {}))
+        self.logger.log_line(
+            f"resume: slot {name!r} -> epoch {self.start_epoch} "
+            f"batch {self.train_loader.cursor} "
+            f"(global step {self._global_step})"
+            + (f", resharded from mesh {saved_mesh}"
+               if saved_mesh and saved_mesh != current_mesh else ""))
 
     def _save(self, epoch: int):
         self.start_epoch = epoch + 1
@@ -638,12 +737,16 @@ class Trainer:
     def _restore_good(self):
         """Recovery restore: pull the supervisor's "last good" slot (same
         tree layout as this run wrote it) back onto the devices, with
-        torn-version fallback (train/resilience.py)."""
+        torn-version fallback (train/resilience.py). The loader position
+        and global step ride along so the retry replays exactly the
+        batches the restored state had seen — budgets stay LIVE (a
+        checkpoint written before the failure must not refill them)."""
         restored = self.ckpt.restore(
             self._ckpt_tree(), self.resilience.slot, allow_fallback=True,
             on_fallback=self.resilience.note_fallback)
         self.state = jax.device_put(restored["state"], self._state_sh)
         self.best_acc = float(restored["best_acc"])
+        self._apply_resume_tree(restored, budgets=False)
 
     # -- epoch loops ---------------------------------------------------------
     def _shard_batch(self, images, labels):
@@ -764,17 +867,28 @@ class Trainer:
         meters = {k: AverageMeter(k) for k in ("loss", "acc1", "acc5")}
         timer = StepTimer()
         pending: list = []
+        # Loader position: start of `epoch`, or the mid-epoch cursor a
+        # resumed run loaded (train/elastic.py). `base + i` is the global
+        # batch index within the epoch; _loader_pos after each dispatched
+        # step keeps the resume position in lockstep with the train state
+        # (the prefetch worker runs ahead and cannot be trusted).
+        self.train_loader.set_epoch(epoch)
+        base = self.train_loader.cursor
+        self._loader_pos = (epoch, base)
         for i, (images, labels) in enumerate(self._prefetched(self.train_loader)):
             if self.preemption.requested():
                 break
+            gi = base + i
             images, labels = self._shard_batch(images, labels)
             timer.data_ready()
-            self._rng, sub = jax.random.split(self._rng)
+            sub = jax.random.fold_in(self._rng_base, self._global_step)
             self.state, metrics = self._train_step(self.state, sub, images, labels)
+            self._global_step += 1
+            self._loader_pos = (epoch, gi + 1)
             pending.append(metrics)
             if self.faults.enabled:
                 self._poll_step_faults(pending)
-            log_now = i % self.config.log_every_n_steps == 0
+            log_now = gi % self.config.log_every_n_steps == 0
             if log_now or len(pending) >= self._max_inflight:
                 n = len(pending)
                 self._drain(pending, meters, sentinel=True)  # sync point
@@ -785,12 +899,13 @@ class Trainer:
                 # must see real per-step variation or a straggler window
                 # collapses into the average and disappears.
                 self.logger.log_step(
-                    epoch, i, loss=meters["loss"].avg,
+                    epoch, gi, loss=meters["loss"].avg,
                     acc1=meters["acc1"].avg,
                     step_time_s=timer.step.last,
                     data_time_s=timer.data.last,
                     samples_per_s=self.config.data.batch_size
                     / max(timer.step.last, 1e-9))
+            self.emergency.after_step(1, self._ckpt_tree)
         n = len(pending)
         self._drain(pending, meters, sentinel=True)
         timer.window_done(n)
@@ -811,19 +926,27 @@ class Trainer:
         pending: list = []
         bs = self.train_loader.batch_size
         K = max(1, self.config.steps_per_dispatch)
-        idx = self.train_loader.epoch_indices()
+        self.train_loader.set_epoch(epoch)
+        # Resume cursor is always dispatch-aligned: saves only happen at
+        # dispatch boundaries, so a resumed run re-chunks the remaining
+        # steps exactly like the uninterrupted run would have.
+        base = self.train_loader.cursor
+        self._loader_pos = (epoch, base)
+        idx = self.train_loader.epoch_indices(epoch)
         steps = len(idx) // bs
         idx = idx[:steps * bs].reshape(steps, bs)
         inflight = 0
-        for i in range(0, steps, K):
+        for i in range(base, steps, K):
             if self.preemption.requested():
                 break
             chunk = np.ascontiguousarray(idx[i:i + K])
             timer.data_ready()
-            self._rng, sub = jax.random.split(self._rng)
+            sub = jax.random.fold_in(self._rng_base, self._global_step)
             self.state, metrics = self._multi_step(
                 self.state, sub, self._dev_images, self._dev_labels,
                 jnp.asarray(chunk))
+            self._global_step += chunk.shape[0]
+            self._loader_pos = (epoch, i + chunk.shape[0])
             pending.append(metrics)
             if self.faults.enabled:
                 # One step-site poll per DISPATCH (K fused steps) — faults
@@ -847,6 +970,7 @@ class Trainer:
                     data_time_s=timer.data.last,
                     samples_per_s=self.config.data.batch_size
                     / max(timer.step.last, 1e-9))
+            self.emergency.after_step(chunk.shape[0], self._ckpt_tree)
         self._drain(pending, meters, sentinel=True)
         timer.window_done(inflight)
         if self.sentinel.enabled:
@@ -926,7 +1050,8 @@ class Trainer:
                     self.start_epoch = epoch
                     checkpoint_on_preempt(self.preemption, self.ckpt,
                                           self._ckpt_tree(), "preempt",
-                                          self.logger, epoch)
+                                          self.logger, epoch,
+                                          global_step=self._global_step)
                     break
                 ev = (self.evaluate()
                       if eval_now(epoch, epochs, self.config.eval_every)

@@ -50,6 +50,11 @@ consistency status (divergence | repaired | no-quorum | non-finite),
            (train/consistency.py); a
            divergence gets a matching ``recovery`` record
            (replica-rebroadcast or restored) on the same timeline
+resume     slot, plus the exact continuation position (epoch,
+           batch_cursor, global_step) and mesh context (saved_mesh vs
+           mesh when the topology changed) — one elastic-resume event
+           (train/elastic.py) emitted when a restarted run restores a
+           checkpoint
 ========== ==========================================================
 """
 
@@ -504,6 +509,14 @@ class TelemetryRun:
         restore takes over, ``non-finite`` when replicas agree on a
         non-finite state (routed to the NonFiniteError recovery path)."""
         self.record("consistency", status=status, **fields)
+
+    def resume(self, slot: str, **fields) -> None:
+        """One elastic-resume event (train/elastic.py): which checkpoint
+        slot a restarted run picked up, the exact position it continues
+        from (epoch, batch cursor, global step) and the saving vs current
+        mesh when the topology changed — so a restart is auditable on the
+        resilience timeline, not inferred from step numbering."""
+        self.record("resume", slot=slot, **fields)
 
     def memory(self) -> list[dict] | None:
         """Record device memory watermarks (no-op record skipped when the

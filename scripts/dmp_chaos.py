@@ -28,6 +28,14 @@ Scenarios (``--scenario``):
   run's total step time (compile warmed up outside the window); an A/B
   sentinel-off run rides along as a diagnostic only — on a shared
   1-core host the two arms differ by 10-30% from load noise alone.
+* ``preempt`` — elastic resume (train/elastic.py): a run is preempted
+  mid-epoch (injected ``preempt`` fault = SIGTERM minus the signal), the
+  preemption/emergency save captures the exact position, and the drill
+  restarts it twice: on the SAME mesh (must reach bitwise-identical
+  final params and per-step loss trajectory vs an uninterrupted run)
+  and on HALF the dp degree (must continue from the exact global step
+  with no sample replayed or skipped, via resharded restore). Non-zero
+  exit on any violation.
 
 Usage:
   JAX_PLATFORMS=cpu python scripts/dmp_chaos.py [--scenario nan] \
@@ -60,7 +68,8 @@ if (os.environ.get("JAX_PLATFORMS") == "cpu"
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scenario", default="nan",
-                   choices=["nan", "bitflip", "desync", "overhead"])
+                   choices=["nan", "bitflip", "desync", "overhead",
+                            "preempt"])
     p.add_argument("--epochs", default=None, type=int,
                    help="epochs per drill run (default 2; the overhead "
                         "scenario pins 1)")
@@ -348,11 +357,137 @@ def scenario_overhead(args, workdir) -> tuple[dict, bool]:
     return summary, bool(n_off and n_on and n_checks)
 
 
+def _per_step_losses(records) -> dict:
+    """Reconstruct per-step losses from the window-averaged ``step``
+    telemetry records of a ``log_every_n_steps=1`` run: with equal batch
+    sizes the epoch meter is an arithmetic running mean, so
+    ``loss_k = avg_k * k - avg_{k-1} * (k-1)`` (k = records seen this
+    epoch *in this run* — a resumed run's partial epoch starts a fresh
+    meter). Keys are ``(epoch, step)``; the step field is the global batch
+    index within the epoch, so baseline and resumed runs align."""
+    from collections import defaultdict
+
+    by_epoch = defaultdict(list)
+    for r in records:
+        if r.get("kind") == "step" and isinstance(r.get("loss"),
+                                                  (int, float)):
+            by_epoch[r["epoch"]].append((r["step"], r["loss"]))
+    out = {}
+    for ep, lst in by_epoch.items():
+        lst.sort()
+        prev_sum = 0.0
+        for k, (step, avg) in enumerate(lst, start=1):
+            out[(ep, step)] = avg * k - prev_sum
+            prev_sum = avg * k
+    return out
+
+
+def scenario_preempt(args, workdir) -> tuple[dict, bool]:
+    """Kill mid-epoch -> exact-step resume (same mesh: bitwise parity;
+    halved dp: exact continuation, nothing replayed or skipped)."""
+    import shutil
+
+    import jax
+    import numpy as np
+
+    from distributed_model_parallel_tpu.config import (
+        MeshConfig,
+        RecoveryConfig,
+    )
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+    from distributed_model_parallel_tpu.utils.faults import parse_faults
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+    if len(jax.devices()) < 4:
+        print("preempt scenario needs >= 4 devices (dp=4 halved to dp=2)",
+              file=sys.stderr)
+        return {"chaos": "preempt", "error": "needs >= 4 devices"}, False
+    steps_per_epoch = 96 // 32        # _config's synthetic set / batch
+    total_steps = args.epochs * steps_per_epoch
+    # Fire after the 2nd step of the FINAL epoch: unambiguously mid-epoch.
+    kill_at = steps_per_epoch * (args.epochs - 1) + 1
+    kw = dict(epochs=args.epochs, mesh=MeshConfig(data=4),
+              max_inflight_steps=1, log_every_n_steps=1, emergency_every=2)
+
+    baseline = Trainer(_config(workdir, "chaos_preempt_base",
+                               recovery=RecoveryConfig(), **kw))
+    baseline.fit()
+    base_losses = _per_step_losses(read_records(baseline.logger.jsonl_path))
+
+    plan = parse_faults(args.faults or f"preempt@{kill_at}")
+    killed = Trainer(_config(workdir, "chaos_preempt_kill",
+                             recovery=RecoveryConfig(faults=plan), **kw))
+    killed.fit()
+    killed_pos = killed.train_loader.state_dict()
+    killed_step = killed._global_step
+    ck_dir = killed.config.checkpoint_dir
+    half_dir = ck_dir + "_half"
+    shutil.copytree(ck_dir, half_dir)   # same-mesh arm mutates the slots
+
+    # Restart 1: same mesh — must converge bitwise-identically to the
+    # uninterrupted run, with the resumed steps' losses on its trajectory.
+    r1 = Trainer(_config(workdir, "chaos_preempt_resume",
+                         recovery=RecoveryConfig(), checkpoint_dir=ck_dir,
+                         resume=True, **kw))
+    r1_pos = dict(epoch=r1.train_loader.epoch,
+                  cursor=r1.train_loader.cursor,
+                  global_step=r1._global_step)
+    r1.fit()
+    r1_records = read_records(r1.logger.jsonl_path)
+    r1_losses = _per_step_losses(r1_records)
+    traj_ok = bool(r1_losses) and all(
+        key in base_losses and np.isclose(base_losses[key], loss,
+                                          rtol=1e-5, atol=1e-6)
+        for key, loss in r1_losses.items())
+    parity = (_bitwise_equal(jax.device_get(baseline.state.params),
+                             jax.device_get(r1.state.params))
+              and int(jax.device_get(r1.state.step)) == total_steps)
+
+    # Restart 2: half the dp degree (the degraded slice a preempted TPU
+    # job typically gets back) — resharded restore, exact-step
+    # continuation, no sample replayed or skipped.
+    r2 = Trainer(_config(workdir, "chaos_preempt_resume_half",
+                         recovery=RecoveryConfig(), checkpoint_dir=half_dir,
+                         resume=True, **{**kw, "mesh": MeshConfig(data=2)}))
+    r2_pos = dict(epoch=r2.train_loader.epoch,
+                  cursor=r2.train_loader.cursor,
+                  global_step=r2._global_step)
+    r2.fit()
+    half_ok = (r2_pos == r1_pos
+               and int(jax.device_get(r2.state.step)) == total_steps
+               and r2._global_step - r2_pos["global_step"]
+               == total_steps - killed_step)
+
+    _report(r1)
+    resume_recs = [r for r in r1_records if r.get("kind") == "resume"]
+    summary = {
+        "chaos": "preempt-exact-resume",
+        "faults_injected": [s.kind for s in killed.faults.fired],
+        "killed_at": {"global_step": killed_step, **killed_pos},
+        "emergency_saves": killed.emergency.saves,
+        "resumed_at_same_mesh": r1_pos,
+        "resumed_at_half_dp": r2_pos,
+        "resume_records": [r.get("slot") for r in resume_recs],
+        "bitwise_parity_with_uninterrupted": parity,
+        "loss_trajectory_parity": traj_ok,
+        "half_dp_exact_continuation": half_ok,
+        "telemetry": r1.logger.jsonl_path,
+    }
+    ok = bool(killed.faults.fired
+              and killed_step == kill_at + 1       # stopped right after
+              and killed_pos["batch_cursor"] != 0  # genuinely mid-epoch
+              and r1_pos["global_step"] == killed_step
+              and r1_pos["cursor"] == killed_pos["batch_cursor"]
+              and resume_recs and parity and traj_ok and half_ok)
+    return summary, ok
+
+
 SCENARIOS = {
     "nan": scenario_nan,
     "bitflip": scenario_bitflip,
     "desync": scenario_desync,
     "overhead": scenario_overhead,
+    "preempt": scenario_preempt,
 }
 
 
@@ -367,6 +502,9 @@ def main(argv=None) -> int:
         "nan": [("--consistency-every", args.consistency_every)],
         "bitflip": [("--lr-shrink", args.lr_shrink)],
         "desync": [("--lr-shrink", args.lr_shrink)],
+        "preempt": [("--consistency-every", args.consistency_every),
+                    ("--retries", args.retries),
+                    ("--lr-shrink", args.lr_shrink)],
     }[args.scenario]
     bad = [flag for flag, value in unread if value is not None]
     if bad:

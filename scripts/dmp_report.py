@@ -197,21 +197,33 @@ def _resilience_section(lines: list[str], by_kind: dict) -> None:
     fails = by_kind.get("failure") or []
     recs = by_kind.get("recovery") or []
     cons = by_kind.get("consistency") or []
-    if not fails and not recs and not cons:
+    resumes = by_kind.get("resume") or []
+    if not fails and not recs and not cons and not resumes:
         return
     starts = by_kind.get("run_start") or []
     t0 = starts[-1].get("ts") if starts else None
     if t0 is None:
-        t0 = min((r.get("ts") for r in fails + recs + cons
+        t0 = min((r.get("ts") for r in fails + recs + cons + resumes
                   if isinstance(r.get("ts"), (int, float))), default=0.0)
     header = f"== resilience ({len(fails)} failures, {len(recs)} recoveries"
-    lines.append(header + (f", {len(cons)} consistency) =="
-                           if cons else ") =="))
-    events = sorted(fails + recs + cons,
+    if cons:
+        header += f", {len(cons)} consistency"
+    if resumes:
+        header += f", {len(resumes)} resumes"
+    lines.append(header + ") ==")
+    events = sorted(fails + recs + cons + resumes,
                     key=lambda r: r.get("ts") or 0.0)
     for r in events:
         dt = (r["ts"] - t0) if isinstance(r.get("ts"), (int, float)) else 0.0
-        if r.get("kind") == "consistency":
+        if r.get("kind") == "resume":
+            extra = " ".join(
+                f"{k}={r[k]}" for k in ("epoch", "batch_cursor",
+                                        "global_step", "saved_mesh")
+                if r.get(k) is not None)
+            lines.append(f"  [+{dt:7.1f}s] resume    "
+                         f"{str(r.get('slot')):<24}"
+                         + (f" {extra}" if extra else ""))
+        elif r.get("kind") == "consistency":
             extra = " ".join(
                 f"{k}={r[k]}" for k in ("replicas", "groups", "outliers",
                                         "leaves", "check")

@@ -67,6 +67,17 @@ def parse_args():
                    help="gradient accumulation: one optimizer update per k "
                         "batches (size-b batch at k == size-k*b batch)")
     p.add_argument("--resume", "-r", action="store_true")
+    p.add_argument("--emergency-every", default=0, type=int, metavar="N",
+                   help="elastic resume: write the emergency checkpoint "
+                        "slot (full mid-epoch resume state: loader cursor, "
+                        "global step, recovery budgets) every N steps so a "
+                        "preempted run continues at the exact step "
+                        "(0 = only the preemption save; train/elastic.py)")
+    p.add_argument("--elastic", action="store_true",
+                   help="on startup, shrink the data axis to the largest "
+                        "degree the live device count and batch size allow "
+                        "(degraded-slice restart) and reshard the resumed "
+                        "checkpoint onto the rebuilt mesh")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="persist checkpoints on a background thread")
     p.add_argument("--sync-bn", action="store_true",
@@ -195,6 +206,8 @@ def main():
         mesh=MeshConfig(data=n, dcn_data=args.dcn_data),
         epochs=args.epochs,
         resume=args.resume,
+        emergency_every=args.emergency_every,
+        elastic=args.elastic,
         async_checkpoint=args.async_checkpoint,
         device_resident_data=args.device_data,
         steps_per_dispatch=args.steps_per_dispatch,

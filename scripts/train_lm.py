@@ -80,6 +80,15 @@ def parse_args():
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--emergency-every", default=0, type=int, metavar="N",
+                   help="elastic resume: write the emergency checkpoint "
+                        "slot (exact mid-epoch resume state) every N steps "
+                        "(0 = only the preemption save; train/elastic.py)")
+    p.add_argument("--elastic", action="store_true",
+                   help="on startup, shrink the data axis to the largest "
+                        "degree the live device count and batch size allow "
+                        "and reshard the resumed checkpoint onto the "
+                        "rebuilt mesh")
     p.add_argument("--check-finite-every", default=0, type=int,
                    help="check loss every step and params every N steps "
                         "for NaN/Inf (0 = off)")
@@ -162,6 +171,7 @@ def main():
         pipeline_schedule=args.schedule,
         virtual_stages=args.virtual_stages,
         steps_per_epoch=args.steps, epochs=args.epochs, resume=args.resume,
+        emergency_every=args.emergency_every, elastic=args.elastic,
         check_finite_every=args.check_finite_every,
         consistency_every=args.consistency_every,
         recovery=RecoveryConfig(

@@ -60,6 +60,10 @@ def parse_args():
     p.add_argument("--batch-size", "-b", default=512, type=int)
     p.add_argument("--warmup-epochs", default=10, type=int)
     p.add_argument("--resume", "-r", action="store_true")
+    p.add_argument("--emergency-every", default=0, type=int, metavar="N",
+                   help="elastic resume: write the emergency checkpoint "
+                        "slot (exact mid-epoch resume state) every N steps "
+                        "(0 = only the preemption save; train/elastic.py)")
     p.add_argument("--image-size", default=32, type=int,
                    help="train/eval input resolution; when it differs from "
                         "the dataset's native size the batch is resized "
@@ -107,6 +111,7 @@ def main():
         mesh=MeshConfig(data=args.dp, stage=args.stages),
         epochs=args.epochs,
         resume=args.resume,
+        emergency_every=args.emergency_every,
         strategy=("spmd_pipeline" if args.engine == "spmd" else "gspmd"),
         num_microbatches=args.microbatches,
         stage_boundaries=boundaries,

@@ -1,0 +1,522 @@
+"""Elastic resume (train/elastic.py): stateless loader position, the
+emergency checkpoint slot, topology-change-resilient restore, and exact
+mid-epoch continuation — the capability the reference caps at
+epoch-granular best-acc checkpointing (``data_parallel.py:143-155``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.config import MeshConfig, RecoveryConfig
+from distributed_model_parallel_tpu.data.loader import (
+    BatchLoader,
+    PrefetchLoader,
+)
+from distributed_model_parallel_tpu.data.registry import ArrayDataset
+from distributed_model_parallel_tpu.mesh import make_mesh
+from distributed_model_parallel_tpu.train.checkpoint import (
+    Checkpointer,
+    TopologyMismatchError,
+)
+from distributed_model_parallel_tpu.train.elastic import (
+    EmergencyCheckpointer,
+    elastic_restore,
+    fit_mesh_to_devices,
+)
+from distributed_model_parallel_tpu.train.trainer import Trainer
+
+from tests.conftest import tiny_train_config
+
+
+def _dataset(n=64, hw=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return ArrayDataset(
+        images=rng.integers(0, 255, (n, hw, hw, 3), dtype=np.uint8),
+        labels=rng.integers(0, 10, n, dtype=np.int32), num_classes=10,
+        mean=np.zeros(3, np.float32), std=np.ones(3, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# BatchLoader: stateless per-epoch order + two-integer resume state
+# ---------------------------------------------------------------------------
+
+def test_epoch_order_independent_of_history():
+    """Replay-after-restart regression: epoch N's batch order must be
+    identical whether or not epochs 0..N-1 were ever iterated (the old
+    loader consumed one rng stream, so a restart reshuffled history)."""
+    ds = _dataset()
+    warm = BatchLoader(ds, 16, shuffle=True, seed=3)
+    for _ in range(2):              # consume epochs 0 and 1
+        list(warm)
+    assert warm.epoch == 2
+    cold = BatchLoader(ds, 16, shuffle=True, seed=3)
+    cold.set_epoch(2)
+    for (xa, ya), (xb, yb) in zip(warm, cold):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+    # and epochs still differ from one another (it IS reshuffling)
+    a = BatchLoader(ds, 16, shuffle=True, seed=3)
+    e0 = a.epoch_indices(0)
+    e1 = a.epoch_indices(1)
+    assert not np.array_equal(e0, e1)
+    np.testing.assert_array_equal(e0, a.epoch_indices(0))  # deterministic
+
+
+def test_loader_state_dict_mid_epoch_resume():
+    ds = _dataset()
+    full = BatchLoader(ds, 16, shuffle=True, seed=7)
+    full.set_epoch(1)
+    batches = list(full)
+    src = BatchLoader(ds, 16, shuffle=True, seed=7)
+    src.position(1, 2)              # consumed 2 of epoch 1's 4 batches
+    sd = src.state_dict()
+    assert sd == {"epoch": 1, "batch_cursor": 2}
+    dst = BatchLoader(ds, 16, shuffle=True, seed=7)
+    dst.load_state_dict(sd)
+    resumed = list(dst)
+    assert len(resumed) == len(batches) - 2
+    for (xa, ya), (xb, yb) in zip(resumed, batches[2:]):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_loader_state_dict_normalizes_epoch_end():
+    ds = _dataset()
+    loader = BatchLoader(ds, 16, shuffle=True, seed=0)
+    loader.position(3, len(loader))
+    assert loader.state_dict() == {"epoch": 4, "batch_cursor": 0}
+    loader.load_state_dict({"epoch": 5, "batch_cursor": len(loader)})
+    assert (loader.epoch, loader.cursor) == (6, 0)
+    with pytest.raises(ValueError, match="invalid loader state"):
+        loader.load_state_dict({"epoch": 0, "batch_cursor": -1})
+    # set_epoch keeps a mid-epoch cursor for the SAME epoch (resume), and
+    # resets it for a different one (fresh epoch / retry-after-restore).
+    loader.load_state_dict({"epoch": 2, "batch_cursor": 1})
+    loader.set_epoch(2)
+    assert loader.cursor == 1
+    loader.set_epoch(3)
+    assert (loader.epoch, loader.cursor) == (3, 0)
+
+
+# ---------------------------------------------------------------------------
+# PrefetchLoader: prompt shutdown + worker-exception propagation
+# ---------------------------------------------------------------------------
+
+def test_prefetch_propagates_worker_exception():
+    class Boom(Exception):
+        pass
+
+    def gen():
+        yield ("a", 1)
+        raise Boom("loader died")
+
+    out = []
+    with pytest.raises(Boom, match="loader died"):
+        for item in PrefetchLoader(gen(), depth=2):
+            out.append(item)
+    assert out == [("a", 1)]        # buffered batches still delivered
+
+
+def test_prefetch_worker_stops_promptly_on_abandon():
+    """A consumer that breaks mid-epoch (the preemption path) must not
+    leave the worker producing forever, and must not block on join."""
+    stopped = threading.Event()
+
+    def endless():
+        try:
+            i = 0
+            while True:
+                yield i
+                i += 1
+        finally:
+            stopped.set()           # GeneratorExit/abandon reached the source
+
+    pl = PrefetchLoader(endless(), depth=2, join_timeout_s=2.0)
+    t0 = time.perf_counter()
+    for item in pl:
+        if item >= 3:
+            break                   # abandon mid-iteration
+    elapsed = time.perf_counter() - t0
+    assert stopped.wait(2.0), "worker kept running after abandon"
+    assert elapsed < 5.0
+    assert not any(th.name == "dmp-prefetch" and th.is_alive()
+                   for th in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# Emergency slot retention + manifest topology stamp
+# ---------------------------------------------------------------------------
+
+def test_emergency_slot_survives_epoch_slot_rotation(tmp_path):
+    """Keep-K garbage collection is per-slot: rotating the epoch slots can
+    never delete the emergency slot (and vice versa)."""
+    ckpt = Checkpointer(str(tmp_path / "ck"), keep=2)
+    tree = {"w": jnp.arange(4.0)}
+    emergency = EmergencyCheckpointer(ckpt, "emergency", 1)
+    emergency.after_step(1, lambda: tree)
+    for _ in range(5):              # heavy epoch-slot churn
+        ckpt.save(tree, "ckpt")
+        ckpt.save(tree, "good")
+    assert ckpt.exists("emergency")
+    # the epoch slot's own rotation ran (prune happens at the NEXT save,
+    # so keep=2 leaves at most 3 committed versions on disk)...
+    assert ckpt._versions("ckpt") == [2, 3, 4]
+    assert ckpt._versions("emergency") == [0]    # ...and never touched it
+    # the emergency slot rotates itself (keep=2) and leaves "ckpt" alone
+    for _ in range(4):
+        emergency.after_step(1, lambda: tree)
+    assert ckpt._versions("emergency") == [2, 3, 4]
+    assert ckpt._versions("ckpt") == [2, 3, 4]
+
+
+def test_manifest_meta_stamps_mesh_and_step(tmp_path):
+    calls = {"step": 17}
+    ckpt = Checkpointer(
+        str(tmp_path / "ck"),
+        meta_fn=lambda: {"mesh": {"data": 8}, "global_step": calls["step"]})
+    ckpt.save({"w": jnp.ones(3)}, "ckpt")
+    meta = ckpt.manifest_meta("ckpt")
+    assert meta["mesh"] == {"data": 8}
+    assert meta["global_step"] == 17
+    assert ckpt.manifest_meta("absent") == {}
+
+
+# ---------------------------------------------------------------------------
+# Cross-topology restore (satellite: dp=8 -> dp=4 -> dp=2 + typed error)
+# ---------------------------------------------------------------------------
+
+def _topology_tree(spec):
+    return {
+        "replicated": jax.device_put(jnp.arange(12.0).reshape(3, 4),
+                                     NamedSharding(spec.mesh, P())),
+        "batch_sharded": jax.device_put(
+            jnp.arange(64.0).reshape(8, 8),
+            NamedSharding(spec.mesh, P("data"))),
+        # FSDP/ZeRO leaf: sharded over data on a non-leading dim
+        "fsdp": jax.device_put(jnp.arange(128.0).reshape(16, 8),
+                               NamedSharding(spec.mesh, P(None, "data"))),
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+@pytest.mark.parametrize("dp", [4, 2])
+def test_restore_resharded_smaller_mesh(tmp_path, mesh8, dp):
+    tree = _topology_tree(mesh8)
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(tree, "ckpt")
+    small = make_mesh(MeshConfig(data=dp), devices=jax.devices()[:dp])
+    target = {
+        "replicated": jax.device_put(jnp.zeros((3, 4)),
+                                     NamedSharding(small.mesh, P())),
+        "batch_sharded": jax.device_put(
+            jnp.zeros((8, 8)), NamedSharding(small.mesh, P("data"))),
+        "fsdp": jax.device_put(jnp.zeros((16, 8)),
+                               NamedSharding(small.mesh, P(None, "data"))),
+        "step": jnp.asarray(0, jnp.int32),
+    }
+    out = ckpt.restore_resharded(target, "ckpt")
+    for key in ("replicated", "batch_sharded", "fsdp"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(tree[key]))
+        assert out[key].sharding == target[key].sharding  # NEW mesh
+    assert int(out["step"]) == 7
+
+
+def test_restore_resharded_true_shape_conflict_typed_error(tmp_path, mesh8):
+    """State whose GLOBAL shape encodes the saving topology (DDP
+    per-replica BN stats: leading axis = num_replicas) cannot be resharded
+    — a typed error naming both shapes, not an orbax stack trace."""
+    per_replica = jax.device_put(jnp.arange(8.0 * 3).reshape(8, 3),
+                                 NamedSharding(mesh8.mesh, P("data")))
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save({"bn": per_replica, "w": jnp.ones(4)}, "ckpt")
+    small = make_mesh(MeshConfig(data=4), devices=jax.devices()[:4])
+    target = {"bn": jax.device_put(jnp.zeros((4, 3)),
+                                   NamedSharding(small.mesh, P("data"))),
+              "w": jnp.ones(4)}
+    with pytest.raises(TopologyMismatchError) as ei:
+        ckpt.restore_resharded(target, "ckpt")
+    assert "(8, 3)" in str(ei.value) and "(4, 3)" in str(ei.value)
+    assert ei.value.conflicts == [("bn", (8, 3), (4, 3))]
+    # and it is NOT a ValueError (the trainers' layout-retry loops must
+    # let it propagate instead of misreading it as an EMA-layout miss)
+    assert not isinstance(ei.value, ValueError)
+
+
+def test_elastic_restore_falls_back_past_torn_slot(tmp_path):
+    from distributed_model_parallel_tpu.utils.faults import tear_checkpoint
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save({"w": jnp.zeros(4), "tag": jnp.asarray(1, jnp.int32)}, "good")
+    time.sleep(0.05)
+    ckpt.save({"w": jnp.ones(4), "tag": jnp.asarray(2, jnp.int32)},
+              "emergency")
+    tear_checkpoint(str(tmp_path / "ck" / "emergency-0"))
+    tmpl = {"w": jnp.zeros(4), "tag": jnp.asarray(0, jnp.int32)}
+    fallbacks = []
+    name, restored = elastic_restore(
+        ckpt, (tmpl,), ("good", "emergency"),
+        on_fallback=lambda p, r: fallbacks.append(r))
+    assert name == "good"           # newest slot fully torn -> next slot
+    assert int(restored["tag"]) == 1
+    assert fallbacks                # the tear was observed, not skipped
+
+
+def test_elastic_restore_legacy_template_on_manifestless_slot(tmp_path):
+    """On a manifest-less version (pre-manifest checkpoint, async save
+    killed before its manifest) a template mismatch is indistinguishable
+    from a tear — elastic_restore must still try the LEGACY templates
+    instead of writing the slot off after the first layout fails."""
+    import os
+
+    from distributed_model_parallel_tpu.train.checkpoint import (
+        MANIFEST_FILENAME,
+    )
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save({"w": jnp.arange(4.0)}, "lm")      # legacy layout: no extras
+    os.remove(str(tmp_path / "ck" / "lm-0" / MANIFEST_FILENAME))
+    modern = {"w": jnp.zeros(4), "resume": {"global_step": jnp.zeros(
+        (), jnp.int32)}}
+    legacy = {"w": jnp.zeros(4)}
+    name, restored = elastic_restore(ckpt, (modern, legacy), ("lm",))
+    assert name == "lm"
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(4.0))
+
+
+def test_elastic_restore_structural_mismatch_raises(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save({"w": jnp.zeros(4)}, "ckpt")
+    with pytest.raises(ValueError, match="resume template"):
+        elastic_restore(ckpt, ({"nope": jnp.zeros(2)},), ("ckpt",))
+    with pytest.raises(FileNotFoundError):
+        elastic_restore(ckpt, ({"w": jnp.zeros(4)},), ("absent",))
+
+
+# ---------------------------------------------------------------------------
+# fit_mesh_to_devices
+# ---------------------------------------------------------------------------
+
+def test_fit_mesh_to_devices():
+    cfg, d = fit_mesh_to_devices(MeshConfig(data=8), 4, batch_size=32)
+    assert cfg.data == 4 and d.changed
+    cfg, d = fit_mesh_to_devices(MeshConfig(data=4), 8, batch_size=32)
+    assert cfg.data == 4 and not d.changed      # never grows past request
+    # batch divisibility: 6 devices but 32 % 6 != 0 -> 4
+    cfg, _ = fit_mesh_to_devices(MeshConfig(data=8), 6, batch_size=32)
+    assert cfg.data == 4
+    # non-data axes are not elastic
+    with pytest.raises(ValueError, match="not elastic"):
+        fit_mesh_to_devices(MeshConfig(data=1, stage=8), 4)
+    # dcn factor dropped when it no longer divides the resolved degree
+    cfg, _ = fit_mesh_to_devices(MeshConfig(data=8, dcn_data=4), 4,
+                                 batch_size=32)
+    assert cfg.data == 4 and cfg.dcn_data == 4
+    cfg, _ = fit_mesh_to_devices(MeshConfig(data=8, dcn_data=4), 2,
+                                 batch_size=32)
+    assert cfg.data == 2 and cfg.dcn_data == 1
+
+
+def test_restore_budgets_clamped():
+    from distributed_model_parallel_tpu.train.logging_util import RunLogger
+    from distributed_model_parallel_tpu.train.resilience import (
+        RecoverySupervisor,
+    )
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        sup = RecoverySupervisor(
+            RecoveryConfig(max_retries=2), logger=RunLogger(d, "t"),
+            ckpt=None, preemption=None)
+        sup.restore_budgets(5, 0.25)     # checkpoint from a looser config
+        assert sup.retries_left == 2     # clamped to THIS run's budget
+        assert sup.lr_scale == 0.25
+        sup.restore_budgets(1, 1.0)
+        assert sup.retries_left == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: kill mid-epoch, resume exactly (same mesh and halved dp)
+# ---------------------------------------------------------------------------
+
+def _preempt_cfg(tmp_path, name, **kw):
+    base = dict(epochs=2, mesh=MeshConfig(data=4),
+                max_inflight_steps=1, log_every_n_steps=1000,
+                checkpoint_dir=str(tmp_path / f"ckpt_{name}"),
+                log_name=name)
+    base.update(kw)
+    return tiny_train_config(tmp_path, **base)
+
+
+def _params_equal(a, b):
+    la, lb = jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(
+        jax.device_get(b))
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def test_trainer_mid_epoch_kill_resume_bitwise_parity(tmp_path):
+    """The headline property: preempt mid-epoch, restart, and the final
+    params are bitwise-identical to a never-interrupted run — no batch
+    replayed, no batch skipped, same augmentation rng stream."""
+    baseline = Trainer(_preempt_cfg(tmp_path, "base"))
+    baseline.fit()
+
+    killed = Trainer(_preempt_cfg(
+        tmp_path, "kill",
+        emergency_every=2,
+        recovery=RecoveryConfig(faults=("preempt@4",))))
+    killed.fit()
+    # 96/32 = 3 steps/epoch; preempt@4 fires after the 5th step: mid epoch 1
+    assert killed.train_loader.state_dict() == {"epoch": 1,
+                                                "batch_cursor": 2}
+    assert killed._global_step == 5
+    assert killed.ckpt.exists("preempt")
+    assert killed.emergency.saves == 2          # cadence-2 saves rode along
+
+    resumed = Trainer(_preempt_cfg(tmp_path, "kill", resume=True))
+    assert resumed.train_loader.cursor == 2
+    assert resumed._global_step == 5
+    assert resumed.start_epoch == 1
+    hist = resumed.fit()
+    assert [h["epoch"] for h in hist] == [1]
+    assert int(jax.device_get(resumed.state.step)) == 6
+    assert _params_equal(baseline.state.params, resumed.state.params)
+    # the resume is on the telemetry timeline
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+    recs = read_records(resumed.logger.jsonl_path)
+    res = [r for r in recs if r.get("kind") == "resume"]
+    assert res and res[0]["slot"] == "preempt" \
+        and res[0]["global_step"] == 5
+
+
+def test_trainer_resume_on_halved_mesh_exact_step(tmp_path):
+    """Restart on half the dp degree: resharded restore, continuation at
+    the exact global step, nothing replayed or skipped."""
+    killed = Trainer(_preempt_cfg(
+        tmp_path, "halve", recovery=RecoveryConfig(faults=("preempt@4",))))
+    killed.fit()
+    resumed = Trainer(_preempt_cfg(tmp_path, "halve", resume=True,
+                                   mesh=MeshConfig(data=2)))
+    assert resumed._global_step == 5
+    assert resumed.train_loader.state_dict() == {"epoch": 1,
+                                                 "batch_cursor": 2}
+    resumed.fit()
+    assert int(jax.device_get(resumed.state.step)) == 6   # 5 + exactly 1
+    assert resumed._global_step == 6
+    # params landed in the dp=2 mesh's shardings
+    leaf = jax.tree.leaves(resumed.state.params)[0]
+    assert leaf.sharding.mesh.shape["data"] == 2
+
+
+def test_trainer_device_resident_mid_epoch_resume(tmp_path):
+    """The K-steps-per-dispatch fast path resumes at a dispatch boundary
+    with identical math (dispatch-aligned cursor, stateless per-dispatch
+    rng)."""
+    kw = dict(device_resident_data=True, steps_per_dispatch=2)
+    baseline = Trainer(_preempt_cfg(tmp_path, "dr_base", **kw))
+    baseline.fit()
+    killed = Trainer(_preempt_cfg(
+        tmp_path, "dr_kill",
+        recovery=RecoveryConfig(faults=("preempt@2",)), **kw))
+    killed.fit()
+    # dispatches per epoch: [0,1],[2]; preempt@2 fires after the 3rd
+    # dispatch = after epoch 1's first [0,1] (5 steps total, cursor 2)
+    assert killed.train_loader.state_dict() == {"epoch": 1,
+                                                "batch_cursor": 2}
+    assert killed._global_step == 5
+    resumed = Trainer(_preempt_cfg(tmp_path, "dr_kill", resume=True, **kw))
+    resumed.fit()
+    assert int(jax.device_get(resumed.state.step)) == 6
+    assert _params_equal(baseline.state.params, resumed.state.params)
+
+
+def test_lm_mid_epoch_kill_resume_bitwise_parity(tmp_path):
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+        LMTrainer,
+    )
+
+    def cfg(name, **kw):
+        return LMTrainConfig(
+            model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                    n_layers=2, d_ff=64, max_seq_len=16),
+            mesh=MeshConfig(data=2), batch_size=4, seq_len=16,
+            steps_per_epoch=3, epochs=2, n_tokens=2000,
+            log_dir=str(tmp_path / "log"), log_name=name,
+            checkpoint_dir=str(tmp_path / f"ckpt_{name}"), **kw)
+
+    baseline = LMTrainer(cfg("base"))
+    baseline.fit()
+    killed = LMTrainer(cfg("kill", emergency_every=2,
+                           recovery=RecoveryConfig(faults=("preempt@4",))))
+    killed.fit()
+    assert (killed._pos_epoch, killed._pos_step) == (1, 2)
+    assert killed._global_step == 5
+    resumed = LMTrainer(cfg("kill", resume=True))
+    assert (resumed._pos_epoch, resumed._pos_step) == (1, 2)
+    assert resumed._global_step == 5
+    hist = resumed.fit()
+    assert [h["epoch"] for h in hist] == [1]
+    assert resumed._global_step == 6
+    assert _params_equal(baseline.params, resumed.params)
+
+
+def test_pipeline_mid_epoch_kill_resume_bitwise_parity(tmp_path):
+    from distributed_model_parallel_tpu.train.pipeline_trainer import (
+        PipelineTrainer,
+    )
+
+    def cfg(name, **kw):
+        return tiny_train_config(
+            tmp_path, epochs=2, mesh=MeshConfig(data=1, stage=4),
+            num_microbatches=2, max_inflight_steps=1,
+            checkpoint_dir=str(tmp_path / f"ckpt_{name}"),
+            log_name=name, **kw)
+
+    baseline = PipelineTrainer(cfg("base"))
+    baseline.fit()
+    killed = PipelineTrainer(cfg(
+        "kill", recovery=RecoveryConfig(faults=("preempt@4",))))
+    killed.fit()
+    assert killed.train_loader.state_dict() == {"epoch": 1,
+                                                "batch_cursor": 2}
+    resumed = PipelineTrainer(cfg("kill", resume=True))
+    assert resumed.train_loader.cursor == 2
+    assert resumed._global_step == 5
+    resumed.fit()
+    assert resumed._global_step == 6
+    assert _params_equal(baseline.runner.merged_params(),
+                         resumed.runner.merged_params())
+
+
+def test_trainer_elastic_flag_refits_mesh(tmp_path):
+    """TrainConfig.elastic shrinks an over-sized data axis to what the
+    live devices support instead of failing mesh construction."""
+    cfg = _preempt_cfg(tmp_path, "elastic", epochs=1,
+                       mesh=MeshConfig(data=64), elastic=True)
+    t = Trainer(cfg)
+    assert t.config.mesh.data == 8      # the 8 virtual CPU devices
+    assert t.elastic_decision is not None and t.elastic_decision.changed
+
+
+@pytest.mark.chaos
+def test_chaos_preempt_drill(tmp_path):
+    """The executable recipe: scripts/dmp_chaos.py preempt must exit 0
+    (kill-and-resume parity + halved-dp exact continuation)."""
+    from scripts.dmp_chaos import main
+
+    assert main(["--scenario", "preempt",
+                 "--workdir", str(tmp_path)]) == 0
