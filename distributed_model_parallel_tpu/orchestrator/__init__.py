@@ -1,0 +1,40 @@
+"""Multi-tenant run orchestration over a shared device pool.
+
+Composes the robustness stack (fault injection, recovery supervisor,
+consistency sentinel, elastic resume — PRs 2-4) into the scenario it was
+built for: many concurrent heterogeneous jobs (CNN, LM/MoE, pipeline) on
+one device fleet, with per-job priorities, admission control, and
+priority preemption. Preempting a job goes through the real
+preempt/emergency checkpoint machinery (train/preemption.py,
+train/elastic.py); rescheduling it onto whatever slice is free goes
+through ``fit_mesh_to_devices`` + ``restore_resharded`` — elastic resume
+as the scheduling substrate, not a manual recovery path.
+
+``scripts/dmp_soak.py`` drives a seeded chaos-soak campaign on top of
+this package; ``scripts/dmp_report.py --fleet`` renders the merged
+tenant telemetry.
+"""
+
+from distributed_model_parallel_tpu.orchestrator.scheduler import (
+    DevicePool,
+    Scheduler,
+)
+from distributed_model_parallel_tpu.orchestrator.tenants import (
+    Tenant,
+    TenantSpec,
+    TenantState,
+)
+from distributed_model_parallel_tpu.orchestrator.orchestrator import (
+    Orchestrator,
+    UnschedulableError,
+)
+
+__all__ = [
+    "DevicePool",
+    "Orchestrator",
+    "Scheduler",
+    "Tenant",
+    "TenantSpec",
+    "TenantState",
+    "UnschedulableError",
+]

@@ -1,0 +1,352 @@
+"""The multi-tenant control loop: admission, stepping, preemption, reap.
+
+One :class:`Orchestrator` owns a :class:`~.scheduler.DevicePool` over the
+visible devices and drives every submitted :class:`~.tenants.Tenant`
+through its lifecycle:
+
+    submit -> QUEUED -> (admission: exact slice granted, trainer built,
+    possibly resumed/resharded) -> RUNNING -> step grants in deterministic
+    round-robin -> {COMPLETED | PREEMPTING -> re-queued | FAILED}
+
+Scheduling decisions happen only between settled states: tenants advance
+one at a time (``Tenant.grant_steps`` is synchronous), so a fixed
+submission order + seeds replays the identical campaign — the property
+the chaos-soak's determinism rests on.
+
+The orchestrator writes its own fleet-level telemetry stream
+(``fleet.jsonl``: typed ``tenant`` records for every lifecycle event,
+``event`` records for topology changes) next to the per-tenant streams
+the trainers write; ``utils/telemetry.merge_streams`` +
+``scripts/dmp_report.py --fleet`` join them into one report.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Sequence
+
+from distributed_model_parallel_tpu.orchestrator.scheduler import (
+    DevicePool,
+    Scheduler,
+)
+from distributed_model_parallel_tpu.orchestrator.tenants import (
+    Tenant,
+    TenantSpec,
+    TenantState,
+)
+from distributed_model_parallel_tpu.utils.telemetry import TelemetryRun
+
+__all__ = ["Orchestrator", "UnschedulableError"]
+
+
+class UnschedulableError(RuntimeError):
+    """The queue cannot make progress: tenants are waiting, nothing is
+    running or draining, and no admission is possible (e.g. a pipeline
+    tenant needs more devices than the shrunken pool has)."""
+
+
+class Orchestrator:
+    """Runs many heterogeneous training jobs on a shared device fleet.
+
+    ``quantum`` is the number of train steps granted per RUNNING tenant
+    per round — the fairness knob, not a correctness one (every trainer
+    checkpoint carries its exact position regardless of where the
+    quantum falls).
+    """
+
+    def __init__(self, devices: Sequence | None = None, *,
+                 workdir: str = "./orchestrator",
+                 quantum: int = 2,
+                 max_stagnant_rounds: int = 50):
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        self.pool = DevicePool(devices)
+        self.scheduler = Scheduler(self.pool)
+        self.quantum = max(1, int(quantum))
+        self.max_stagnant_rounds = max_stagnant_rounds
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.telemetry = TelemetryRun(
+            os.path.join(workdir, "fleet.jsonl"), run="fleet",
+            meta={"n_devices": len(self.pool.devices)})
+        self.tenants: dict[str, Tenant] = {}
+        self.rounds = 0
+        self._seq = 0
+        self._admit_seq = 0
+        # Every (tenant, device-ids) grant ever made, for the
+        # no-overlap/auditing tests and the fleet summary.
+        self.assignment_log: list[dict] = []
+
+    # -- bookkeeping ----------------------------------------------------------
+    def _record(self, tenant: Tenant, event: str, **fields) -> None:
+        self.telemetry.record("tenant", name=tenant.name, event=event,
+                              priority=tenant.priority, round=self.rounds,
+                              **fields)
+
+    def _by_state(self, *states: TenantState) -> list[Tenant]:
+        return sorted((t for t in self.tenants.values()
+                       if t.state in states), key=lambda t: t.seq)
+
+    # -- submission / churn ---------------------------------------------------
+    def submit(self, spec: TenantSpec) -> Tenant:
+        if spec.name in self.tenants:
+            raise ValueError(f"tenant name {spec.name!r} already submitted")
+        log_key = (spec.config.log_dir, spec.config.log_name)
+        for other in self.tenants.values():
+            if other.spec.config.checkpoint_dir == spec.config.checkpoint_dir:
+                raise ValueError(
+                    f"tenant {spec.name!r} shares checkpoint_dir "
+                    f"{spec.config.checkpoint_dir!r} with "
+                    f"{other.name!r} — slots would clobber each other")
+            if (other.spec.config.log_dir,
+                    other.spec.config.log_name) == log_key:
+                raise ValueError(
+                    f"tenant {spec.name!r} shares telemetry stream "
+                    f"{os.path.join(*log_key)}.jsonl with {other.name!r} — "
+                    f"two tenants appending to one stream would merge "
+                    f"under mixed attribution")
+        tenant = Tenant(spec, self._seq)
+        self._seq += 1
+        self.tenants[spec.name] = tenant
+        self._record(tenant, "submitted", workload=spec.workload)
+        return tenant
+
+    def cancel(self, name: str) -> None:
+        """Tenant churn: withdraw a job. Queued jobs drop immediately; a
+        running job is preempted (its checkpoint survives for a later
+        campaign) and not re-queued."""
+        tenant = self.tenants[name]
+        if tenant.state is TenantState.QUEUED:
+            tenant.state = TenantState.CANCELLED
+            self._record(tenant, "cancelled")
+        elif tenant.state in (TenantState.RUNNING, TenantState.PREEMPTING):
+            tenant._cancel_on_reap = True
+            self._preempt(tenant, reason="cancelled")
+
+    # -- preemption -----------------------------------------------------------
+    def preempt(self, name: str, *, reason: str = "manual") -> None:
+        """Operator-initiated preemption of a running tenant (the
+        scheduler's priority preemptions and topology shrinks route
+        through the same path). The tenant drains through its preempt
+        checkpoint on the next round and re-queues for resumption."""
+        tenant = self.tenants[name]
+        if tenant.state not in (TenantState.RUNNING,
+                                TenantState.PREEMPTING):
+            raise ValueError(f"tenant {name!r} is {tenant.state.value}, "
+                             f"not running")
+        self._preempt(tenant, reason=reason)
+
+    def _preempt(self, tenant: Tenant, *, reason: str) -> None:
+        if tenant.state is TenantState.PREEMPTING:
+            return
+        tenant.preemptions += 1
+        tenant.request_preemption()
+        self._record(tenant, "preempt-requested", reason=reason,
+                     global_step=tenant.global_step)
+
+    # -- topology churn -------------------------------------------------------
+    def shrink(self, n: int) -> tuple[int, ...]:
+        """Topology shrink: take ``n`` devices out of service. Tenants
+        holding a revoked device are preempted; re-admission refits them
+        to whatever remains (``fit_mesh_to_devices`` + resharded
+        restore)."""
+        ids = self.pool.revoke(n)
+        self.telemetry.record("event",
+                              message=f"topology shrink: revoked {ids}")
+        for name in self.pool.holders_of_revoked():
+            self._preempt(self.tenants[name], reason="topology-shrink")
+        return ids
+
+    def grow(self, n: int | None = None) -> tuple[int, ...]:
+        """Topology grow: return revoked devices to service."""
+        ids = self.pool.restore(n)
+        if ids:
+            self.telemetry.record("event",
+                                  message=f"topology grow: restored {ids}")
+        return ids
+
+    # -- the control loop -----------------------------------------------------
+    def _admit(self) -> int:
+        """Serve the queue in (priority desc, submission order): grant
+        free slices, or arrange preemptions for strictly-lower-priority
+        victims. Head-of-line blocking — see scheduler.py. Returns how
+        many tenants were admitted."""
+        admitted = 0
+        queue = sorted(self._by_state(TenantState.QUEUED),
+                       key=lambda t: (-t.priority, t.seq))
+        running = self._by_state(TenantState.RUNNING, TenantState.PREEMPTING)
+        for waiter in queue:
+            n = self.scheduler.resolve_slice(waiter.spec, self.pool.n_free)
+            if n is not None:
+                devices = self.pool.assign(waiter.name, n)
+                granted = self.pool.assigned_ids(waiter.name)
+                # Hard no-overlap invariant, independently of pool
+                # internals: the grant must be disjoint from every other
+                # live assignment.
+                for other, ids in self.pool.assignments().items():
+                    if other != waiter.name and set(ids) & set(granted):
+                        raise RuntimeError(
+                            f"device overlap: {waiter.name!r} granted "
+                            f"{granted} while {other!r} holds {ids}")
+                waiter.start(devices, self._admit_seq)
+                self._admit_seq += 1
+                self.assignment_log.append(
+                    {"round": self.rounds, "tenant": waiter.name,
+                     "devices": granted, "attempt": waiter.attempts})
+                self._record(waiter, "admitted", devices=list(granted),
+                             attempt=waiter.attempts)
+                # Settle construction (and any resume/reshard) before the
+                # next scheduling decision; a construction that dies
+                # immediately is reaped this same round.
+                waiter.wait_boundary()
+                admitted += 1
+                continue
+            victims = self.scheduler.pick_victims(waiter, running)
+            if victims:
+                for v in victims:
+                    self._preempt(v, reason=f"priority:{waiter.name}")
+            # Whether drains are pending or the waiter is simply too big
+            # right now: hold the line so later (lower-priority) arrivals
+            # can't steal the devices it is waiting for.
+            break
+        return admitted
+
+    def _reap(self) -> None:
+        """Collect finished tenant threads: free their devices and route
+        the outcome — completed, preempted (re-queue with resume), or
+        failed (the unrecovered ledger)."""
+        for tenant in self._by_state(TenantState.RUNNING,
+                                     TenantState.PREEMPTING):
+            if tenant.alive:
+                continue
+            tenant.join()
+            ids = self.pool.release(tenant.name)
+            tenant.devices = ()
+            if tenant.outcome == "failed":
+                tenant.state = TenantState.FAILED
+                self._record(tenant, "failed", devices=list(ids),
+                             error=f"{type(tenant.error).__name__}: "
+                                   f"{tenant.error}"[:300])
+            elif tenant.outcome == "completed":
+                tenant.state = TenantState.COMPLETED
+                self._record(tenant, "completed", devices=list(ids),
+                             global_step=tenant.global_step,
+                             attempts=tenant.attempts)
+            else:                   # preempted — checkpointed, resumable
+                if tenant.state is TenantState.RUNNING:
+                    # Self-preemption: an injected preempt fault or a
+                    # stall-watchdog escalation inside the tenant, not an
+                    # orchestrator decision — count it the same.
+                    tenant.preemptions += 1
+                tenant.preempted_at_step = tenant.global_step
+                if getattr(tenant, "_cancel_on_reap", False):
+                    tenant.state = TenantState.CANCELLED
+                    self._record(tenant, "cancelled", devices=list(ids),
+                                 global_step=tenant.global_step)
+                else:
+                    tenant.state = TenantState.QUEUED
+                    self._record(tenant, "preempted", devices=list(ids),
+                                 global_step=tenant.global_step)
+
+    def pending(self) -> bool:
+        return any(t.state in (TenantState.QUEUED, TenantState.RUNNING,
+                               TenantState.PREEMPTING)
+                   for t in self.tenants.values())
+
+    def run_round(self) -> bool:
+        """One scheduling round: admit, advance every running tenant by
+        the quantum (admission order — deterministic), reap. Returns
+        whether any tenant advanced or changed state."""
+        before = {n: t.state for n, t in self.tenants.items()}
+        admitted = self._admit()
+        moved = admitted > 0
+        for tenant in sorted(self._by_state(TenantState.RUNNING,
+                                            TenantState.PREEMPTING),
+                             key=lambda t: t.admit_seq):
+            if tenant.state is TenantState.PREEMPTING:
+                tenant.drain()
+                moved = True
+            elif tenant.alive:
+                tenant.grant_steps(self.quantum)
+                moved = True
+        self._reap()
+        self.rounds += 1
+        after = {n: t.state for n, t in self.tenants.items()}
+        return moved or after != before
+
+    def run(self, *, on_round: Callable[["Orchestrator", int], None]
+            | None = None, max_rounds: int | None = None) -> dict:
+        """Drive rounds until every tenant reaches a terminal state.
+
+        ``on_round(orchestrator, round_index)`` fires before each round —
+        the chaos-soak campaign's injection point for topology churn and
+        late tenant submissions. Raises :class:`UnschedulableError` when
+        the queue stagnates (nothing running, nothing admissible) and
+        RuntimeError past ``max_rounds``.
+        """
+        stagnant = 0
+        while self.pending():
+            if max_rounds is not None and self.rounds >= max_rounds:
+                raise RuntimeError(
+                    f"orchestrator exceeded {max_rounds} rounds with "
+                    f"tenants still pending: "
+                    f"{[t.name for t in self._by_state(TenantState.QUEUED, TenantState.RUNNING, TenantState.PREEMPTING)]}")
+            if on_round is not None:
+                on_round(self, self.rounds)
+            if self.run_round():
+                stagnant = 0
+            else:
+                stagnant += 1
+                if stagnant > self.max_stagnant_rounds:
+                    waiting = [t.name for t in
+                               self._by_state(TenantState.QUEUED)]
+                    raise UnschedulableError(
+                        f"no progress for {stagnant} rounds; queued "
+                        f"tenants {waiting} cannot be placed on "
+                        f"{self.pool.n_free} free devices "
+                        f"(revoked: {self.pool.revoked_ids})")
+        return self.summary()
+
+    # -- results --------------------------------------------------------------
+    def telemetry_paths(self) -> list[str]:
+        """Every telemetry stream of this campaign: the fleet stream plus
+        one per tenant (deduplicated — a resumed tenant appends to the
+        same stream)."""
+        paths = [self.telemetry.path]
+        for t in sorted(self.tenants.values(), key=lambda t: t.seq):
+            if t.jsonl_path and t.jsonl_path not in paths:
+                paths.append(t.jsonl_path)
+        return paths
+
+    def summary(self) -> dict:
+        """Fleet outcome: per-tenant states, preemption/resume exactness
+        accounting, and the unrecovered-failure ledger."""
+        tenants = {}
+        for t in sorted(self.tenants.values(), key=lambda t: t.seq):
+            tenants[t.name] = {
+                "workload": t.spec.workload,
+                "priority": t.priority,
+                "state": t.state.value,
+                "attempts": t.attempts,
+                "preemptions": t.preemptions,
+                "resumed_exact_step": t.resume_exact,
+                "resume_fallbacks": t.resume_fallbacks,
+                "global_step": t.global_step,
+                "faults_injected": [s.kind for s in t.fired_faults],
+            }
+        failed = {t.name: f"{type(t.error).__name__}: {t.error}"[:300]
+                  for t in self.tenants.values()
+                  if t.state is TenantState.FAILED}
+        return {
+            "rounds": self.rounds,
+            "tenants": tenants,
+            "unrecovered": failed,
+            "all_resumes_exact": all(
+                all(t.resume_exact) for t in self.tenants.values()),
+            "assignments": self.assignment_log,
+        }
+
+    def close(self, **fields) -> None:
+        self.telemetry.finish(**fields)

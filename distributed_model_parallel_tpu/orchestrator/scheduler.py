@@ -1,0 +1,198 @@
+"""Device-pool admission control + priority preemption.
+
+The policy half of the orchestrator: which queued tenant gets which
+devices, and who gets preempted to make room. Everything here is pure
+deterministic bookkeeping — no threads, no JAX — so a fixed submission
+order replays the identical schedule (the property
+tests/test_orchestrator.py pins).
+
+Placement rules:
+
+* a tenant's granted slice is EXACTLY the devices its resolved mesh
+  needs (``fit_mesh_to_devices`` shrinks the data axis to what the free
+  pool and batch divisibility allow; non-data axes, and the pipeline
+  stage count, are not elastic);
+* slices never overlap — the pool hands out each device to at most one
+  tenant, and :meth:`DevicePool.assign` enforces it with a hard check;
+* queued tenants are served in (priority desc, submission order) with
+  head-of-line blocking: when the front tenant cannot be placed, nothing
+  behind it is — a lower-priority late arrival must not steal the
+  devices a draining preemption is about to free;
+* preemption is chosen lowest-priority-first (newest admission first
+  within a priority), only from strictly lower-priority victims, and
+  only when the freed devices actually make the waiter schedulable —
+  no pointless churn.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from distributed_model_parallel_tpu.orchestrator.tenants import (
+    Tenant,
+    TenantSpec,
+    TenantState,
+)
+
+__all__ = ["DevicePool", "Scheduler"]
+
+
+class DevicePool:
+    """Ownership ledger for the fleet's devices.
+
+    ``revoke``/``restore`` model topology shrink/grow (a maintenance
+    event taking a sub-slice away and giving it back): revoked devices
+    exist but are not schedulable. Devices are keyed by ``id`` so the
+    ledger is printable and test-assertable.
+    """
+
+    def __init__(self, devices: Sequence):
+        self.devices = tuple(devices)
+        if not self.devices:
+            raise ValueError("device pool needs at least one device")
+        self._free = [d.id for d in self.devices]
+        self._revoked: list[int] = []
+        self._assigned: dict[str, tuple[int, ...]] = {}
+        self._by_id = {d.id: d for d in self.devices}
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def free_ids(self) -> tuple[int, ...]:
+        return tuple(sorted(self._free))
+
+    @property
+    def revoked_ids(self) -> tuple[int, ...]:
+        return tuple(sorted(self._revoked))
+
+    def assigned_ids(self, tenant: str) -> tuple[int, ...]:
+        return self._assigned.get(tenant, ())
+
+    def assignments(self) -> dict[str, tuple[int, ...]]:
+        return dict(self._assigned)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    # -- transitions ---------------------------------------------------------
+    def assign(self, tenant: str, n: int) -> tuple:
+        """Grant ``n`` free devices (lowest ids first — deterministic) to
+        ``tenant``. Raises when the pool cannot satisfy the request or
+        the tenant already holds a slice (overlap would be a scheduling
+        bug, not a recoverable condition)."""
+        if tenant in self._assigned:
+            raise RuntimeError(f"tenant {tenant!r} already holds devices "
+                               f"{self._assigned[tenant]}")
+        if n > len(self._free):
+            raise RuntimeError(
+                f"cannot grant {n} devices to {tenant!r}: only "
+                f"{len(self._free)} free")
+        grant = sorted(self._free)[:n]
+        self._free = [i for i in self._free if i not in grant]
+        self._assigned[tenant] = tuple(grant)
+        return tuple(self._by_id[i] for i in grant)
+
+    def release(self, tenant: str) -> tuple[int, ...]:
+        """Return a tenant's slice to the pool (preemption drained or job
+        finished). Devices revoked while held go to the revoked set, not
+        the free list."""
+        ids = self._assigned.pop(tenant, ())
+        for i in ids:
+            if i in self._revoked:
+                continue            # revoked mid-hold: stays out of service
+            self._free.append(i)
+        return ids
+
+    def revoke(self, n: int) -> tuple[int, ...]:
+        """Take ``n`` devices out of service (topology shrink). Free
+        devices go first (highest ids first, so low-id grants stay
+        stable); if that is not enough, the remainder is marked revoked
+        in place — the scheduler must preempt the holders and their
+        release will not re-free the revoked ids."""
+        out: list[int] = []
+        free_take = sorted(self._free, reverse=True)[:n]
+        self._free = [i for i in self._free if i not in free_take]
+        out += free_take
+        if len(out) < n:
+            held = sorted((i for ids in self._assigned.values() for i in ids
+                           if i not in self._revoked), reverse=True)
+            out += held[:n - len(out)]
+        if len(out) < n:
+            raise ValueError(
+                f"cannot revoke {n} devices: pool has "
+                f"{len(self.devices) - len(self._revoked)} in service")
+        self._revoked += out
+        return tuple(sorted(out))
+
+    def restore(self, n: int | None = None) -> tuple[int, ...]:
+        """Return revoked devices to service (topology grow); ids still
+        held by a tenant are un-revoked in place. ``None`` restores all."""
+        n = len(self._revoked) if n is None else min(n, len(self._revoked))
+        back = sorted(self._revoked)[:n]
+        self._revoked = [i for i in self._revoked if i not in back]
+        held = {i for ids in self._assigned.values() for i in ids}
+        for i in back:
+            if i not in held:
+                self._free.append(i)
+        return tuple(back)
+
+    def holders_of_revoked(self) -> list[str]:
+        """Tenants currently holding a revoked device — the ones a shrink
+        must preempt."""
+        rev = set(self._revoked)
+        return sorted(t for t, ids in self._assigned.items()
+                      if rev & set(ids))
+
+
+class Scheduler:
+    """Deterministic placement policy over a :class:`DevicePool`."""
+
+    def __init__(self, pool: DevicePool):
+        self.pool = pool
+
+    # -- placement -----------------------------------------------------------
+    def resolve_slice(self, spec: TenantSpec, n_free: int) -> int | None:
+        """How many devices ``spec`` would take from an ``n_free`` pool:
+        the resolved mesh size after shrinking the data axis to fit (and
+        to divide the batch), or None when the tenant cannot run on
+        ``n_free`` at all (non-data axes too wide, pipeline short of
+        stages, or a corruption drill squeezed below two replicas)."""
+        need = spec.min_devices()
+        if n_free < need:
+            return None
+        if spec.workload == "pipeline":
+            return spec.config.mesh.stage
+        from distributed_model_parallel_tpu.train.elastic import (
+            fit_mesh_to_devices,
+        )
+
+        try:
+            mesh_cfg, _ = fit_mesh_to_devices(spec.config.mesh, n_free,
+                                              batch_size=spec.batch_size)
+        except ValueError:
+            return None
+        n = mesh_cfg.num_devices
+        return n if n >= need else None
+
+    def pick_victims(self, waiter: Tenant, running: Sequence[Tenant]
+                     ) -> list[Tenant] | None:
+        """Choose the strictly-lower-priority victims whose slices, added
+        to the free pool (and to slices already draining), make
+        ``waiter`` placeable. Lowest priority first; newest admission
+        first within a priority. None when no such set exists."""
+        draining = sum(len(t.devices) for t in running
+                       if t.state is TenantState.PREEMPTING)
+        avail = self.pool.n_free + draining
+        if self.resolve_slice(waiter.spec, avail) is not None:
+            return []               # already satisfiable once drains land
+        candidates = sorted(
+            (t for t in running if t.state is TenantState.RUNNING
+             and t.priority < waiter.priority),
+            key=lambda t: (t.priority, -t.admit_seq))
+        chosen: list[Tenant] = []
+        for v in candidates:
+            chosen.append(v)
+            avail += len(v.devices)
+            if self.resolve_slice(waiter.spec, avail) is not None:
+                return chosen
+        return None

@@ -1,0 +1,350 @@
+"""Tenant lifecycle: one training job co-resident on the shared fleet.
+
+A :class:`Tenant` wraps one trainer (CNN ``train/trainer.Trainer``, LM/MoE
+``train/lm_trainer.LMTrainer``, or ``train/pipeline_trainer
+.PipelineTrainer``) and runs its unmodified ``fit()`` on a dedicated
+thread, gated step-by-step through the trainers' ``step_hook``: the hook
+parks the thread at every train-step boundary until the orchestrator
+grants the next step (a baton, not a time slice), so the fleet advances
+under the orchestrator's deterministic control — one tenant computes at a
+time, every scheduling decision observes settled state, and a fixed seed
+replays the identical campaign.
+
+Preemption is the REAL preemption path: the orchestrator sets the
+trainer's :class:`~distributed_model_parallel_tpu.train.preemption
+.PreemptionGuard` flag and grants one more step; the trainer breaks at
+the boundary, writes its preempt checkpoint (exact position, budgets,
+topology stamp), and ``fit()`` returns. Re-admission constructs a fresh
+trainer with ``resume=True`` on whatever slice the scheduler granted —
+``fit_mesh_to_devices`` refits the data axis and ``restore_resharded``
+lands the checkpoint in the new mesh's shardings, so a tenant preempted
+off a dp=4 slice continues at the exact global step on dp=2.
+
+Trainer construction and the whole fit run execute inside
+``telemetry.tenant_scope(name)``, so every record the trainer's stream
+writes carries the tenant tag the fleet report groups by.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Any
+
+from distributed_model_parallel_tpu.utils.telemetry import tenant_scope
+
+__all__ = ["Tenant", "TenantSpec", "TenantState"]
+
+WORKLOADS = ("cnn", "lm", "pipeline")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One job submission: which trainer drives it, its full config, and
+    its scheduling priority (higher preempts lower).
+
+    ``workload`` selects the trainer class: ``"cnn"`` =
+    ``train/trainer.Trainer`` (TrainConfig; gspmd/ddp/fsdp strategies,
+    any zoo model), ``"lm"`` = ``train/lm_trainer.LMTrainer``
+    (LMTrainConfig; a MoE tenant is an LM config with
+    ``model.moe_experts > 0``), ``"pipeline"`` =
+    ``train/pipeline_trainer.PipelineTrainer`` (TrainConfig with
+    ``mesh.stage`` stages; the stage axis is not elastic, so this tenant
+    needs exactly that many devices).
+
+    The config's ``mesh`` is a CEILING, not a demand: on every admission
+    the data axis is refit to the granted slice
+    (``fit_mesh_to_devices``), so ``mesh.data`` is the largest dp the
+    tenant will use. ``checkpoint_dir`` / ``log_dir`` must be
+    tenant-unique (the orchestrator rejects collisions at submit).
+    """
+
+    name: str
+    workload: str
+    config: Any                 # TrainConfig (cnn/pipeline) | LMTrainConfig
+    priority: int = 0
+
+    def __post_init__(self):
+        if self.workload not in WORKLOADS:
+            raise ValueError(f"unknown workload {self.workload!r}; known: "
+                             f"{WORKLOADS}")
+        if self.workload == "pipeline" and self.config.mesh.stage < 2:
+            raise ValueError(
+                f"pipeline tenant {self.name!r} needs mesh.stage >= 2, "
+                f"got {self.config.mesh.stage}")
+
+    @property
+    def epochs(self) -> int:
+        return int(self.config.epochs)
+
+    @property
+    def batch_size(self) -> int:
+        cfg = self.config
+        return int(cfg.batch_size if hasattr(cfg, "batch_size")
+                   else cfg.data.batch_size)
+
+    def min_devices(self) -> int:
+        """Smallest slice this tenant can run on at all: the non-data
+        mesh axes (not elastic), times two replicas when the fault plan
+        injects silent corruption (the corruption drills need redundancy
+        — the trainers reject a dp=1 corruption plan loudly, so the
+        scheduler must not grant one)."""
+        mesh = self.config.mesh
+        if self.workload == "pipeline":
+            return mesh.stage
+        other = mesh.stage * mesh.model * mesh.seq * mesh.expert
+        from distributed_model_parallel_tpu.utils.faults import (
+            CORRUPTION_KINDS,
+            parse_faults,
+        )
+
+        min_dp = 1
+        for f in self.config.recovery.faults or ():
+            kind = f.kind if hasattr(f, "kind") else parse_faults(f)[0].kind
+            if kind in CORRUPTION_KINDS:
+                min_dp = 2
+        return other * min_dp
+
+
+class TenantState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTING = "preempting"     # preemption requested, draining to save
+    COMPLETED = "completed"
+    FAILED = "failed"             # unrecovered error — the soak ledger
+    CANCELLED = "cancelled"
+
+
+class _Baton:
+    """Step-boundary handoff between the orchestrator thread and one
+    tenant thread. The tenant parks in :meth:`hook` at every boundary;
+    the orchestrator's grant wakes it for exactly one step."""
+
+    def __init__(self):
+        self.at_boundary = threading.Event()
+        self.go = threading.Event()
+
+    def hook(self, _trainer) -> None:          # runs on the tenant thread
+        self.at_boundary.set()
+        self.go.wait()
+        self.go.clear()
+
+    def release(self) -> None:
+        """Unpark the tenant unconditionally (shutdown/abandon path)."""
+        self.go.set()
+
+
+class Tenant:
+    """Runtime state of one submitted job across admissions."""
+
+    def __init__(self, spec: TenantSpec, seq: int):
+        self.spec = spec
+        self.seq = seq                  # submission order (FIFO tie-break)
+        self.state = TenantState.QUEUED
+        self.devices: tuple = ()        # granted slice while RUNNING
+        self.admit_seq = -1             # order of the LAST admission
+        self.attempts = 0               # trainer constructions (1 + resumes)
+        self.preemptions = 0
+        self.preempted_at_step: int | None = None   # step when last preempted
+        self.resume_exact: list[bool] = []          # per-resume step parity
+        # Resumes that legitimately could NOT land at the exact step: the
+        # newest checkpoint was torn (e.g. an injected tear_save hitting
+        # the preemption save) and the restore provably fell back to an
+        # older committed state — exempt from the exactness gate, counted
+        # here so the campaign summary still surfaces them.
+        self.resume_fallbacks = 0
+        self.trainer = None
+        self.error: BaseException | None = None
+        self.outcome: str | None = None     # completed | preempted | failed
+        self.jsonl_path: str | None = None
+        # Faults fired across ALL attempts (the trainer — and its
+        # injector — is rebuilt on every admission, so per-attempt fired
+        # lists must be accumulated here for the campaign ledger).
+        self.fired_faults: list = []
+        self._cancel_on_reap = False
+        self._thread: threading.Thread | None = None
+        self._baton = _Baton()
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def priority(self) -> int:
+        return self.spec.priority
+
+    @property
+    def global_step(self) -> int:
+        t = self.trainer
+        return int(getattr(t, "_global_step", 0)) if t is not None else 0
+
+    # -- trainer construction (on the tenant thread) ------------------------
+    def _attempt_config(self, n_devices: int):
+        """The config for THIS admission: resume on after the first
+        attempt, data axis refit to the granted slice, and the fault plan
+        stripped on resumes — FaultInjector occurrence counters are
+        per-construction, so replaying the plan would re-inject every
+        fault on every resume (an accidental infinite preempt loop);
+        chaos on resumed attempts comes from the campaign schedule, not
+        from replay."""
+        spec = self.spec
+        cfg = spec.config
+        resume = self.attempts > 1
+        kw: dict[str, Any] = {"resume": resume}
+        if resume and (cfg.recovery.faults or ()):
+            kw["recovery"] = dataclasses.replace(cfg.recovery, faults=())
+        if spec.workload != "pipeline":
+            from distributed_model_parallel_tpu.train.elastic import (
+                fit_mesh_to_devices,
+            )
+
+            mesh_cfg, _ = fit_mesh_to_devices(cfg.mesh, n_devices,
+                                              batch_size=spec.batch_size)
+            if mesh_cfg.num_devices != n_devices:
+                raise ValueError(
+                    f"tenant {spec.name!r}: granted {n_devices} devices "
+                    f"but the mesh resolves to {mesh_cfg.num_devices} — "
+                    f"the scheduler must grant exactly the resolved slice")
+            kw["mesh"] = mesh_cfg
+        return dataclasses.replace(cfg, **kw)
+
+    def _build_trainer(self, devices):
+        spec = self.spec
+        cfg = self._attempt_config(len(devices))
+        if spec.workload == "pipeline":
+            from distributed_model_parallel_tpu.train.pipeline_trainer import (
+                PipelineTrainer,
+            )
+
+            return PipelineTrainer(cfg, devices=list(devices))
+        from distributed_model_parallel_tpu.mesh import make_mesh
+
+        mesh_spec = make_mesh(cfg.mesh, list(devices))
+        if spec.workload == "lm":
+            from distributed_model_parallel_tpu.train.lm_trainer import (
+                LMTrainer,
+            )
+
+            return LMTrainer(cfg, mesh_spec)
+        from distributed_model_parallel_tpu.train.trainer import Trainer
+
+        return Trainer(cfg, mesh_spec)
+
+    def _completed(self, trainer, history) -> bool:
+        total = self.spec.epochs
+        if any(h.get("epoch") == total - 1 for h in history or ()):
+            return True
+        # Zero-work resume (preempted exactly at the final epoch
+        # boundary): the restored position already sits past the last
+        # epoch, so fit() ran nothing and recorded nothing.
+        return int(getattr(trainer, "start_epoch", 0)) >= total
+
+    def _run(self, devices) -> None:
+        # Drop the previous attempt's trainer BEFORE building the new one:
+        # a failed re-admission must not let the finally block read the
+        # stale trainer and re-append fired faults it already accumulated.
+        self.trainer = None
+        try:
+            with tenant_scope(self.name):
+                trainer = self._build_trainer(devices)
+                self.trainer = trainer
+                self.jsonl_path = trainer.logger.jsonl_path
+                if self.attempts > 1 and self.preempted_at_step is not None:
+                    # The acceptance gate for the whole orchestration
+                    # story: a resumed tenant continues at the EXACT
+                    # global step it was preempted at. The one legitimate
+                    # exception: the supervisor recorded a torn-checkpoint
+                    # fallback during THIS restore — the exact position
+                    # was destroyed with the torn version, and resuming
+                    # older-but-intact state is the correct behavior.
+                    exact = trainer._global_step == self.preempted_at_step
+                    if not exact and trainer.resilience._fallback_reported:
+                        self.resume_fallbacks += 1
+                    else:
+                        self.resume_exact.append(exact)
+                trainer.step_hook = self._baton.hook
+                history = trainer.fit()
+                self.outcome = ("completed"
+                                if self._completed(trainer, history)
+                                else "preempted")
+        except BaseException as e:  # noqa: BLE001 - ledger, not crash
+            self.error = e
+            self.outcome = "failed"
+        finally:
+            faults = getattr(self.trainer, "faults", None)
+            if faults is not None:
+                self.fired_faults.extend(faults.fired)
+            # The thread's death IS the completion signal; make sure the
+            # boundary flag can't wedge an orchestrator mid-wait.
+            self._baton.at_boundary.set()
+
+    # -- orchestrator-side controls -----------------------------------------
+    def start(self, devices, admit_seq: int) -> None:
+        assert self._thread is None or not self._thread.is_alive()
+        self.devices = tuple(devices)
+        self.admit_seq = admit_seq
+        self.attempts += 1
+        self.state = TenantState.RUNNING
+        self.outcome = None
+        self._baton = _Baton()
+        self._thread = threading.Thread(
+            target=self._run, args=(self.devices,), daemon=True,
+            name=f"tenant-{self.name}")
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait_boundary(self, poll_s: float = 0.01) -> bool:
+        """Block until the tenant parks at a step boundary (or its
+        thread finishes — the death path sets the flag too, so callers
+        can never wedge here; they distinguish by checking ``alive``)."""
+        while not self._baton.at_boundary.wait(poll_s):
+            if not self.alive:
+                return False
+        return True
+
+    def grant_steps(self, n: int) -> bool:
+        """Advance the tenant by up to ``n`` steps, synchronously: each
+        grant waits for the tenant to re-park (or finish) before the
+        next, so exactly one tenant computes at a time and control
+        returns with the tenant settled. Returns False once the thread
+        has finished."""
+        for _ in range(n):
+            if not self.wait_boundary() or not self.alive:
+                return False
+            self._baton.at_boundary.clear()
+            self._baton.go.set()
+        self.wait_boundary()
+        return self.alive
+
+    def request_preemption(self) -> None:
+        """Flip the trainer's cooperative stop flag — the same flag a TPU
+        maintenance SIGTERM sets. The tenant honors it at the next
+        granted boundary and exits through its preempt checkpoint."""
+        if self.trainer is not None:
+            self.trainer.preemption.request()
+        self.state = TenantState.PREEMPTING
+
+    def drain(self, max_steps: int = 10_000) -> None:
+        """Grant steps until the thread finishes (used after a
+        preemption request: the trainer needs one boundary to observe
+        the flag, then runs its checkpoint-and-exit path)."""
+        for _ in range(max_steps):
+            if not self.alive:
+                break
+            if not self.wait_boundary():
+                break
+            self._baton.at_boundary.clear()
+            self._baton.go.set()
+        if self._thread is not None:
+            self._thread.join(timeout=300)
+
+    def join(self) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=300)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"tenant {self.name!r} thread failed to exit")

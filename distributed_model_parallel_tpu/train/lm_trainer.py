@@ -276,6 +276,10 @@ class LMTrainer:
             self.ckpt, "lm-emergency", config.emergency_every,
             logger=self.logger)
         self.start_epoch = 0
+        # Cooperative-scheduling hook (orchestrator/): called with this
+        # trainer at every train-step boundary, before the preemption poll
+        # — see Trainer.step_hook.
+        self.step_hook = None
         # Exact-continuation position: the next (epoch, step) the training
         # loop will sample. Batches are derived statelessly from
         # (seed, epoch, step), so this pair IS the data-loader state
@@ -288,7 +292,7 @@ class LMTrainer:
             self.logger.telemetry.event(self.elastic_decision.describe())
         if config.resume and any(self.ckpt.exists(n)
                                  for n in ("lm", "lm-preempt",
-                                           "lm-emergency")):
+                                           "lm-emergency", "lm-good")):
             self._resume()
 
     # ------------------------------------------------------------------ data
@@ -415,7 +419,10 @@ class LMTrainer:
         t2 = {k: v for k, v in tmpl.items() if k != "resume"}
         t3 = {k: v for k, v in t2.items() if k != "virtual_stages"}
         name, restored = elastic.elastic_restore(
-            self.ckpt, (tmpl, t2, t3), ("lm", "lm-preempt", "lm-emergency"),
+            self.ckpt, (tmpl, t2, t3),
+            # The supervisor's good slot is the last resort: it makes a
+            # torn preemption/emergency save survivable (dmp_soak.py).
+            ("lm", "lm-preempt", "lm-emergency", "lm-good"),
             on_fallback=self.resilience.note_fallback)
         ckpt_v = int(restored.get("virtual_stages", 1))
         if ckpt_v != self.config.virtual_stages:
@@ -534,6 +541,8 @@ class LMTrainer:
             self._pos_epoch, self._pos_step = epoch, 0
         start = self._pos_step
         for step_i in range(start, self.config.steps_per_epoch):
+            if self.step_hook is not None:
+                self.step_hook(self)
             if self.preemption.requested():
                 break
             toks, tgts = self.sample_batch(epoch, step_i)

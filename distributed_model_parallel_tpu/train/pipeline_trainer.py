@@ -165,6 +165,10 @@ class PipelineTrainer:
             logger=self.logger)
         self.best_acc = 0.0
         self.start_epoch = 0
+        # Cooperative-scheduling hook (orchestrator/): called with this
+        # trainer at every train-step boundary, before the preemption poll
+        # — see Trainer.step_hook.
+        self.step_hook = None
         # Stateless per-step augmentation rng (base key x global step) +
         # host-side step counter — the exact-continuation pair
         # (train/elastic.py).
@@ -176,7 +180,8 @@ class PipelineTrainer:
         self._loader_pos = (0, 0)
         if config.resume and any(self.ckpt.exists(n)
                                  for n in ("pipeline", "pipeline-preempt",
-                                           "pipeline-emergency")):
+                                           "pipeline-emergency",
+                                           "pipeline-good")):
             self._resume()
 
     def _ckpt_meta(self):
@@ -260,7 +265,10 @@ class PipelineTrainer:
                   if k not in ("resume", "opt_state")}
         name, restored = elastic.elastic_restore(
             self.ckpt, (tmpl, legacy),
-            ("pipeline", "pipeline-preempt", "pipeline-emergency"),
+            # The supervisor's good slot is the last resort: it makes a
+            # torn preemption/emergency save survivable (dmp_soak.py).
+            ("pipeline", "pipeline-preempt", "pipeline-emergency",
+             "pipeline-good"),
             on_fallback=self.resilience.note_fallback)
         self._push_restored(restored)
         self.start_epoch = int(restored["epoch"])
@@ -391,6 +399,8 @@ class PipelineTrainer:
         win_wall, win_data, win_steps = t_epoch, 0.0, 0
         timer.mark()
         for i, (images, labels) in enumerate(loader):
+            if train and self.step_hook is not None:
+                self.step_hook(self)
             if train and self.preemption.requested():
                 break
             timer.data_ready()          # pure loader-fetch time

@@ -235,6 +235,11 @@ class RecoverySupervisor:
 
     # -- chaos bookkeeping --------------------------------------------------
     def _on_fault_fired(self, spec: FaultSpec, site: str, index: int) -> None:
+        # Typed record, not just the log line: the fleet report's fault
+        # ledger pairs every injected fault with the detection/recovery
+        # records that follow it (scripts/dmp_report.py pair_faults).
+        self._telemetry.record("fault", fault=spec.kind, site=site,
+                               index=index)
         self.logger.log_line(
             f"chaos: injected fault {spec.kind} at {site}[{index}]")
 

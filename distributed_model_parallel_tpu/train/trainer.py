@@ -486,6 +486,12 @@ class Trainer:
             logger=self.logger, wait=not config.async_checkpoint)
         self.best_acc = 0.0
         self.start_epoch = 0
+        # Cooperative-scheduling hook (orchestrator/): when set, called with
+        # this trainer at EVERY train-step boundary, before the preemption
+        # poll — so an external scheduler can pause the run mid-epoch
+        # (block in the hook), and a preemption it requests while the run
+        # is paused is honored before the next step dispatches.
+        self.step_hook: Callable[["Trainer"], None] | None = None
         # Per-step augmentation rng is derived from (base key, global step)
         # — stateless, so a resumed run replays the exact stream an
         # uninterrupted run would have used (train/elastic.py). The host
@@ -499,7 +505,8 @@ class Trainer:
             self.logger.log_line(self.elastic_decision.describe())
             self.logger.telemetry.event(self.elastic_decision.describe())
         if config.resume and any(self.ckpt.exists(n)
-                                 for n in ("ckpt", "preempt", "emergency")):
+                                 for n in ("ckpt", "preempt", "emergency",
+                                           "good")):
             self._resume()
 
     def _build_steps(self) -> None:
@@ -678,12 +685,17 @@ class Trainer:
         templates = [{**tmpl, "state": lo} for lo in layouts]
         legacy = {k: v for k, v in tmpl.items() if k != "resume"}
         templates += [{**legacy, "state": lo} for lo in layouts]
-        # Newest-valid slot wins — best-accuracy, preemption, or
-        # step-cadence emergency save — restored through restore_resharded
-        # so a checkpoint from a different mesh degree lands in THIS mesh's
-        # shardings; torn versions/slots fall back (train/elastic.py).
+        # Newest-valid slot wins — best-accuracy, preemption, step-cadence
+        # emergency, or the recovery supervisor's per-epoch good slot —
+        # restored through restore_resharded so a checkpoint from a
+        # different mesh degree lands in THIS mesh's shardings; torn
+        # versions/slots fall back (train/elastic.py). The good slot is
+        # the last resort that makes a torn preemption save survivable
+        # (the multi-tenant soak flushed this out: an injected tear_save
+        # landing on a first-preemption checkpoint used to kill the
+        # resume outright — scripts/dmp_soak.py).
         name, restored = elastic.elastic_restore(
-            self.ckpt, templates, ("ckpt", "preempt", "emergency"),
+            self.ckpt, templates, ("ckpt", "preempt", "emergency", "good"),
             on_fallback=self.resilience.note_fallback)
         rs = restored["state"]
         want_ema = self.config.optimizer.ema_decay is not None
@@ -876,6 +888,8 @@ class Trainer:
         base = self.train_loader.cursor
         self._loader_pos = (epoch, base)
         for i, (images, labels) in enumerate(self._prefetched(self.train_loader)):
+            if self.step_hook is not None:
+                self.step_hook(self)
             if self.preemption.requested():
                 break
             gi = base + i
@@ -937,6 +951,8 @@ class Trainer:
         idx = idx[:steps * bs].reshape(steps, bs)
         inflight = 0
         for i in range(base, steps, K):
+            if self.step_hook is not None:
+                self.step_hook(self)
             if self.preemption.requested():
                 break
             chunk = np.ascontiguousarray(idx[i:i + K])

@@ -124,6 +124,31 @@ def peak_hbm_bytes_per_chip(device=None) -> float | None:
     return match_device_kind(TPU_PEAK_HBM_BYTES, device)
 
 
+def demand_frac_of_peak(bytes_per_s: float | None,
+                        peak_bytes_per_s: float | None
+                        ) -> tuple[float | None, str | None]:
+    """Demand-side bytes rate as a fraction of the physical HBM peak —
+    or ``(None, reason)`` when the fraction exceeds 1.0: a demand
+    estimate above the DMA ceiling is an op-level byte-accounting
+    overcount (VMEM-reused values billed once per use — see
+    :func:`bytes_accessed_of`), not a measurement, and publishing it as
+    fact is how BENCH_r04's bogus ``hbm_frac_of_peak: 1.457`` happened.
+    The single policy point for bench.py AND scripts/dmp_report.py, so
+    the threshold and explanation cannot drift apart. The GB/s demand
+    number stays honest as *demand*; only the roofline *position* is
+    refused."""
+    if not bytes_per_s or not peak_bytes_per_s:
+        return None, None
+    frac = bytes_per_s / peak_bytes_per_s
+    if frac > 1.0:
+        return None, (f"demand {bytes_per_s / 1e9:.0f} GB/s exceeds the "
+                      f"{peak_bytes_per_s / 1e9:.0f} GB/s physical peak "
+                      f"({frac:.2f}x): op-level byte accounting overcount, "
+                      f"not a DMA rate — see benchmarks/run_step_profile.py "
+                      f"for the measured-timeline roofline")
+    return round(frac, 3), None
+
+
 def bytes_accessed_of(ca: dict) -> float | None:
     """"bytes accessed" from a :func:`compiled_cost_analysis` dict.
 

@@ -22,7 +22,11 @@ telemetry layer all of them now share:
   step: multiply by the step count for a program that retraces once (the
   steady state), and read them as "what one dispatch moves".
 
-Record schema (all records carry ``ts`` (unix seconds) and ``kind``):
+Record schema (all records carry ``ts`` (unix seconds) and ``kind``; runs
+opened inside a :func:`tenant_scope` — the multi-tenant orchestrator wraps
+each tenant's trainer in one — additionally stamp ``tenant`` on every
+record, and :func:`merge_streams` joins per-tenant streams into the
+ts-ordered fleet view the report renders):
 
 ========== ==========================================================
 kind       payload keys
@@ -55,11 +59,19 @@ resume     slot, plus the exact continuation position (epoch,
            mesh when the topology changed) — one elastic-resume event
            (train/elastic.py) emitted when a restarted run restores a
            checkpoint
+fault      fault (kind), site, index — one injected fault firing
+           (train/resilience.py on_fire); the anchor the fleet
+           report's ledger pairs detections/recoveries against
+tenant     name, event (submitted/admitted/preempt-requested/preempted/
+           completed/failed/cancelled), devices, global_step, priority
+           — one tenant lifecycle transition on the orchestrator's
+           fleet stream (orchestrator/orchestrator.py)
 ========== ==========================================================
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import os
@@ -74,11 +86,14 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "TelemetryRun",
+    "current_tenant",
     "device_info",
     "device_memory_snapshot",
     "install_compile_tracking",
+    "merge_streams",
     "record_collective",
     "registry",
+    "tenant_scope",
     "wire_bytes_estimate",
 ]
 
@@ -404,6 +419,63 @@ def device_memory_snapshot() -> list[dict] | None:
 
 
 # ---------------------------------------------------------------------------
+# Tenant tagging (multi-tenant orchestration, orchestrator/)
+# ---------------------------------------------------------------------------
+
+# Thread-local "who is writing telemetry right now": the orchestrator runs
+# each tenant's trainer on its own thread and wraps construction + fit in
+# ``tenant_scope(name)``, so every TelemetryRun a trainer opens inside that
+# scope tags its records without the trainers knowing tenancy exists.
+_tenant_local = threading.local()
+
+
+def current_tenant() -> str | None:
+    """The tenant name bound to this thread (None outside any scope)."""
+    return getattr(_tenant_local, "name", None)
+
+
+@contextlib.contextmanager
+def tenant_scope(name: str):
+    """Bind a tenant name to the current thread: every
+    :class:`TelemetryRun` constructed inside the scope stamps ``tenant``
+    onto all of its records (the fleet report groups by it). Scopes nest;
+    the previous binding is restored on exit."""
+    prev = current_tenant()
+    _tenant_local.name = str(name)
+    try:
+        yield
+    finally:
+        _tenant_local.name = prev
+
+
+def merge_streams(paths: Iterable[str]) -> list[dict]:
+    """Merge several telemetry JSONL streams into one ts-ordered record
+    list — the fleet view ``scripts/dmp_report.py`` renders for a
+    multi-tenant run. Records keep their per-stream ``tenant`` tags;
+    untagged records from a stream whose ``run_start`` carries one inherit
+    it (legacy streams predating the tag merge untagged). Missing files
+    are skipped (a tenant killed before its header wrote nothing)."""
+    merged: list[tuple[float, int, dict]] = []
+    order = 0
+    for path in paths:
+        try:
+            records = read_records(path)
+        except FileNotFoundError:
+            continue
+        tenant = next((r.get("tenant") for r in records
+                       if r.get("kind") == "run_start"), None)
+        for r in records:
+            if tenant is not None and "tenant" not in r:
+                r = {**r, "tenant": tenant}
+            ts = r.get("ts")
+            merged.append((ts if isinstance(ts, (int, float)) else 0.0,
+                           order, r))
+            order += 1
+    merged.sort(key=lambda t: (t[0], t[1]))
+    return [r for _, _, r in merged]
+
+
+# ---------------------------------------------------------------------------
 # The run event stream
 # ---------------------------------------------------------------------------
 
@@ -436,8 +508,13 @@ class TelemetryRun:
                  meta: Mapping[str, Any] | None = None,
                  registry_: MetricsRegistry | None = None,
                  track_compiles: bool = True,
-                 device: Mapping[str, Any] | None = None):
+                 device: Mapping[str, Any] | None = None,
+                 tenant: str | None = None):
         self.path = path
+        # Tenant tag: explicit, or inherited from the thread's
+        # tenant_scope (how the orchestrator tags trainer-opened streams
+        # without the trainers knowing). Stamped on every record.
+        self.tenant = tenant if tenant is not None else current_tenant()
         self.registry = registry_ if registry_ is not None else registry()
         self._lock = threading.Lock()
         self._finished = False
@@ -469,7 +546,10 @@ class TelemetryRun:
                     meta=_coerce(dict(meta or {})))
 
     def record(self, kind: str, **fields) -> None:
-        line = json.dumps({"ts": time.time(), "kind": kind,
+        head = {"ts": time.time(), "kind": kind}
+        if self.tenant is not None:
+            head["tenant"] = self.tenant
+        line = json.dumps({**head,
                            **{k: _coerce(v) for k, v in fields.items()}},
                           default=str)
         with self._lock:

@@ -141,12 +141,24 @@ def _mfu_section(lines: list[str], meta: dict, device: dict,
     hbm_peak = match_device_kind(TPU_PEAK_HBM_BYTES, kind=kind)
     bytes_step = ca.get("bytes_accessed_per_step")
     if bytes_step and times and hbm_peak:
+        from distributed_model_parallel_tpu.utils.profiling import (
+            demand_frac_of_peak,
+        )
+
         rate = bytes_step / percentile(times, 50)
-        lines.append(
-            f"HBM roofline: demand {rate / 1e9:.0f} GB/s vs "
-            f"{hbm_peak / 1e9:.0f} GB/s peak ({rate / hbm_peak:.2f}x) — "
-            f"demand-side estimate, >1.0 means VMEM reuse, not impossible "
-            f"DMA")
+        frac, frac_err = demand_frac_of_peak(rate, hbm_peak)
+        if frac_err:
+            # A fraction of the physical peak > 1 is not a roofline
+            # position, it is proof the measurement overcounted
+            # (BENCH_r04 published 1.457x as fact) — the shared policy
+            # in utils/profiling.demand_frac_of_peak refuses it.
+            lines.append(f"HBM roofline: MEASUREMENT ERROR — {frac_err}")
+        else:
+            lines.append(
+                f"HBM roofline: demand {rate / 1e9:.0f} GB/s vs "
+                f"{hbm_peak / 1e9:.0f} GB/s peak ({frac:.2f}x) — "
+                f"demand-side estimate (analytic bytes / measured time), "
+                f"not a hardware counter")
     elif bytes_step:
         lines.append("HBM roofline unavailable (no peak-bandwidth entry "
                      f"for device_kind={kind!r})")
@@ -188,12 +200,16 @@ def _memory_section(lines: list[str], by_kind: dict) -> None:
         lines.append(f"device {dev_id}: peak {_fmt_bytes(peak)} in use")
 
 
-def _resilience_section(lines: list[str], by_kind: dict) -> None:
+def _resilience_section(lines: list[str], by_kind: dict,
+                        t0: float | None = None) -> None:
     """Failure / recovery / divergence timeline: every detected failure
     (non-finite, stall, torn checkpoint, failed save, preemption, replica
     divergence) next to the recovery action the supervisor or consistency
     sentinel took (train/resilience.py, train/consistency.py), in event
-    order."""
+    order. ``t0`` overrides the timeline origin (the fleet report passes
+    the campaign start — a resumed tenant's stream holds several
+    ``run_start`` records, and the last one would put earlier attempts'
+    events at negative offsets)."""
     fails = by_kind.get("failure") or []
     recs = by_kind.get("recovery") or []
     cons = by_kind.get("consistency") or []
@@ -201,7 +217,8 @@ def _resilience_section(lines: list[str], by_kind: dict) -> None:
     if not fails and not recs and not cons and not resumes:
         return
     starts = by_kind.get("run_start") or []
-    t0 = starts[-1].get("ts") if starts else None
+    if t0 is None and starts:
+        t0 = starts[-1].get("ts")
     if t0 is None:
         t0 = min((r.get("ts") for r in fails + recs + cons + resumes
                   if isinstance(r.get("ts"), (int, float))), default=0.0)
@@ -327,22 +344,201 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# Fleet report: merged multi-tenant streams (orchestrator/ + dmp_soak.py)
+# ---------------------------------------------------------------------------
+
+# Which detection (failure error / consistency status) and recovery
+# (recovery action / consistency status) records close the loop for each
+# injected fault kind — the pairing the fault ledger audits. A fault is
+# "paired" when a detection AND an action matching these sets appear in
+# its tenant's stream after the injection.
+FAULT_PAIRING: dict[str, tuple[frozenset, frozenset]] = {
+    "nan_loss": (frozenset({"non-finite"}), frozenset({"restored"})),
+    "nan_params": (frozenset({"non-finite"}), frozenset({"restored"})),
+    "preempt": (frozenset({"preempted"}),
+                frozenset({"checkpoint-and-exit"})),
+    "stall": (frozenset({"stall"}), frozenset({"checkpoint-and-exit"})),
+    "save_fail": (frozenset({"checkpoint-save-failed"}),
+                  frozenset({"save-retried", "save-skipped"})),
+    "tear_save": (frozenset({"checkpoint-torn"}),
+                  frozenset({"checkpoint-fallback"})),
+    # Silent corruption: detection is the sentinel's divergence (or, for
+    # a consensus-poisoning drill, non-finite); the closing action is an
+    # in-place replica re-broadcast, or a good-slot restore when there
+    # was no quorum.
+    "bitflip": (frozenset({"divergence", "non-finite"}),
+                frozenset({"repaired", "replica-rebroadcast", "restored"})),
+    "desync": (frozenset({"divergence", "non-finite"}),
+               frozenset({"repaired", "replica-rebroadcast", "restored"})),
+    "grad_skew": (frozenset({"divergence", "non-finite"}),
+                  frozenset({"repaired", "replica-rebroadcast",
+                             "restored"})),
+}
+
+
+def _detection_key(r: dict) -> str | None:
+    if r.get("kind") == "failure":
+        return r.get("error")
+    if r.get("kind") == "consistency" and r.get("status") != "repaired":
+        return r.get("status")
+    return None
+
+
+def _action_key(r: dict) -> str | None:
+    if r.get("kind") == "recovery":
+        return r.get("action")
+    if r.get("kind") == "consistency" and r.get("status") == "repaired":
+        return "repaired"
+    return None
+
+
+def pair_faults(records: list[dict]) -> list[dict]:
+    """Pair every injected fault (typed ``fault`` record,
+    train/resilience.py) with the detection and recovery that followed it
+    in the same tenant's stream. Returns one ledger row per injection:
+    ``{tenant, fault, site, detected, action, paired}``. Detections and
+    actions are consumed in order, so two faults cannot claim the same
+    recovery."""
+    by_tenant: dict[str, list[dict]] = {}
+    for r in records:
+        by_tenant.setdefault(r.get("tenant") or "", []).append(r)
+    ledger: list[dict] = []
+    for tenant, recs in sorted(by_tenant.items()):
+        used: set[int] = set()
+
+        def _claim(start: int, match, accept: frozenset) -> tuple:
+            for j in range(start, len(recs)):
+                if j in used:
+                    continue
+                key = match(recs[j])
+                if key is not None and key in accept:
+                    used.add(j)
+                    return j, key
+            return len(recs), None
+
+        for i, r in enumerate(recs):
+            if r.get("kind") != "fault":
+                continue
+            kind = r.get("fault")
+            det_set, act_set = FAULT_PAIRING.get(
+                kind, (frozenset(), frozenset()))
+            dj, detected = _claim(i + 1, _detection_key, det_set)
+            _, action = _claim(dj + 1 if detected else i + 1,
+                               _action_key, act_set)
+            ledger.append({
+                "tenant": tenant, "fault": kind, "site": r.get("site"),
+                "detected": detected, "action": action,
+                "paired": detected is not None and action is not None,
+            })
+    return ledger
+
+
+def build_fleet_report(records: list[dict]) -> str:
+    """Render the fleet-level report for a merged multi-tenant record
+    stream (utils/telemetry.merge_streams): the orchestration timeline,
+    one resilience timeline per tenant, per-tenant recovery/repair/resume
+    counts, the injected-fault ledger, and the unrecovered-failure
+    ledger."""
+    lines: list[str] = []
+    tenants = sorted({r["tenant"] for r in records if r.get("tenant")})
+    lifecycle = [r for r in records if r.get("kind") == "tenant"]
+    topology = [r for r in records if r.get("kind") == "event"
+                and "topology" in str(r.get("message", ""))]
+    lines.append(f"== fleet ({len(tenants)} tenants) ==")
+    t0 = min((r.get("ts") for r in records
+              if isinstance(r.get("ts"), (int, float))), default=0.0)
+    for r in sorted(lifecycle + topology, key=lambda r: r.get("ts") or 0.0):
+        dt = (r["ts"] - t0) if isinstance(r.get("ts"), (int, float)) else 0.0
+        if r.get("kind") == "event":
+            lines.append(f"  [+{dt:7.1f}s] {r.get('message')}")
+        else:
+            extra = " ".join(
+                f"{k}={r[k]}" for k in ("devices", "global_step", "reason",
+                                        "attempt", "error")
+                if r.get(k) is not None)
+            lines.append(f"  [+{dt:7.1f}s] {str(r.get('name')):<12} "
+                         f"{str(r.get('event')):<20}"
+                         + (f" {extra}" if extra else ""))
+
+    for tenant in tenants:
+        recs = [r for r in records if r.get("tenant") == tenant]
+        by_kind = _by_kind(recs)
+        counts = {
+            "failures": len(by_kind.get("failure") or []),
+            "recoveries": len(by_kind.get("recovery") or []),
+            "repairs": len([c for c in by_kind.get("consistency") or []
+                            if c.get("status") == "repaired"]),
+            "resumes": len(by_kind.get("resume") or []),
+            "epochs": len(by_kind.get("epoch") or []),
+        }
+        lines.append(f"== tenant {tenant} ==")
+        lines.append("  " + "  ".join(f"{k}={v}"
+                                      for k, v in counts.items()))
+        sub: list[str] = []
+        _resilience_section(sub, by_kind, t0)
+        lines += ["  " + s for s in sub]
+
+    ledger = pair_faults(records)
+    if ledger:
+        lines.append(f"== fault ledger ({len(ledger)} injected) ==")
+        for row in ledger:
+            status = "ok" if row["paired"] else "UNPAIRED"
+            lines.append(
+                f"  {row['tenant']:<12} {row['fault']:<12} "
+                f"detected={row['detected'] or '-':<24} "
+                f"action={row['action'] or '-':<22} {status}")
+    unpaired = [r for r in ledger if not r["paired"]]
+    unrecovered = [r for r in lifecycle if r.get("event") == "failed"]
+    lines.append(f"== unrecovered ({len(unrecovered)} tenant failures, "
+                 f"{len(unpaired)} unpaired faults) ==")
+    for r in unrecovered:
+        lines.append(f"  {r.get('name')}: {r.get('error')}")
+    for r in unpaired:
+        lines.append(f"  {r['tenant']}: fault {r['fault']} never "
+                     f"{'detected' if r['detected'] is None else 'recovered'}")
+    if not unrecovered and not unpaired:
+        lines.append("  (none — every injected fault was detected and "
+                     "recovered, no tenant died)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(
         description="Render a run report from a telemetry JSONL stream")
-    p.add_argument("jsonl", help="telemetry stream (RunLogger's "
-                                 "{log_dir}/{name}.jsonl or DMP_TELEMETRY)")
+    p.add_argument("jsonl", nargs="+",
+                   help="telemetry stream(s) (RunLogger's "
+                        "{log_dir}/{name}.jsonl or DMP_TELEMETRY); several "
+                        "streams (or --fleet) render the merged "
+                        "multi-tenant fleet report")
+    p.add_argument("--fleet", action="store_true",
+                   help="force the fleet report even for one stream "
+                        "(e.g. just the orchestrator's fleet.jsonl)")
     p.add_argument("--trace", default=None,
                    help="xplane trace directory (utils/xplane.trace_to / "
                         "jax.profiler.start_trace) to join in")
     p.add_argument("--top", type=int, default=15,
                    help="top device ops to print from the trace")
     args = p.parse_args(argv)
-    if not os.path.exists(args.jsonl):
-        raise SystemExit(f"no such telemetry file: {args.jsonl}")
-    records = read_records(args.jsonl)
+    for path in args.jsonl:
+        if not os.path.exists(path):
+            raise SystemExit(f"no such telemetry file: {path}")
+    if args.fleet or len(args.jsonl) > 1:
+        from distributed_model_parallel_tpu.utils.telemetry import (
+            merge_streams,
+        )
+
+        if args.trace:
+            raise SystemExit("--trace joins a single-run report, not the "
+                             "fleet view; render the tenant's own stream")
+        records = merge_streams(args.jsonl)
+        if not records:
+            raise SystemExit("no parseable records in any stream")
+        print(build_fleet_report(records))
+        return
+    records = read_records(args.jsonl[0])
     if not records:
-        raise SystemExit(f"{args.jsonl} holds no parseable records")
+        raise SystemExit(f"{args.jsonl[0]} holds no parseable records")
     print(build_report(records, trace_dir=args.trace, top=args.top))
 
 

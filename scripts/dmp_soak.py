@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""Chaos-soak campaign: the whole robustness stack under fleet traffic.
+
+``dmp_chaos.py`` drills one trainer, one fault, one scenario at a time.
+This driver grows that into the production scenario the stack was built
+for: a multi-tenant orchestrator (``distributed_model_parallel_tpu/
+orchestrator/``) runs several concurrent heterogeneous jobs — CNN
+(``train/trainer.py``), LM and MoE (``train/lm_trainer.py``), pipeline
+(``train/pipeline_trainer.py``) — on a shared device pool while a seeded
+schedule injects faults (``utils/faults.py``, corruption drills
+included), preempts by priority, shrinks and regrows the topology, and
+churns tenants. Every cross-feature interaction the single-trainer
+drills cannot reach — a preemption landing while a sentinel repair is
+one cadence away, two tenants racing for freed devices, an emergency
+checkpoint resharded onto a shrunken slice — happens here on purpose.
+
+Modes:
+
+* ``fast`` (default) — one deterministic campaign: fixed seed, tiny
+  models, CPU-friendly, seconds-to-a-minute; the ``chaos`` pytest tier
+  runs it on every CI pass (tests/test_soak.py).
+* ``long`` — repeated campaigns with derived seeds until
+  ``--duration-s`` wall clock is spent (hours for a real soak); each
+  campaign is the fast campaign's shape scaled by ``--tenants`` /
+  ``--epochs``.
+
+Every campaign gates on the same four invariants and exits non-zero when
+any fails:
+
+1. zero unrecovered failures (no tenant ends FAILED);
+2. every preempted tenant resumed at its EXACT global step;
+3. every injected fault is paired with its detection + recovery/repair/
+   resume record in the merged telemetry (``dmp_report.pair_faults``);
+4. every tenant completed its configured epochs.
+
+The fault pool spans the ``utils/faults.py`` taxonomy: nan_loss,
+nan_params, preempt, stall (escalating to checkpoint-and-exit),
+save_fail, tear_save (always scheduled together with a later nan so a
+restore provably walks past the torn version), and the corruption drills
+bitflip / desync / grad_skew on replicated-dp tenants. Corruption kinds
+are only assigned to tenants whose minimum slice keeps >= 2 replicas —
+the same topology rule the trainers enforce loudly.
+
+One fleet-level report is rendered from the merged tenant streams
+(``utils/telemetry.merge_streams`` + ``dmp_report.build_fleet_report``),
+followed by ONE parseable JSON summary line.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/dmp_soak.py [--seed 0] [--mode fast]
+      [--tenants 4] [--epochs 2] [--quantum 2] [--no-churn] [--no-shrink]
+  JAX_PLATFORMS=cpu python scripts/dmp_soak.py --mode long --duration-s 3600
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Virtual CPU devices (must precede any jax import; no-op when the test
+# session already forced a device count).
+if (os.environ.get("JAX_PLATFORMS") == "cpu"
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", default="fast", choices=["fast", "long"])
+    p.add_argument("--seed", default=0, type=int,
+                   help="campaign seed: fault kinds/sites, priorities and "
+                        "event rounds all derive from it — same seed, "
+                        "same campaign")
+    p.add_argument("--tenants", default=4, type=int,
+                   help="initial tenant count (>= 3; workloads cycle "
+                        "cnn / lm / pipeline / moe)")
+    p.add_argument("--epochs", default=2, type=int,
+                   help="epochs per tenant")
+    p.add_argument("--quantum", default=2, type=int,
+                   help="train steps granted per tenant per round")
+    p.add_argument("--duration-s", default=3600.0, type=float,
+                   help="long mode: wall-clock budget across campaigns")
+    p.add_argument("--no-churn", action="store_true",
+                   help="skip the mid-campaign high-priority tenant "
+                        "submission (the churn + priority-preemption event)")
+    p.add_argument("--no-shrink", action="store_true",
+                   help="skip the topology shrink/grow events")
+    p.add_argument("--workdir", default=None,
+                   help="campaign root (default: a fresh tmp dir)")
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------------
+# tenant recipes (sized for the fast tier; long mode reuses them — the
+# soak's scale comes from tenant count x campaign count, not model size)
+# ---------------------------------------------------------------------------
+
+def _cnn_config(workdir, name, dp, epochs, **kw):
+    from distributed_model_parallel_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        OptimizerConfig,
+        TrainConfig,
+    )
+
+    defaults = dict(
+        model=ModelConfig(name="tinycnn"),
+        data=DataConfig(name="synthetic", batch_size=16, eval_batch_size=16,
+                        synthetic_train_size=48, synthetic_eval_size=16),
+        optimizer=OptimizerConfig(learning_rate=0.1, warmup_steps=2),
+        mesh=MeshConfig(data=dp), epochs=epochs,
+        # Eval every epoch costs real wall clock on a 1-core host and the
+        # campaign gates on resilience, not accuracy.
+        eval_every=100,
+        log_dir=os.path.join(workdir, name, "log"),
+        checkpoint_dir=os.path.join(workdir, name, "ckpt"),
+        log_name=name, log_every_n_steps=1000,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def _lm_config(workdir, name, dp, epochs, *, moe=0, **kw):
+    from distributed_model_parallel_tpu.config import MeshConfig
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_model_parallel_tpu.train.lm_trainer import LMTrainConfig
+
+    defaults = dict(
+        model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq_len=16,
+                                moe_experts=moe,
+                                moe_top_k=2 if moe else 1),
+        mesh=MeshConfig(data=dp), batch_size=4, seq_len=16,
+        steps_per_epoch=3, epochs=epochs, n_tokens=2000, eval_batches=0,
+        log_dir=os.path.join(workdir, name, "log"),
+        checkpoint_dir=os.path.join(workdir, name, "ckpt"),
+        log_name=name,
+    )
+    defaults.update(kw)
+    return LMTrainConfig(**defaults)
+
+
+# Per-workload fault-plan templates: (plan, extra config kw). Step
+# indexes assume >= 6 steps of budget (epochs >= 2 x 3 steps). Recovery
+# knobs ride along so every injected fault has an armed detector and a
+# recovery policy — the same no-undetectable-faults rule the supervisor
+# enforces at construction.
+def _fault_menu(steps_per_epoch: int, epochs: int):
+    from distributed_model_parallel_tpu.config import RecoveryConfig
+
+    total = steps_per_epoch * epochs
+    mid = max(1, total // 2)
+
+    def rec(faults, **kw):
+        return RecoveryConfig(max_retries=3, lr_shrink=0.5,
+                              faults=tuple(faults), **kw)
+
+    # (label, needs_replicas, config kwargs)
+    return [
+        ("nan_loss", False,
+         dict(recovery=rec([f"nan_loss@{mid}"]), check_finite_every=1)),
+        ("nan_params", False,
+         dict(recovery=rec([f"nan_params@{mid}"]), check_finite_every=1)),
+        ("preempt", False,
+         dict(recovery=rec([f"preempt@{mid}"]))),
+        ("stall", False,
+         dict(recovery=rec(["stall@1:0.3"], stall_exit=True),
+              stall_budget_s=0.05)),
+        ("save_fail", False,
+         # save site occurrence 0 is the supervisor's begin() good-slot
+         # save — the one save whose failure is handled (retried) rather
+         # than raised.
+         dict(recovery=rec(["save_fail@0"], ), check_finite_every=1)),
+        ("tear_save", False,
+         # Deterministic pairing: tear the SECOND save (epoch 0's
+         # note_good — eval is off so no best-acc save interleaves, and
+         # this template is restricted to the cnn/pipeline trainers,
+         # whose only per-epoch save IS note_good), then a final-epoch
+         # NaN forces a good-slot restore that must walk past the torn
+         # version — checkpoint-torn + checkpoint-fallback + restored,
+         # all on one tenant.
+         dict(recovery=rec(["tear_save@1",
+                            f"nan_loss@{steps_per_epoch + 1}"]),
+              check_finite_every=1)),
+        ("bitflip", True,
+         dict(recovery=rec(["bitflip@2"]), consistency_every=1,
+              max_inflight_steps=1)),
+        ("grad_skew", True,
+         dict(recovery=rec(["grad_skew@2"]), consistency_every=1,
+              max_inflight_steps=1)),
+        ("desync", True,
+         dict(recovery=rec(["desync@2"]), consistency_every=1,
+              max_inflight_steps=1)),
+    ]
+
+
+def build_tenants(workdir: str, rng: random.Random, n_tenants: int,
+                  epochs: int) -> list:
+    """The campaign's initial fleet: heterogeneous workloads cycling
+    cnn / lm / pipeline / moe, each with a fault plan drawn from the
+    menu. Placement rules baked in:
+
+    * corruption kinds land only on the dp>=2 tenants (the trainers
+      reject them anywhere else) — the dp4 cnn slice gives the quorum
+      repair, a dp2 slice exercises the no-quorum restore instead;
+    * ``tear_save`` only on cnn/pipeline (the LM trainer writes an extra
+      per-epoch slot save, which would shift the torn-save occurrence
+      off the good slot and break the deterministic pairing);
+    * at least one tenant always draws a self-preempting kind
+      (``preempt`` or ``stall``) — the campaign must exercise the
+      preempt-checkpoint -> requeue -> exact-step resume loop even when
+      the rng is unlucky, so the last plain-fault tenant is overridden
+      when none drew one.
+    """
+    from distributed_model_parallel_tpu.config import MeshConfig
+    from distributed_model_parallel_tpu.orchestrator import TenantSpec
+
+    menu = _fault_menu(3, epochs)
+    by_label = {m[0]: m for m in menu}
+    plain = [m for m in menu if not m[1]]
+    no_tear = [m for m in plain if m[0] != "tear_save"]
+    specs, labels, overridable = [], [], []
+    for i in range(n_tenants):
+        workload = ("cnn", "lm", "pipeline", "moe")[i % 4]
+        prio = rng.randint(0, 2)
+        name = f"t{i}_{workload}"
+        if workload == "cnn":
+            # dp4: enough replicas for a majority-quorum repair, so the
+            # corruption drills prefer this slice.
+            label, _, kw = rng.choice(menu)
+            cfg = _cnn_config(workdir, name, 4, epochs, **kw)
+            spec = TenantSpec(name=name, workload="cnn", config=cfg,
+                              priority=prio)
+        elif workload == "pipeline":
+            # Single-controller pipeline: no replicated state, no
+            # corruption drills (the trainer rejects them loudly); the
+            # pipeline-specific recovery paths (per-stage restore, LR
+            # shrink rebuild) are exercised by nan/preempt/stall.
+            label, _, kw = rng.choice(plain)
+            cfg = _cnn_config(workdir, name, 1, epochs,
+                              mesh=MeshConfig(data=1, stage=2),
+                              num_microbatches=2, **kw)
+            spec = TenantSpec(name=name, workload="pipeline", config=cfg,
+                              priority=prio)
+        else:                                    # lm / moe
+            label, _, kw = rng.choice(no_tear)
+            kw = dict(kw)
+            kw.pop("max_inflight_steps", None)   # LM syncs every step
+            cfg = _lm_config(workdir, name, 2, epochs,
+                             moe=2 if workload == "moe" else 0, **kw)
+            spec = TenantSpec(name=name, workload="lm", config=cfg,
+                              priority=prio)
+        specs.append(spec)
+        labels.append(label)
+        if workload in ("lm", "moe"):
+            overridable.append(i)
+    if not any(lb in ("preempt", "stall") for lb in labels) and overridable:
+        i = overridable[-1]
+        label, _, kw = by_label["preempt"]
+        workload = ("cnn", "lm", "pipeline", "moe")[i % 4]
+        cfg = _lm_config(workdir, specs[i].name, 2, epochs,
+                         moe=2 if workload == "moe" else 0, **kw)
+        specs[i] = TenantSpec(name=specs[i].name, workload="lm",
+                              config=cfg, priority=specs[i].priority)
+        labels[i] = label
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# one campaign
+# ---------------------------------------------------------------------------
+
+def run_campaign(args, workdir: str, seed: int) -> tuple[dict, bool]:
+    from distributed_model_parallel_tpu.config import RecoveryConfig
+    from distributed_model_parallel_tpu.orchestrator import (
+        Orchestrator,
+        TenantSpec,
+    )
+    from distributed_model_parallel_tpu.utils.telemetry import merge_streams
+    from scripts.dmp_report import build_fleet_report, pair_faults
+
+    rng = random.Random(seed)
+    if args.tenants < 3:
+        raise SystemExit("--tenants must be >= 3 (a soak below three "
+                         "concurrent tenants is a chaos drill, not a soak "
+                         "— scripts/dmp_chaos.py covers those)")
+    orch = Orchestrator(workdir=os.path.join(workdir, "fleet"),
+                        quantum=args.quantum)
+    for spec in build_tenants(workdir, rng, args.tenants, args.epochs):
+        orch.submit(spec)
+
+    # Event schedule: rounds are the campaign's clock, so a fixed seed
+    # fires the same event at the same fleet state every run. Events
+    # land EARLY (the fast campaign is only a handful of rounds long) so
+    # they hit a busy fleet, not a drained one.
+    churn_round = None if args.no_churn else rng.randint(1, 2)
+    shrink_round = None if args.no_shrink else \
+        (churn_round or 1) + rng.randint(1, 2)
+    grow_round = None if shrink_round is None \
+        else shrink_round + rng.randint(2, 3)
+    events: dict = {"churn": None, "shrink": None, "grow": None}
+
+    def on_round(o: Orchestrator, r: int) -> None:
+        if churn_round is not None and r == churn_round \
+                and events["churn"] is None:
+            # Tenant churn + priority preemption in one event: a
+            # high-priority arrival on a full fleet must evict the
+            # lowest-priority victim through the real preempt-checkpoint
+            # path.
+            cfg = _cnn_config(workdir, "hi_burst", 4, 1,
+                              recovery=RecoveryConfig(max_retries=1))
+            o.submit(TenantSpec(name="hi_burst", workload="cnn",
+                                config=cfg, priority=9))
+            events["churn"] = r
+        if shrink_round is not None and r == shrink_round \
+                and events["shrink"] is None:
+            events["shrink"] = {"round": r, "revoked": list(o.shrink(2))}
+        if grow_round is not None and r == grow_round \
+                and events["grow"] is None:
+            events["grow"] = {"round": r, "restored": list(o.grow())}
+
+    t0 = time.time()
+    summary = orch.run(on_round=on_round, max_rounds=2000)
+    orch.close(rounds=summary["rounds"])
+
+    merged = merge_streams(orch.telemetry_paths())
+    print(build_fleet_report(merged))
+    ledger = pair_faults(merged)
+    unpaired = [r for r in ledger if not r["paired"]]
+    tenants = summary["tenants"]
+    incomplete = [n for n, t in tenants.items() if t["state"] != "completed"]
+    preempted = {n: t["preemptions"] for n, t in tenants.items()
+                 if t["preemptions"]}
+    fault_kinds = sorted({r["fault"] for r in ledger})
+    out = {
+        "soak": "multi-tenant-chaos-campaign",
+        "mode": args.mode,
+        "seed": seed,
+        "rounds": summary["rounds"],
+        "wall_s": round(time.time() - t0, 1),
+        "tenants": {n: t["state"] for n, t in tenants.items()},
+        "heterogeneous_workloads": sorted({t["workload"]
+                                           for t in tenants.values()}),
+        "faults_injected": fault_kinds,
+        "faults_paired": len(ledger) - len(unpaired),
+        "faults_unpaired": [f"{r['tenant']}:{r['fault']}" for r in unpaired],
+        "preemptions": preempted,
+        "resumes_exact": summary["all_resumes_exact"],
+        "unrecovered": summary["unrecovered"],
+        "events": events,
+        "telemetry": orch.telemetry_paths(),
+    }
+    ok = (not summary["unrecovered"]
+          and not incomplete
+          and summary["all_resumes_exact"]
+          and not unpaired
+          and bool(ledger)
+          and (args.no_shrink or events["shrink"] is not None)
+          and (args.no_churn or events["churn"] is not None))
+    return out, ok
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dmp_soak_")
+    if args.mode == "fast":
+        summary, ok = run_campaign(args, workdir, args.seed)
+        print(json.dumps(summary), flush=True)
+        return 0 if ok else 1
+    # long mode: campaign after campaign with derived seeds until the
+    # wall-clock budget is spent; one failure fails the soak.
+    t0 = time.time()
+    campaigns, all_ok = [], True
+    i = 0
+    while time.time() - t0 < args.duration_s:
+        sub = os.path.join(workdir, f"campaign_{i}")
+        os.makedirs(sub, exist_ok=True)
+        summary, ok = run_campaign(args, sub, args.seed + i)
+        campaigns.append({"seed": summary["seed"], "ok": ok,
+                          "wall_s": summary["wall_s"],
+                          "faults": summary["faults_injected"],
+                          "unrecovered": summary["unrecovered"],
+                          "unpaired": summary["faults_unpaired"]})
+        all_ok = all_ok and ok
+        i += 1
+    print(json.dumps({"soak": "long", "campaigns": campaigns,
+                      "n_campaigns": i,
+                      "wall_s": round(time.time() - t0, 1),
+                      "all_ok": all_ok}), flush=True)
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,272 @@
+"""Multi-tenant orchestrator (distributed_model_parallel_tpu/orchestrator/):
+admission control, deterministic priority preemption, exact-step resume
+through the real preempt-checkpoint machinery, and the never-overlapping
+device-slice invariant."""
+
+import os
+
+import pytest
+
+import jax
+
+from distributed_model_parallel_tpu.config import (
+    MeshConfig,
+    RecoveryConfig,
+)
+from distributed_model_parallel_tpu.orchestrator import (
+    DevicePool,
+    Orchestrator,
+    Scheduler,
+    TenantSpec,
+    TenantState,
+)
+from distributed_model_parallel_tpu.train.trainer import Trainer
+
+from tests.conftest import tiny_train_config
+from tests.test_elastic import _params_equal
+
+
+def _tenant_cfg(tmp_path, name, dp=4, epochs=2, **kw):
+    """Tenant-unique dirs over the shared tiny recipe (3 steps/epoch:
+    96 synthetic samples at batch 32)."""
+    base = dict(
+        mesh=MeshConfig(data=dp), epochs=epochs,
+        log_dir=str(tmp_path / name / "log"),
+        checkpoint_dir=str(tmp_path / name / "ckpt"),
+        log_name=name, eval_every=100,
+    )
+    base.update(kw)
+    return tiny_train_config(tmp_path, **base)
+
+
+# ---------------------------------------------------------------------------
+# pure scheduler units (no trainers, no threads)
+# ---------------------------------------------------------------------------
+
+def test_device_pool_assign_release_disjoint(devices):
+    pool = DevicePool(devices)
+    a = pool.assign("a", 3)
+    b = pool.assign("b", 3)
+    assert not set(pool.assigned_ids("a")) & set(pool.assigned_ids("b"))
+    assert pool.n_free == len(devices) - 6
+    with pytest.raises(RuntimeError, match="already holds"):
+        pool.assign("a", 1)
+    with pytest.raises(RuntimeError, match="only"):
+        pool.assign("c", pool.n_free + 1)
+    pool.release("a")
+    assert pool.n_free == len(devices) - 3
+    assert len(a) == 3 and len(b) == 3
+
+
+def test_device_pool_revoke_prefers_free_then_held(devices):
+    pool = DevicePool(devices)
+    pool.assign("a", 6)             # ids 0..5; free: 6, 7
+    revoked = pool.revoke(3)        # 2 free + 1 held
+    assert len(revoked) == 3
+    assert pool.n_free == 0
+    assert "a" in pool.holders_of_revoked()
+    # a releases: its revoked id must NOT come back to the free list
+    pool.release("a")
+    assert pool.n_free == 5
+    # grow: everything returns
+    pool.restore()
+    assert pool.n_free == len(devices)
+
+
+def test_resolve_slice_corruption_needs_replicas(tmp_path, devices):
+    sched = Scheduler(DevicePool(devices))
+    spec = TenantSpec(
+        name="c", workload="cnn",
+        config=_tenant_cfg(tmp_path, "c", dp=4,
+                           recovery=RecoveryConfig(
+                               max_retries=1, faults=("bitflip@1",)),
+                           consistency_every=1))
+    assert spec.min_devices() == 2          # corruption needs 2 replicas
+    assert sched.resolve_slice(spec, 1) is None
+    assert sched.resolve_slice(spec, 2) == 2
+    assert sched.resolve_slice(spec, 8) == 4     # capped at mesh.data
+    plain = TenantSpec(name="p", workload="cnn",
+                       config=_tenant_cfg(tmp_path, "p", dp=4))
+    assert sched.resolve_slice(plain, 1) == 1    # dp elastic down to 1
+
+
+def test_resolve_slice_pipeline_not_elastic(tmp_path, devices):
+    sched = Scheduler(DevicePool(devices))
+    spec = TenantSpec(
+        name="pp", workload="pipeline",
+        config=_tenant_cfg(tmp_path, "pp", dp=1,
+                           mesh=MeshConfig(data=1, stage=2),
+                           num_microbatches=2))
+    assert sched.resolve_slice(spec, 1) is None
+    assert sched.resolve_slice(spec, 2) == 2
+    assert sched.resolve_slice(spec, 8) == 2     # exactly the stage count
+
+
+# ---------------------------------------------------------------------------
+# trainer step hook (the yieldable run-loop surface the baton rides on)
+# ---------------------------------------------------------------------------
+
+def test_trainer_step_hook_called_every_step(tmp_path):
+    cfg = tiny_train_config(tmp_path, epochs=1, mesh=MeshConfig(data=4))
+    t = Trainer(cfg)
+    seen = []
+    t.step_hook = lambda tr: seen.append(tr._global_step)
+    t.fit()
+    # 96/32 = 3 steps; the hook fires BEFORE each step dispatches.
+    assert seen == [0, 1, 2]
+
+
+def test_step_hook_preemption_honored_before_next_step(tmp_path):
+    cfg = tiny_train_config(tmp_path, epochs=1, mesh=MeshConfig(data=4),
+                            checkpoint_dir=str(tmp_path / "hk"))
+    t = Trainer(cfg)
+
+    def hook(tr):
+        if tr._global_step == 2:
+            tr.preemption.request()
+
+    t.step_hook = hook
+    t.fit()
+    # Preemption requested at the step-2 boundary stops BEFORE step 2.
+    assert t._global_step == 2
+    assert t.ckpt.exists("preempt")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end orchestration
+# ---------------------------------------------------------------------------
+
+def _replay_no_overlap(fleet_jsonl):
+    """Replay the fleet lifecycle stream and assert no device is ever
+    held by two tenants at once."""
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+    held = {}
+    for r in read_records(fleet_jsonl):
+        if r.get("kind") != "tenant":
+            continue
+        name, event = r.get("name"), r.get("event")
+        if event == "admitted":
+            ids = set(r.get("devices") or [])
+            for other, other_ids in held.items():
+                assert not ids & other_ids, (
+                    f"{name} admitted onto {sorted(ids & other_ids)} "
+                    f"while {other} still holds them")
+            held[name] = ids
+        elif event in ("preempted", "completed", "failed", "cancelled"):
+            held.pop(name, None)
+    return held
+
+
+def test_priority_preemption_deterministic_order(tmp_path):
+    """A full pool + a high-priority arrival: the victim must be the
+    LOWEST-priority, NEWEST-admitted tenant, the arrival must land on the
+    freed slice, and the victim must resume at its exact global step.
+    Every expectation here is exact — any timing dependence in the
+    scheduler would flake it."""
+    orch = Orchestrator(workdir=str(tmp_path / "fleet"), quantum=1)
+    orch.submit(TenantSpec(name="low_a", workload="cnn", priority=1,
+                           config=_tenant_cfg(tmp_path, "low_a", dp=4,
+                                              epochs=2)))
+    orch.submit(TenantSpec(name="low_b", workload="cnn", priority=0,
+                           config=_tenant_cfg(tmp_path, "low_b", dp=4,
+                                              epochs=2)))
+
+    def on_round(o, r):
+        if r == 1 and "hi" not in o.tenants:
+            o.submit(TenantSpec(
+                name="hi", workload="cnn", priority=5,
+                config=_tenant_cfg(tmp_path, "hi", dp=4, epochs=1)))
+
+    summary = orch.run(on_round=on_round, max_rounds=200)
+    orch.close()
+    assert all(t["state"] == "completed"
+               for t in summary["tenants"].values()), summary
+    # victim selection: low_b has the lower priority -> preempted; low_a
+    # untouched.
+    assert summary["tenants"]["low_b"]["preemptions"] == 1
+    assert summary["tenants"]["low_a"]["preemptions"] == 0
+    assert summary["tenants"]["low_b"]["resumed_exact_step"] == [True]
+    assert summary["all_resumes_exact"]
+    # deterministic admission order and slices: low_a [0-3], low_b [4-7],
+    # hi onto low_b's freed slice, low_b back after hi completes.
+    grants = [(a["tenant"], a["devices"]) for a in summary["assignments"]]
+    assert grants[0] == ("low_a", (0, 1, 2, 3))
+    assert grants[1] == ("low_b", (4, 5, 6, 7))
+    assert grants[2] == ("hi", (4, 5, 6, 7))
+    assert grants[3][0] == "low_b"
+    _replay_no_overlap(os.path.join(str(tmp_path / "fleet"),
+                                    "fleet.jsonl"))
+
+
+def test_preempted_tenant_resumes_exact_step_bitwise(tmp_path):
+    """Orchestrator preemption + resume must reproduce the PR 4
+    guarantee end to end: the resumed tenant continues at the exact
+    global step and finishes bitwise-identical to a never-preempted solo
+    run of the same config."""
+    solo_cfg = _tenant_cfg(tmp_path, "solo", dp=4, epochs=2)
+    solo = Trainer(solo_cfg)
+    solo.fit()
+
+    orch = Orchestrator(workdir=str(tmp_path / "fleet2"), quantum=1)
+    tenant = orch.submit(TenantSpec(
+        name="orc", workload="cnn",
+        config=_tenant_cfg(tmp_path, "orc", dp=4, epochs=2)))
+    # Advance until mid-epoch-1 (3 steps/epoch), then preempt.
+    while tenant.state is not TenantState.RUNNING or tenant.global_step < 4:
+        orch.run_round()
+    orch.preempt("orc", reason="test")
+    summary = orch.run(max_rounds=200)
+    orch.close()
+    assert summary["tenants"]["orc"]["state"] == "completed"
+    assert summary["tenants"]["orc"]["preemptions"] == 1
+    assert summary["tenants"]["orc"]["resumed_exact_step"] == [True]
+    assert _params_equal(solo.state.params, tenant.trainer.state.params)
+    assert int(jax.device_get(tenant.trainer.state.step)) == \
+        int(jax.device_get(solo.state.step))
+
+
+def test_heterogeneous_tenants_never_overlap(tmp_path):
+    """cnn + lm + pipeline sharing the 8-device pool: disjoint slices
+    throughout, everyone completes."""
+    from distributed_model_parallel_tpu.models.transformer import (
+        TransformerConfig,
+    )
+    from distributed_model_parallel_tpu.train.lm_trainer import (
+        LMTrainConfig,
+    )
+
+    orch = Orchestrator(workdir=str(tmp_path / "fleet3"), quantum=2)
+    orch.submit(TenantSpec(name="cnn", workload="cnn",
+                           config=_tenant_cfg(tmp_path, "cnn", dp=4,
+                                              epochs=1)))
+    orch.submit(TenantSpec(
+        name="lm", workload="lm",
+        config=LMTrainConfig(
+            model=TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                    n_layers=2, d_ff=64, max_seq_len=16),
+            mesh=MeshConfig(data=2), batch_size=4, seq_len=16,
+            steps_per_epoch=3, epochs=1, n_tokens=2000, eval_batches=0,
+            log_dir=str(tmp_path / "lm" / "log"),
+            checkpoint_dir=str(tmp_path / "lm" / "ckpt"), log_name="lm")))
+    orch.submit(TenantSpec(
+        name="pipe", workload="pipeline",
+        config=_tenant_cfg(tmp_path, "pipe", dp=1, epochs=1,
+                           mesh=MeshConfig(data=1, stage=2),
+                           num_microbatches=2)))
+    summary = orch.run(max_rounds=200)
+    orch.close()
+    assert all(t["state"] == "completed"
+               for t in summary["tenants"].values()), summary
+    held_after = _replay_no_overlap(
+        os.path.join(str(tmp_path / "fleet3"), "fleet.jsonl"))
+    assert held_after == {}        # everything released at the end
+
+
+def test_submit_rejects_shared_checkpoint_dir(tmp_path):
+    orch = Orchestrator(workdir=str(tmp_path / "fleet4"))
+    cfg = _tenant_cfg(tmp_path, "x", dp=2)
+    orch.submit(TenantSpec(name="x", workload="cnn", config=cfg))
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        orch.submit(TenantSpec(name="y", workload="cnn", config=cfg))
+    orch.close()

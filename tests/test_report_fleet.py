@@ -1,0 +1,228 @@
+"""Fleet reporting + bench degradation satellites: tenant-tagged
+telemetry and stream merging (utils/telemetry.py), the fault-pairing
+ledger and fleet report (scripts/dmp_report.py), the roofline
+measurement-error flag, and bench.py's mid-run backend-loss record."""
+
+import json
+
+import pytest
+
+from distributed_model_parallel_tpu.utils.telemetry import (
+    TelemetryRun,
+    merge_streams,
+    read_records,
+    tenant_scope,
+)
+from scripts.dmp_report import (
+    build_fleet_report,
+    build_report,
+    pair_faults,
+)
+
+
+# ---------------------------------------------------------------------------
+# tenant tagging + merge
+# ---------------------------------------------------------------------------
+
+def test_tenant_scope_tags_every_record(tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    with tenant_scope("t0"):
+        run = TelemetryRun(path, run="r")
+        run.step(step=0, step_time_s=0.1)
+        run.failure("non-finite")
+    recs = read_records(path)
+    assert recs and all(r.get("tenant") == "t0" for r in recs)
+    # outside any scope: no tag
+    path2 = str(tmp_path / "b.jsonl")
+    run2 = TelemetryRun(path2, run="r2")
+    run2.step(step=0)
+    assert all("tenant" not in r for r in read_records(path2))
+
+
+def test_tenant_scope_is_thread_local(tmp_path):
+    import threading
+
+    paths = {}
+
+    def open_stream(name):
+        with tenant_scope(name):
+            run = TelemetryRun(str(tmp_path / f"{name}.jsonl"), run=name)
+            run.event("hello")
+            paths[name] = run.path
+
+    threads = [threading.Thread(target=open_stream, args=(f"t{i}",))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for name, path in paths.items():
+        assert all(r.get("tenant") == name for r in read_records(path))
+
+
+def test_merge_streams_orders_and_skips_missing(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    with tenant_scope("a"):
+        TelemetryRun(a, run="a").event("one")
+    with tenant_scope("b"):
+        TelemetryRun(b, run="b").event("two")
+    merged = merge_streams([a, b, str(tmp_path / "missing.jsonl")])
+    assert merged
+    ts = [r["ts"] for r in merged]
+    assert ts == sorted(ts)
+    assert {r["tenant"] for r in merged} == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# fault-pairing ledger
+# ---------------------------------------------------------------------------
+
+def _rec(kind, tenant="t", ts=0.0, **kw):
+    return {"kind": kind, "tenant": tenant, "ts": ts, **kw}
+
+
+def test_pair_faults_pairs_detection_and_action():
+    records = [
+        _rec("fault", ts=1, fault="nan_loss", site="step"),
+        _rec("failure", ts=2, error="non-finite"),
+        _rec("recovery", ts=3, action="restored"),
+    ]
+    ledger = pair_faults(records)
+    assert len(ledger) == 1
+    assert ledger[0]["paired"]
+    assert ledger[0]["detected"] == "non-finite"
+    assert ledger[0]["action"] == "restored"
+
+
+def test_pair_faults_flags_undetected_and_unrecovered():
+    records = [
+        _rec("fault", ts=1, fault="nan_loss", site="step"),
+        # a detection that does NOT match the kind's pairing
+        _rec("failure", ts=2, error="stall"),
+    ]
+    ledger = pair_faults(records)
+    assert len(ledger) == 1 and not ledger[0]["paired"]
+    # corruption repaired in place: consistency records close the loop
+    records = [
+        _rec("fault", ts=1, fault="bitflip", site="step"),
+        _rec("consistency", ts=2, status="divergence"),
+        _rec("consistency", ts=3, status="repaired"),
+    ]
+    assert pair_faults(records)[0]["paired"]
+
+
+def test_pair_faults_does_not_share_recoveries():
+    """Two injections cannot claim one recovery record."""
+    records = [
+        _rec("fault", ts=1, fault="nan_loss", site="step"),
+        _rec("fault", ts=2, fault="nan_loss", site="step"),
+        _rec("failure", ts=3, error="non-finite"),
+        _rec("recovery", ts=4, action="restored"),
+    ]
+    ledger = pair_faults(records)
+    assert [row["paired"] for row in ledger] == [True, False]
+
+
+def test_build_fleet_report_renders_tenants_and_ledger():
+    records = [
+        {"kind": "tenant", "ts": 1, "name": "t", "event": "submitted"},
+        {"kind": "tenant", "ts": 2, "name": "t", "event": "admitted",
+         "devices": [0, 1]},
+        _rec("fault", ts=3, fault="preempt", site="step"),
+        _rec("failure", ts=4, error="preempted"),
+        _rec("recovery", ts=5, action="checkpoint-and-exit"),
+        _rec("resume", ts=6, slot="preempt", global_step=4),
+        {"kind": "tenant", "ts": 7, "name": "t", "event": "completed"},
+    ]
+    out = build_fleet_report(records)
+    assert "== tenant t ==" in out
+    assert "fault ledger (1 injected)" in out
+    assert "ok" in out
+    assert "(none — every injected fault was detected and recovered" in out
+
+
+# ---------------------------------------------------------------------------
+# roofline: frac > 1 is a measurement error, not a fact
+# ---------------------------------------------------------------------------
+
+def _roofline_records(bytes_per_step):
+    return [
+        {"kind": "run_start", "ts": 0, "run": "bench",
+         "device": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                    "n_devices": 1}, "meta": {}},
+        {"kind": "step", "ts": 1, "step": 0, "step_time_s": 0.01},
+        {"kind": "cost_analysis", "ts": 2,
+         "device_flops_per_step": 1e9,
+         "bytes_accessed_per_step": bytes_per_step},
+    ]
+
+
+def test_report_flags_impossible_roofline_fraction():
+    # 12 GB in 10 ms = 1200 GB/s >> the 819 GB/s v5e peak
+    out = build_report(_roofline_records(12e9))
+    assert "MEASUREMENT ERROR" in out
+    assert "1.47x" in out or "1.46x" in out
+    # a physically possible fraction still renders as a roofline position
+    ok = build_report(_roofline_records(4e9))     # 400 GB/s -> 0.49x
+    assert "MEASUREMENT ERROR" not in ok
+    assert "HBM roofline: demand" in ok
+
+
+def test_bench_demand_frac_helper():
+    from bench import demand_frac_of_peak
+
+    frac, err = demand_frac_of_peak(400e9, 819e9)
+    assert err is None and frac == pytest.approx(0.488, abs=1e-3)
+    frac, err = demand_frac_of_peak(1200e9, 819e9)
+    assert frac is None and "overcount" in err
+    assert demand_frac_of_peak(None, 819e9) == (None, None)
+    assert demand_frac_of_peak(1e9, None) == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# bench.py: backend lost mid-run -> parseable record, rc 0 semantics
+# ---------------------------------------------------------------------------
+
+def test_bench_classifies_backend_unavailability():
+    from bench import is_backend_unavailable
+
+    assert is_backend_unavailable(
+        RuntimeError("Unable to initialize backend 'axon': UNAVAILABLE: "
+                     "TPU backend setup/compile error (Unavailable)."))
+    assert is_backend_unavailable(
+        RuntimeError("UNAVAILABLE: Socket closed"))
+    assert not is_backend_unavailable(ValueError("shapes mismatch"))
+
+
+def test_bench_emits_record_when_backend_dies_mid_run(tmp_path,
+                                                      monkeypatch, capsys):
+    import bench
+
+    telem = str(tmp_path / "bench_telemetry.jsonl")
+    monkeypatch.setenv("DMP_TELEMETRY", telem)
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+
+    monkeypatch.setattr(bench, "_run_workload", boom)
+    bench.main()                    # must NOT raise — rc 0 semantics
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["error"] == "tpu-unreachable"
+    assert rec["stage"] == "workload"
+    assert rec["value"] is None
+    # the failure also landed on the telemetry stream
+    recs = read_records(telem)
+    assert any(r.get("kind") == "failure"
+               and r.get("error") == "tpu-unreachable" for r in recs)
+
+
+def test_bench_mid_run_real_bugs_still_raise(monkeypatch):
+    import bench
+
+    def boom():
+        raise ValueError("a real bug, not an infra flake")
+
+    monkeypatch.setattr(bench, "_run_workload", boom)
+    with pytest.raises(ValueError, match="real bug"):
+        bench.main()

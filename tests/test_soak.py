@@ -1,0 +1,36 @@
+"""Chaos-soak smoke (scripts/dmp_soak.py): the cross-feature interaction
+surface — concurrent heterogeneous tenants, injected faults, priority
+preemption, topology shrink — exercised on every chaos-tier run."""
+
+import pytest
+
+
+@pytest.mark.chaos
+def test_soak_fast_campaign_smoke(tmp_path):
+    """The ISSUE-6 acceptance drill: a fixed-seed fast campaign with >= 3
+    heterogeneous tenants, >= 2 injected fault kinds, one topology
+    shrink and one tenant-churn event must complete with zero
+    unrecovered failures, every preempted tenant resuming at its exact
+    global step, and every injected fault paired on the fleet report."""
+    from scripts.dmp_soak import parse_args, run_campaign
+
+    args = parse_args(["--seed", "0"])
+    summary, ok = run_campaign(args, str(tmp_path), 0)
+    assert ok, summary
+    # >= 3 concurrent heterogeneous tenants (+ the churn arrival)
+    assert len(summary["tenants"]) >= 4
+    assert len(summary["heterogeneous_workloads"]) >= 3
+    assert all(state == "completed"
+               for state in summary["tenants"].values()), summary
+    # >= 2 injected fault kinds, every one paired with its recovery
+    assert len(summary["faults_injected"]) >= 2
+    assert summary["faults_unpaired"] == []
+    assert summary["faults_paired"] >= 2
+    # the chaos events really happened
+    assert summary["events"]["shrink"] is not None
+    assert summary["events"]["churn"] is not None
+    # zero unrecovered failures; preemptions occurred and every resume
+    # landed at the exact global step
+    assert summary["unrecovered"] == {}
+    assert summary["preemptions"]
+    assert summary["resumes_exact"]
