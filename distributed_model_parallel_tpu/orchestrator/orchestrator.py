@@ -57,7 +57,9 @@ class Orchestrator:
     def __init__(self, devices: Sequence | None = None, *,
                  workdir: str = "./orchestrator",
                  quantum: int = 2,
-                 max_stagnant_rounds: int = 50):
+                 max_stagnant_rounds: int = 50,
+                 health=None,
+                 grow_back: bool = True):
         if devices is None:
             import jax
 
@@ -66,6 +68,17 @@ class Orchestrator:
         self.scheduler = Scheduler(self.pool)
         self.quantum = max(1, int(quantum))
         self.max_stagnant_rounds = max_stagnant_rounds
+        # Device-health sentinel (utils/health.DeviceHealthMonitor): when
+        # given, it is installed process-wide so the tenants' trainers
+        # feed it timing signals, and every round consumes its
+        # transitions — quarantine + proactive migration, probation
+        # reinstate + grow-back. None = reactive-only orchestration.
+        self.health = health
+        self.grow_back = bool(grow_back)
+        if health is not None:
+            from distributed_model_parallel_tpu.utils import health as hm
+
+            hm.install(health)
         self.workdir = workdir
         os.makedirs(workdir, exist_ok=True)
         self.telemetry = TelemetryRun(
@@ -167,6 +180,79 @@ class Orchestrator:
                                   message=f"topology grow: restored {ids}")
         return ids
 
+    # -- device health (utils/health.py) --------------------------------------
+    def _apply_health(self) -> None:
+        """Consume the health monitor's transitions for this round: every
+        event becomes a typed ``health`` record on the fleet stream;
+        newly quarantined devices leave the pool and their holders are
+        proactively migrated — preempted through the ordinary
+        preempt-checkpoint path *before* the degradation becomes a crash
+        — and reinstated devices return to the free pool (where the
+        grow-back pass may expand a shrunken tenant onto them)."""
+        if self.health is None:
+            return
+        events = self.health.tick()
+        quarantine: list[int] = []
+        reinstate: list[int] = []
+        for ev in events:
+            self.telemetry.record("health", round=self.rounds, **ev)
+            if ev["event"] == "quarantine":
+                quarantine += ev["devices"]
+            elif ev["event"] == "reinstate":
+                reinstate += ev["devices"]
+        if reinstate:
+            back = self.pool.reinstate(reinstate)
+            if back:
+                self.telemetry.record(
+                    "event", message=f"health reinstate: {back} back in "
+                                     f"service after probation")
+        if quarantine:
+            # A maintenance-revoked device is already out of service —
+            # quarantining it on top is a policy conflict the pool
+            # rejects; it re-enters health scoring when restored.
+            eligible = [i for i in quarantine
+                        if i not in self.pool.revoked_ids]
+            ids = self.pool.quarantine(eligible) if eligible else ()
+            if ids:
+                self.telemetry.record(
+                    "event",
+                    message=f"health quarantine: {ids} out of service")
+            for name in self.pool.holders_of_quarantined():
+                self._preempt(self.tenants[name], reason="device-degraded")
+
+    def _maybe_grow_back(self) -> None:
+        """Grow-back elasticity: a tenant running below its requested
+        data-parallel degree (it was re-admitted onto a shrunken slice)
+        is preempt-checkpointed and re-queued as soon as enough devices
+        are free to place it larger — re-admission then lands it on the
+        bigger slice at the exact global step. Only fires when the queue
+        is empty (queued tenants own freed devices first — grow-back
+        must not starve admissions) and at most one tenant per round
+        (the re-queued tenant's own admission settles before the next
+        candidate is considered, so growth never thrashes)."""
+        if not self.grow_back or self.pool.n_free == 0:
+            return
+        if self._by_state(TenantState.QUEUED):
+            return
+        for t in sorted(self._by_state(TenantState.RUNNING),
+                        key=lambda t: t.admit_seq):
+            if not t.alive or t.spec.workload == "pipeline":
+                continue
+            cur = len(t.devices)
+            want = self.scheduler.resolve_slice(
+                t.spec, self.pool.n_free + cur)
+            if want is not None and want > cur:
+                # grow_backs counts GRANTED expansions: _admit compares
+                # the re-admission grant against this size (the pool can
+                # shrink again while the tenant drains, in which case
+                # the cycle was churn, not growth).
+                t._grow_back_from = cur
+                self._preempt(t, reason="grow-back")
+                self._record(t, "grow-back", devices=list(t.devices),
+                             target_devices=want,
+                             global_step=t.global_step)
+                return
+
     # -- the control loop -----------------------------------------------------
     def _admit(self) -> int:
         """Serve the queue in (priority desc, submission order): grant
@@ -190,6 +276,10 @@ class Orchestrator:
                         raise RuntimeError(
                             f"device overlap: {waiter.name!r} granted "
                             f"{granted} while {other!r} holds {ids}")
+                if getattr(waiter, "_grow_back_from", None) is not None:
+                    if n > waiter._grow_back_from:
+                        waiter.grow_backs += 1
+                    waiter._grow_back_from = None
                 waiter.start(devices, self._admit_seq)
                 self._admit_seq += 1
                 self.assignment_log.append(
@@ -260,7 +350,9 @@ class Orchestrator:
         the quantum (admission order — deterministic), reap. Returns
         whether any tenant advanced or changed state."""
         before = {n: t.state for n, t in self.tenants.items()}
+        self._apply_health()
         admitted = self._admit()
+        self._maybe_grow_back()
         moved = admitted > 0
         for tenant in sorted(self._by_state(TenantState.RUNNING,
                                             TenantState.PREEMPTING),
@@ -287,26 +379,35 @@ class Orchestrator:
         RuntimeError past ``max_rounds``.
         """
         stagnant = 0
-        while self.pending():
-            if max_rounds is not None and self.rounds >= max_rounds:
-                raise RuntimeError(
-                    f"orchestrator exceeded {max_rounds} rounds with "
-                    f"tenants still pending: "
-                    f"{[t.name for t in self._by_state(TenantState.QUEUED, TenantState.RUNNING, TenantState.PREEMPTING)]}")
-            if on_round is not None:
-                on_round(self, self.rounds)
-            if self.run_round():
-                stagnant = 0
-            else:
-                stagnant += 1
-                if stagnant > self.max_stagnant_rounds:
-                    waiting = [t.name for t in
-                               self._by_state(TenantState.QUEUED)]
-                    raise UnschedulableError(
-                        f"no progress for {stagnant} rounds; queued "
-                        f"tenants {waiting} cannot be placed on "
-                        f"{self.pool.n_free} free devices "
-                        f"(revoked: {self.pool.revoked_ids})")
+        try:
+            while self.pending():
+                if max_rounds is not None and self.rounds >= max_rounds:
+                    raise RuntimeError(
+                        f"orchestrator exceeded {max_rounds} rounds with "
+                        f"tenants still pending: "
+                        f"{[t.name for t in self._by_state(TenantState.QUEUED, TenantState.RUNNING, TenantState.PREEMPTING)]}")
+                if on_round is not None:
+                    on_round(self, self.rounds)
+                if self.run_round():
+                    stagnant = 0
+                else:
+                    stagnant += 1
+                    if stagnant > self.max_stagnant_rounds:
+                        waiting = [t.name for t in
+                                   self._by_state(TenantState.QUEUED)]
+                        raise UnschedulableError(
+                            f"no progress for {stagnant} rounds; queued "
+                            f"tenants {waiting} cannot be placed on "
+                            f"{self.pool.n_free} free devices "
+                            f"(revoked: {self.pool.revoked_ids}, "
+                            f"quarantined: {self.pool.quarantined_ids})")
+        except BaseException:
+            # A campaign dying mid-run never reaches close(): the
+            # process-wide health monitor must not keep collecting (and
+            # queueing events for) a dead campaign from later runs in
+            # the same process.
+            self._uninstall_health()
+            raise
         return self.summary()
 
     # -- results --------------------------------------------------------------
@@ -325,16 +426,24 @@ class Orchestrator:
         accounting, and the unrecovered-failure ledger."""
         tenants = {}
         for t in sorted(self.tenants.values(), key=lambda t: t.seq):
+            grants = [a["devices"] for a in self.assignment_log
+                      if a["tenant"] == t.name]
             tenants[t.name] = {
                 "workload": t.spec.workload,
                 "priority": t.priority,
                 "state": t.state.value,
                 "attempts": t.attempts,
                 "preemptions": t.preemptions,
+                "grow_backs": t.grow_backs,
                 "resumed_exact_step": t.resume_exact,
                 "resume_fallbacks": t.resume_fallbacks,
                 "global_step": t.global_step,
                 "faults_injected": [s.kind for s in t.fired_faults],
+                # Slice trajectory across admissions: the shrink/grow-back
+                # story in one list (requested = the config-mesh ceiling).
+                "requested_devices": t.spec.requested_devices(),
+                "granted_sizes": [len(g) for g in grants],
+                "counters": t.counter_deltas,
             }
         failed = {t.name: f"{type(t.error).__name__}: {t.error}"[:300]
                   for t in self.tenants.values()
@@ -348,5 +457,13 @@ class Orchestrator:
             "assignments": self.assignment_log,
         }
 
+    def _uninstall_health(self) -> None:
+        if self.health is not None:
+            from distributed_model_parallel_tpu.utils import health as hm
+
+            if hm.installed() is self.health:
+                hm.uninstall()
+
     def close(self, **fields) -> None:
+        self._uninstall_health()
         self.telemetry.finish(**fields)

@@ -42,8 +42,14 @@ class DevicePool:
 
     ``revoke``/``restore`` model topology shrink/grow (a maintenance
     event taking a sub-slice away and giving it back): revoked devices
-    exist but are not schedulable. Devices are keyed by ``id`` so the
-    ledger is printable and test-assertable.
+    exist but are not schedulable. ``quarantine``/``reinstate`` are the
+    *health-driven* counterpart (utils/health.py): same not-schedulable
+    effect, but auto-reversible — the health sentinel quarantines a
+    degrading device proactively and reinstates it after probation,
+    while a revoke lasts until the maintenance event ends. The two sets
+    are disjoint (a device is out of service for one adjudicated reason
+    at a time). Devices are keyed by ``id`` so the ledger is printable
+    and test-assertable.
     """
 
     def __init__(self, devices: Sequence):
@@ -52,6 +58,7 @@ class DevicePool:
             raise ValueError("device pool needs at least one device")
         self._free = [d.id for d in self.devices]
         self._revoked: list[int] = []
+        self._quarantined: list[int] = []
         self._assigned: dict[str, tuple[int, ...]] = {}
         self._by_id = {d.id: d for d in self.devices}
 
@@ -63,6 +70,10 @@ class DevicePool:
     @property
     def revoked_ids(self) -> tuple[int, ...]:
         return tuple(sorted(self._revoked))
+
+    @property
+    def quarantined_ids(self) -> tuple[int, ...]:
+        return tuple(sorted(self._quarantined))
 
     def assigned_ids(self, tenant: str) -> tuple[int, ...]:
         return self._assigned.get(tenant, ())
@@ -88,18 +99,29 @@ class DevicePool:
                 f"cannot grant {n} devices to {tenant!r}: only "
                 f"{len(self._free)} free")
         grant = sorted(self._free)[:n]
+        if set(grant) & set(self._quarantined):
+            # Quarantined ids never sit in the free list; reaching this
+            # means the ledger itself is corrupt — typed so tests (and
+            # operators) see the health subsystem, not a generic crash.
+            from distributed_model_parallel_tpu.utils.health import (
+                DeviceDegradedError,
+            )
+
+            raise DeviceDegradedError(
+                f"grant for {tenant!r} includes quarantined devices "
+                f"{sorted(set(grant) & set(self._quarantined))}")
         self._free = [i for i in self._free if i not in grant]
         self._assigned[tenant] = tuple(grant)
         return tuple(self._by_id[i] for i in grant)
 
     def release(self, tenant: str) -> tuple[int, ...]:
         """Return a tenant's slice to the pool (preemption drained or job
-        finished). Devices revoked while held go to the revoked set, not
-        the free list."""
+        finished). Devices revoked or quarantined while held go to their
+        out-of-service set, not the free list."""
         ids = self._assigned.pop(tenant, ())
         for i in ids:
-            if i in self._revoked:
-                continue            # revoked mid-hold: stays out of service
+            if i in self._revoked or i in self._quarantined:
+                continue            # taken out mid-hold: stays out of service
             self._free.append(i)
         return ids
 
@@ -108,19 +130,22 @@ class DevicePool:
         devices go first (highest ids first, so low-id grants stay
         stable); if that is not enough, the remainder is marked revoked
         in place — the scheduler must preempt the holders and their
-        release will not re-free the revoked ids."""
+        release will not re-free the revoked ids. Quarantined devices are
+        already out of service and are never double-claimed by a revoke."""
         out: list[int] = []
         free_take = sorted(self._free, reverse=True)[:n]
         self._free = [i for i in self._free if i not in free_take]
         out += free_take
         if len(out) < n:
             held = sorted((i for ids in self._assigned.values() for i in ids
-                           if i not in self._revoked), reverse=True)
+                           if i not in self._revoked
+                           and i not in self._quarantined), reverse=True)
             out += held[:n - len(out)]
         if len(out) < n:
             raise ValueError(
                 f"cannot revoke {n} devices: pool has "
-                f"{len(self.devices) - len(self._revoked)} in service")
+                f"{len(self.devices) - len(self._revoked) - len(self._quarantined)}"
+                f" in service")
         self._revoked += out
         return tuple(sorted(out))
 
@@ -142,6 +167,51 @@ class DevicePool:
         rev = set(self._revoked)
         return sorted(t for t, ids in self._assigned.items()
                       if rev & set(ids))
+
+    # -- health-driven transitions (utils/health.py) -------------------------
+    def quarantine(self, ids: Sequence[int]) -> tuple[int, ...]:
+        """Take degrading devices out of service on the health sentinel's
+        verdict. Free ids leave the free list; held ids are marked in
+        place (the orchestrator preempts the holders — their release
+        will not re-free them). Already-quarantined ids are idempotent
+        no-ops; revoking and quarantining the same device is a policy
+        conflict and raises."""
+        out: list[int] = []
+        for i in ids:
+            i = int(i)
+            if i not in self._by_id:
+                raise KeyError(f"unknown device id {i}")
+            if i in self._quarantined:
+                continue
+            if i in self._revoked:
+                raise ValueError(
+                    f"device {i} is revoked (maintenance) — it cannot "
+                    f"also be health-quarantined; restore it first")
+            self._quarantined.append(i)
+            if i in self._free:
+                self._free.remove(i)
+            out.append(i)
+        return tuple(sorted(out))
+
+    def reinstate(self, ids: Sequence[int] | None = None) -> tuple[int, ...]:
+        """Return quarantined devices to service after probation
+        (utils/health.py hysteresis); ids still held by a draining
+        tenant are un-quarantined in place. ``None`` reinstates all."""
+        take = (sorted(self._quarantined) if ids is None
+                else [int(i) for i in ids if int(i) in self._quarantined])
+        self._quarantined = [i for i in self._quarantined if i not in take]
+        held = {i for a in self._assigned.values() for i in a}
+        for i in take:
+            if i not in held:
+                self._free.append(i)
+        return tuple(sorted(take))
+
+    def holders_of_quarantined(self) -> list[str]:
+        """Tenants currently holding a quarantined device — the ones the
+        health loop must migrate off it."""
+        bad = set(self._quarantined)
+        return sorted(t for t, ids in self._assigned.items()
+                      if bad & set(ids))
 
 
 class Scheduler:
@@ -179,8 +249,17 @@ class Scheduler:
         """Choose the strictly-lower-priority victims whose slices, added
         to the free pool (and to slices already draining), make
         ``waiter`` placeable. Lowest priority first; newest admission
-        first within a priority. None when no such set exists."""
-        draining = sum(len(t.devices) for t in running
+        first within a priority. None when no such set exists. Held
+        devices that are revoked or quarantined will NOT return to the
+        free pool when their holder drains — counting them would make a
+        waiter look satisfiable by devices that are out of service."""
+        out_of_service = (set(self.pool.revoked_ids)
+                          | set(self.pool.quarantined_ids))
+
+        def reclaimable(t: Tenant) -> int:
+            return sum(1 for d in t.devices if d.id not in out_of_service)
+
+        draining = sum(reclaimable(t) for t in running
                        if t.state is TenantState.PREEMPTING)
         avail = self.pool.n_free + draining
         if self.resolve_slice(waiter.spec, avail) is not None:
@@ -192,7 +271,7 @@ class Scheduler:
         chosen: list[Tenant] = []
         for v in candidates:
             chosen.append(v)
-            avail += len(v.devices)
+            avail += reclaimable(v)
             if self.resolve_slice(waiter.spec, avail) is not None:
                 return chosen
         return None

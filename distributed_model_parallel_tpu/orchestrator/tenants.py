@@ -84,6 +84,16 @@ class TenantSpec:
         return int(cfg.batch_size if hasattr(cfg, "batch_size")
                    else cfg.data.batch_size)
 
+    def requested_devices(self) -> int:
+        """The slice this tenant ASKED for: the full config mesh (the
+        admission ceiling). A tenant granted less — re-admitted onto a
+        shrunken slice after a preemption or quarantine — is below
+        request, and the orchestrator's grow-back pass expands it once
+        devices free up (orchestrator.py _maybe_grow_back)."""
+        if self.workload == "pipeline":
+            return self.config.mesh.stage
+        return self.config.mesh.num_devices
+
     def min_devices(self) -> int:
         """Smallest slice this tenant can run on at all: the non-data
         mesh axes (not elastic), times two replicas when the fault plan
@@ -146,6 +156,16 @@ class Tenant:
         self.admit_seq = -1             # order of the LAST admission
         self.attempts = 0               # trainer constructions (1 + resumes)
         self.preemptions = 0
+        self.grow_backs = 0             # below-request expansions GRANTED
+        # Slice size before a pending grow-back preemption; the next
+        # admission compares its grant against it and clears it
+        # (orchestrator.py _maybe_grow_back / _admit).
+        self._grow_back_from: int | None = None
+        # Per-tenant registry counter totals (utils/telemetry.py
+        # attributes counter increments to the thread's tenant_scope), so
+        # lifecycle summaries carry THIS tenant's compiles/comm volume,
+        # not fleet totals. Captured at the end of every attempt.
+        self.counter_deltas: dict = {}
         self.preempted_at_step: int | None = None   # step when last preempted
         self.resume_exact: list[bool] = []          # per-resume step parity
         # Resumes that legitimately could NOT land at the exact step: the
@@ -275,6 +295,13 @@ class Tenant:
             faults = getattr(self.trainer, "faults", None)
             if faults is not None:
                 self.fired_faults.extend(faults.fired)
+            from distributed_model_parallel_tpu.utils.telemetry import (
+                registry,
+            )
+
+            self.counter_deltas = {
+                k: v for k, v in registry().snapshot(
+                    tenant=self.name).get("counters", {}).items() if v}
             # The thread's death IS the completion signal; make sure the
             # boundary flag can't wedge an orchestrator mid-wait.
             self._baton.at_boundary.set()

@@ -147,8 +147,13 @@ class GuardRunner:
     def __init__(self, *, check_finite_every: int = 0,
                  stall_budget_s: float | None = None, logger=None,
                  watchdog_interval_s: float | None = None,
-                 on_stall=None, injector=None):
+                 on_stall=None, injector=None,
+                 device_ids: tuple = ()):
         self.every = check_finite_every
+        # Slice attribution for the device-health sentinel feeds
+        # (utils/health.py): every watched sync's wall time is an
+        # observation for these devices.
+        self.device_ids = tuple(device_ids)
         if stall_budget_s:
             from distributed_model_parallel_tpu.train.resilience import (
                 Watchdog,
@@ -176,28 +181,47 @@ class GuardRunner:
         dead mesh is attributed to the check, not a training sync)."""
         import contextlib
 
-        if self.stall is None and self.injector is None:
+        from distributed_model_parallel_tpu.utils import health
+
+        if (self.stall is None and self.injector is None
+                and health.installed() is None):
             return contextlib.nullcontext()
         return self._watched(what)
 
     def _watched(self, what: str):
         import contextlib
+        import time
+
+        from distributed_model_parallel_tpu.utils import health
 
         @contextlib.contextmanager
         def ctx():
             wd = (self.stall.watch(what) if self.stall is not None
                   else contextlib.nullcontext())
-            with wd:
-                if self.injector is not None:
-                    # Injected stalls sleep INSIDE the watched region, so
-                    # the watchdog observes them like a real wedged sync.
-                    # Polling is keyed by ``what``: the sentinel's
-                    # "consistency-fingerprint" fetches advance their own
-                    # occurrence counter, so arming the sentinel never
-                    # shifts which training drain a planned ``stall@N``
-                    # fires at (stall specs target site "sync" only).
-                    self.injector.maybe_stall(what)
-                yield
+            t0 = time.perf_counter()
+            try:
+                with wd:
+                    if self.injector is not None:
+                        # Injected stalls sleep INSIDE the watched region,
+                        # so the watchdog observes them like a real wedged
+                        # sync. Polling is keyed by ``what``: the
+                        # sentinel's "consistency-fingerprint" fetches
+                        # advance their own occurrence counter, so arming
+                        # the sentinel never shifts which training drain a
+                        # planned ``stall@N`` fires at (stall specs target
+                        # site "sync" only).
+                        self.injector.maybe_stall(what)
+                    yield
+            finally:
+                # Every watched sync's wall time feeds the device-health
+                # sentinel (no-op unless a monitor is installed): the
+                # sentinel's labeled fetches land in the per-replica
+                # "fetch" signal, training drains in "sync".
+                dt = time.perf_counter() - t0
+                if what == "consistency-fingerprint":
+                    health.observe_fetch(self.device_ids, dt)
+                else:
+                    health.observe_sync(self.device_ids, dt)
         return ctx()
 
     def after_sync(self, host_metrics: Any, n_steps: int,

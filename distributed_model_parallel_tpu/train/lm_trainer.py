@@ -244,6 +244,10 @@ class LMTrainer:
         # sentinel" advice is useless on a dp=1 mesh.
         validate_corruption_plan(self.faults.plan, self.spec.num_data,
                                  context=f"dp={self.spec.num_data}")
+        # Slice identity for the device-health sentinel feeds
+        # (utils/health.py; no-ops outside orchestrated runs).
+        self._device_ids = tuple(sorted(
+            d.id for d in np.asarray(self.spec.mesh.devices).flat))
         self.ckpt = Checkpointer(config.checkpoint_dir,
                                  keep=config.recovery.keep_checkpoints,
                                  injector=self.faults,
@@ -252,14 +256,16 @@ class LMTrainer:
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="lm-good", injector=self.faults,
             check_finite_every=config.check_finite_every,
-            consistency_every=config.consistency_every)
+            consistency_every=config.consistency_every,
+            device_ids=self._device_ids)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
             check_finite_every=config.check_finite_every,
             stall_budget_s=config.stall_budget_s, logger=self.logger,
             watchdog_interval_s=config.recovery.watchdog_interval_s,
-            on_stall=self.resilience.on_stall, injector=self.faults)
+            on_stall=self.resilience.on_stall, injector=self.faults,
+            device_ids=self._device_ids)
         from distributed_model_parallel_tpu.train.consistency import (
             ConsistencySentinel,
         )
@@ -566,6 +572,13 @@ class LMTrainer:
             self._pos_step = step_i + 1
             self._global_step += 1
             timer.step_done()
+            # Per-step health signal (the LM loop syncs every step, so
+            # this is a true per-step time; utils/health.py — no-op
+            # outside orchestrated runs, first compile window skipped).
+            from distributed_model_parallel_tpu.utils import health
+
+            health.observe_step_warmed(self, self._device_ids,
+                                       timer.step.last, 1)
             # Per-step telemetry (the LM loop syncs every step, so
             # the per-step timing is real, not a window average).
             self.logger.telemetry.step(

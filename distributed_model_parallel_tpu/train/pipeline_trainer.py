@@ -131,19 +131,24 @@ class PipelineTrainer:
                                  keep=config.recovery.keep_checkpoints,
                                  injector=self.faults,
                                  meta_fn=self._ckpt_meta)
+        # Slice identity for the device-health sentinel feeds
+        # (utils/health.py; no-ops outside orchestrated runs).
+        self._device_ids = tuple(sorted(d.id for d in self.devices))
         self.resilience = RecoverySupervisor(
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="pipeline-good",
             injector=self.faults,
             check_finite_every=config.check_finite_every,
-            consistency_every=config.consistency_every)
+            consistency_every=config.consistency_every,
+            device_ids=self._device_ids)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
             check_finite_every=config.check_finite_every,
             stall_budget_s=config.stall_budget_s, logger=self.logger,
             watchdog_interval_s=config.recovery.watchdog_interval_s,
-            on_stall=self.resilience.on_stall, injector=self.faults)
+            on_stall=self.resilience.on_stall, injector=self.faults,
+            device_ids=self._device_ids)
         from distributed_model_parallel_tpu.train.consistency import (
             ConsistencySentinel,
         )
@@ -426,6 +431,13 @@ class PipelineTrainer:
                     run_step = max(0.0, now - win_wall - d_data) / d_steps
                     win_wall, win_data, win_steps = (now, timer.data.sum,
                                                      n_steps)
+                    # Per-window health signal (utils/health.py; no-op
+                    # outside orchestrated runs, first compile window
+                    # skipped).
+                    from distributed_model_parallel_tpu.utils import health
+
+                    health.observe_step_warmed(self, self._device_ids,
+                                               run_step, d_steps)
                     self.logger.log_step(
                         epoch, gi, loss=meters["loss"].avg,
                         acc1=meters["acc1"].avg,

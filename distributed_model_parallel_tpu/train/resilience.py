@@ -37,9 +37,10 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 from distributed_model_parallel_tpu.config import RecoveryConfig
+from distributed_model_parallel_tpu.utils import health
 from distributed_model_parallel_tpu.utils.faults import FaultInjector, FaultSpec
 
 
@@ -157,7 +158,8 @@ class RecoverySupervisor:
     def __init__(self, config: RecoveryConfig, *, logger, ckpt, preemption,
                  slot: str = "good", injector: FaultInjector | None = None,
                  check_finite_every: int | None = None,
-                 consistency_every: int | None = None):
+                 consistency_every: int | None = None,
+                 device_ids: Sequence[int] = ()):
         if config.max_retries < 0:
             raise ValueError(
                 f"recovery.max_retries must be >= 0, got {config.max_retries}")
@@ -174,6 +176,10 @@ class RecoverySupervisor:
         self.ckpt = ckpt
         self.preemption = preemption
         self.slot = slot
+        # The run's device ids, for the device-health sentinel feeds
+        # (utils/health.py): checkpoint-I/O latency and stall escalations
+        # are attributed to the slice this trainer runs on.
+        self.device_ids = tuple(device_ids)
         self.injector = (injector if injector is not None
                          else FaultInjector(config.faults))
         self.injector.on_fire = self._on_fault_fired
@@ -276,7 +282,11 @@ class RecoverySupervisor:
         if not self.enabled:
             return
         try:
+            t0 = time.perf_counter()
             self.ckpt.save(tree_fn(), self.slot, wait=True)
+            # Checkpoint-I/O latency feeds the health score: a device
+            # whose HBM reads crawl shows up here long before it NaNs.
+            health.observe_io(self.device_ids, time.perf_counter() - t0)
             return
         except Exception as e:  # noqa: BLE001 - any save failure is handled
             self._telemetry.failure("checkpoint-save-failed", stage=self.slot,
@@ -397,7 +407,10 @@ class RecoverySupervisor:
     def on_stall(self, what: str, blocked_s: float) -> None:
         """Watchdog escalation: record the stall; with ``stall_exit``,
         request a graceful checkpoint-and-exit (the preemption path then
-        saves and emits the matching ``recovery`` record)."""
+        saves and emits the matching ``recovery`` record). The stall is
+        also a hard device-health penalty for this slice
+        (utils/health.py)."""
+        health.observe_stall(self.device_ids, blocked_s)
         if self._stall_reported:
             return
         self._stall_reported = True

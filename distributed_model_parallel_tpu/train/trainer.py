@@ -44,6 +44,7 @@ from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
 from distributed_model_parallel_tpu.train.logging_util import RunLogger
 from distributed_model_parallel_tpu.train.metrics import AverageMeter, StepTimer, topk_correct
 from distributed_model_parallel_tpu.train.optim import make_optimizer
+from distributed_model_parallel_tpu.utils import health
 
 
 class TrainState(struct.PyTreeNode):
@@ -438,6 +439,12 @@ class Trainer:
             validate_corruption_plan,
         )
 
+        # Slice identity for the device-health sentinel feeds
+        # (utils/health.py; no-ops unless an orchestrator installed a
+        # monitor): step windows, guarded syncs, checkpoint I/O and stall
+        # escalations are all attributed to these devices.
+        self._device_ids = tuple(sorted(
+            d.id for d in np.asarray(self.spec.mesh.devices).flat))
         self.faults = FaultInjector(config.recovery.faults)
         if config.consistency_every and config.strategy == "fsdp":
             raise ValueError(
@@ -461,14 +468,16 @@ class Trainer:
             config.recovery, logger=self.logger, ckpt=self.ckpt,
             preemption=self.preemption, slot="good", injector=self.faults,
             check_finite_every=config.check_finite_every,
-            consistency_every=config.consistency_every)
+            consistency_every=config.consistency_every,
+            device_ids=self._device_ids)
         from distributed_model_parallel_tpu.train.guards import GuardRunner
 
         self.guards = GuardRunner(
             check_finite_every=config.check_finite_every,
             stall_budget_s=config.stall_budget_s, logger=self.logger,
             watchdog_interval_s=config.recovery.watchdog_interval_s,
-            on_stall=self.resilience.on_stall, injector=self.faults)
+            on_stall=self.resilience.on_stall, injector=self.faults,
+            device_ids=self._device_ids)
         from distributed_model_parallel_tpu.train.consistency import (
             ConsistencySentinel,
         )
@@ -873,6 +882,14 @@ class Trainer:
                         self.state.params, self.spec, spec.kind,
                         spec.param))
 
+    def _health_window(self, n_steps: int, timer: StepTimer) -> None:
+        """Report a drained step window's per-step wall time to the
+        device-health sentinel (utils/health.py; no-op unless a monitor
+        is installed — i.e. outside orchestrated runs). The first-window
+        compile skip lives in the shared helper."""
+        health.observe_step_warmed(self, self._device_ids,
+                                   timer.step.last, n_steps)
+
     def train_epoch(self, epoch: int) -> EpochResult:
         if getattr(self, "_multi_step", None) is not None:
             return self._train_epoch_device_resident(epoch)
@@ -907,6 +924,7 @@ class Trainer:
                 n = len(pending)
                 self._drain(pending, meters, sentinel=True)  # sync point
                 timer.window_done(n)
+                self._health_window(n, timer)
             if log_now:
                 # Per-WINDOW samples (meter .last, set by window_done), not
                 # the epoch running mean: the report's step-time percentiles
@@ -923,6 +941,7 @@ class Trainer:
         n = len(pending)
         self._drain(pending, meters, sentinel=True)
         timer.window_done(n)
+        self._health_window(n, timer)
         if self.sentinel.enabled:
             self._run_sentinel(0, flush=True)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,
@@ -976,6 +995,7 @@ class Trainer:
             if log_now or len(pending) >= self._max_inflight:
                 self._drain(pending, meters, sentinel=True)
                 timer.window_done(inflight)
+                self._health_window(inflight, timer)
                 inflight = 0
             if log_now:
                 # Per-window samples, same rationale as the per-batch path.
@@ -989,6 +1009,7 @@ class Trainer:
             self.emergency.after_step(chunk.shape[0], self._ckpt_tree)
         self._drain(pending, meters, sentinel=True)
         timer.window_done(inflight)
+        self._health_window(inflight, timer)
         if self.sentinel.enabled:
             self._run_sentinel(0, flush=True)
         return EpochResult(meters["loss"].avg, meters["acc1"].avg,

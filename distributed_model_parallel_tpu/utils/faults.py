@@ -38,6 +38,17 @@ kind           site    effect when fired
 ``grad_skew``  step    add ``param`` (default 1e-3) to every float leaf of
                        one replica's params — the accumulated effect of one
                        replica applying a skewed gradient
+``slow_device`` step   PERSISTENT degradation: from the firing step on,
+                       every step sleeps a linearly RAMPING delay
+                       (``param`` seconds, default 0.05, times the number
+                       of steps since firing, capped at 4x) — a device
+                       thermal-throttling its way toward death, as the
+                       health sentinel (utils/health.py) sees it
+``flaky_sync`` sync    PERSISTENT degradation: from the firing sync on,
+                       every SECOND guarded sync sleeps ``param`` seconds
+                       (default 0.05) — an intermittently flaky link whose
+                       stalls stay under the watchdog budget and are only
+                       visible as latency jitter
 =============  ======  =====================================================
 
 Sites are consulted by the trainers (``step``), ``GuardRunner.watch``
@@ -62,6 +73,7 @@ from typing import Any, Callable, Sequence
 
 __all__ = [
     "CORRUPTION_KINDS",
+    "DEGRADATION_KINDS",
     "FAULT_SITES",
     "FaultInjector",
     "FaultSpec",
@@ -87,12 +99,27 @@ FAULT_SITES = {
     "bitflip": "step",
     "desync": "step",
     "grad_skew": "step",
+    "slow_device": "step",
+    "flaky_sync": "sync",
 }
 
 # Faults that silently corrupt ONE data-parallel replica's state (served by
 # corrupt_one_replica); they need >= 2 replicas to be meaningful — and to be
 # detectable at all.
 CORRUPTION_KINDS = frozenset({"bitflip", "desync", "grad_skew"})
+
+# PERSISTENT degradations: unlike every other kind (one effect at one
+# occurrence), these register at their ``at`` occurrence and keep acting on
+# every later poll of their site — gradual decline, not an event. Served by
+# FaultInjector.poll itself (the injector owns the ramp state), detected by
+# the device-health sentinel (utils/health.py), not by the guards.
+DEGRADATION_KINDS = frozenset({"slow_device", "flaky_sync"})
+
+# slow_device ramp: delay = param * min(polls_since_firing, cap) — linear
+# decline toward a bounded worst case, so a soak stays finite.
+SLOW_DEVICE_RAMP_CAP = 4
+# flaky_sync intermittency: sleep on every PERIOD-th sync after firing.
+FLAKY_SYNC_PERIOD = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,10 +194,18 @@ class FaultInjector:
         self.on_fire = on_fire
         self.fired: list[FaultSpec] = []
         self._counts: dict[str, int] = {}
+        # Active persistent degradations (DEGRADATION_KINDS): spec ->
+        # polls of its site since it fired. The injector owns the ramp
+        # state so every trainer gets the decline for free via poll().
+        self._degradations: dict[FaultSpec, int] = {}
 
     @property
     def enabled(self) -> bool:
         return bool(self.plan)
+
+    @property
+    def active_degradations(self) -> tuple[FaultSpec, ...]:
+        return tuple(self._degradations)
 
     def poll(self, site: str) -> list[FaultSpec]:
         if not self.plan:
@@ -182,7 +217,27 @@ class FaultInjector:
             self.fired.append(s)
             if self.on_fire is not None:
                 self.on_fire(s, site, i)
+            if s.kind in DEGRADATION_KINDS:
+                self._degradations[s] = 0
+        self._serve_degradations(site)
         return out
+
+    def _serve_degradations(self, site: str) -> None:
+        """Serve the active persistent degradations scheduled on this
+        site: ``slow_device`` sleeps its linear ramp on every step,
+        ``flaky_sync`` sleeps intermittently (every FLAKY_SYNC_PERIOD-th
+        sync). The sleeps land inside the trainers' timed regions, so
+        the device-health sentinel observes them exactly like a real
+        thermal throttle or flaky link (utils/health.py)."""
+        for s, n in list(self._degradations.items()):
+            if s.site != site:
+                continue
+            self._degradations[s] = n = n + 1
+            if s.kind == "slow_device":
+                time.sleep((s.param if s.param is not None else 0.05)
+                           * min(n, SLOW_DEVICE_RAMP_CAP))
+            elif s.kind == "flaky_sync" and n % FLAKY_SYNC_PERIOD == 0:
+                time.sleep(s.param if s.param is not None else 0.05)
 
     def maybe_stall(self, site: str = "sync") -> None:
         """Poll ``site`` and serve any ``stall`` fault by sleeping — called

@@ -63,9 +63,16 @@ fault      fault (kind), site, index — one injected fault firing
            (train/resilience.py on_fire); the anchor the fleet
            report's ledger pairs detections/recoveries against
 tenant     name, event (submitted/admitted/preempt-requested/preempted/
-           completed/failed/cancelled), devices, global_step, priority
-           — one tenant lifecycle transition on the orchestrator's
-           fleet stream (orchestrator/orchestrator.py)
+           completed/failed/cancelled/grow-back), devices, global_step,
+           priority — one tenant lifecycle transition on the
+           orchestrator's fleet stream (orchestrator/orchestrator.py)
+health     event (degrading | quarantine | reinstate), devices, score,
+           signal, value, baseline, round — one device-health-sentinel
+           transition (utils/health.py) on the fleet stream; a
+           quarantine is followed by its holders' ``tenant``
+           preempt-requested records with reason=device-degraded (the
+           proactive migration), a reinstate by possible ``grow-back``
+           records
 ========== ==========================================================
 """
 
@@ -103,17 +110,27 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 class Counter:
-    """Monotonic float counter."""
+    """Monotonic float counter.
 
-    __slots__ = ("value",)
+    Increments made on a thread bound to a :func:`tenant_scope` are
+    *additionally* attributed to that tenant's bucket — the orchestrator
+    runs each tenant's trainer on its own scoped thread, so a
+    co-resident tenant's compile/comm-volume counters are separable from
+    fleet totals (``MetricsRegistry.snapshot(tenant=...)``)."""
+
+    __slots__ = ("value", "by_tenant")
 
     def __init__(self):
         self.value = 0.0
+        self.by_tenant: dict[str, float] = {}
 
     def inc(self, n: float = 1.0) -> None:
         if n < 0:
             raise ValueError(f"counter increments must be >= 0, got {n}")
         self.value += float(n)
+        tenant = current_tenant()
+        if tenant is not None:
+            self.by_tenant[tenant] = self.by_tenant.get(tenant, 0.0) + float(n)
 
 
 class Gauge:
@@ -242,16 +259,22 @@ class MetricsRegistry:
                   **tags) -> Histogram:
         return self._get(Histogram, name, tags, bounds=bounds)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, tenant: str | None = None) -> dict:
         """JSON-ready dump: {"counters": {...}, "gauges": {...},
-        "histograms": {...}} with ``name{k=v,...}`` keys."""
+        "histograms": {...}} with ``name{k=v,...}`` keys.
+
+        With ``tenant``, counters report only the increments made inside
+        that tenant's :func:`tenant_scope` (per-tenant attribution);
+        gauges and histograms have no per-tenant buckets and stay
+        process-global."""
         out = {"counters": {}, "gauges": {}, "histograms": {}}
         with self._lock:
             items = list(self._metrics.items())
         for (name, tags), m in sorted(items, key=lambda kv: kv[0]):
             key = _fmt_key(name, tags)
             if isinstance(m, Counter):
-                out["counters"][key] = m.value
+                out["counters"][key] = (m.value if tenant is None
+                                        else m.by_tenant.get(tenant, 0.0))
             elif isinstance(m, Gauge):
                 out["gauges"][key] = m.value
             else:
@@ -522,8 +545,12 @@ class TelemetryRun:
         # Counter baseline at stream open: the registry is process-global,
         # so a second run in the same process must not inherit the first
         # run's collective-volume / compile counts in its metrics record.
+        # Tenant-tagged streams baseline (and later report) the TENANT's
+        # own counter bucket, so a co-resident tenant's metrics record
+        # carries per-tenant deltas, not fleet totals.
         self._counter_baseline = dict(
-            self.registry.snapshot().get("counters", {}))
+            self.registry.snapshot(tenant=self.tenant)
+            .get("counters", {}))
         # Step-time histogram is RUN-LOCAL (histograms have no delta
         # semantics, so sharing the global registry would merge runs).
         self._step_hist = Histogram()
@@ -612,10 +639,13 @@ class TelemetryRun:
         Counters are reported as DELTAS since this stream opened (the
         registry is process-global; without the baseline a second run in
         the same process would re-report the first run's comm volume and
-        compile counts). The ``step_time_s`` histogram is run-local, so
-        its quantiles describe only this run; gauges and any caller-made
-        registry histograms are absolute."""
-        snap = self.registry.snapshot()
+        compile counts). A tenant-tagged stream reports the tenant's own
+        counter bucket — increments made inside its ``tenant_scope`` —
+        so co-resident tenants' deltas are per-tenant, not fleet totals.
+        The ``step_time_s`` histogram is run-local, so its quantiles
+        describe only this run; gauges and any caller-made registry
+        histograms are absolute."""
+        snap = self.registry.snapshot(tenant=self.tenant)
         base = self._counter_baseline
         snap["counters"] = {k: v - base.get(k, 0)
                             for k, v in snap.get("counters", {}).items()}

@@ -16,6 +16,8 @@ directory (utils/xplane op breakdown) and prints:
   restores, stall escalations, torn-checkpoint fallbacks, cross-replica
   divergence detections + repairs — train/resilience.py,
   train/consistency.py);
+* on fleet reports: the device-health timeline (score transitions,
+  quarantines, proactive migrations, grow-backs — utils/health.py);
 * top-N device ops + per-category device time from the xplane trace
   (``--trace``), degrading to an actionable one-liner when the tensorflow
   proto bindings are absent.
@@ -400,6 +402,10 @@ def pair_faults(records: list[dict]) -> list[dict]:
     ``{tenant, fault, site, detected, action, paired}``. Detections and
     actions are consumed in order, so two faults cannot claim the same
     recovery."""
+    from distributed_model_parallel_tpu.utils.faults import (
+        DEGRADATION_KINDS,
+    )
+
     by_tenant: dict[str, list[dict]] = {}
     for r in records:
         by_tenant.setdefault(r.get("tenant") or "", []).append(r)
@@ -421,6 +427,13 @@ def pair_faults(records: list[dict]) -> list[dict]:
             if r.get("kind") != "fault":
                 continue
             kind = r.get("fault")
+            if kind in DEGRADATION_KINDS:
+                # Persistent degradations (slow_device/flaky_sync) are
+                # not event faults with a detection/recovery pair — their
+                # audit trail is the device-health timeline (quarantine,
+                # migration, grow-back records), gated by the
+                # degradation soak, not by this ledger.
+                continue
             det_set, act_set = FAULT_PAIRING.get(
                 kind, (frozenset(), frozenset()))
             dj, detected = _claim(i + 1, _detection_key, det_set)
@@ -434,9 +447,51 @@ def pair_faults(records: list[dict]) -> list[dict]:
     return ledger
 
 
+def _health_section(lines: list[str], records: list[dict],
+                    t0: float) -> None:
+    """Device-health timeline (utils/health.py): score transitions,
+    quarantines and probation reinstates from the typed ``health``
+    records, interleaved with the proactive migrations (tenant
+    preemptions with reason ``device-degraded``) and grow-backs they
+    caused — the self-healing story as one sequence."""
+    health = [r for r in records if r.get("kind") == "health"]
+    moves = [r for r in records if r.get("kind") == "tenant"
+             and (str(r.get("reason", "")).startswith("device-degraded")
+                  or str(r.get("reason", "")) == "grow-back"
+                  or r.get("event") == "grow-back")]
+    if not health and not moves:
+        return
+    n_q = sum(1 for r in health if r.get("event") == "quarantine")
+    n_r = sum(1 for r in health if r.get("event") == "reinstate")
+    lines.append(f"== device health ({len(health)} events, "
+                 f"{n_q} quarantines, {n_r} reinstates) ==")
+    for r in sorted(health + moves, key=lambda r: r.get("ts") or 0.0):
+        dt = (r["ts"] - t0) if isinstance(r.get("ts"), (int, float)) else 0.0
+        if r.get("kind") == "health":
+            extra = " ".join(
+                f"{k}={r[k]}" for k in ("signal", "score", "value",
+                                        "baseline", "probation_ticks")
+                if r.get(k) is not None)
+            lines.append(f"  [+{dt:7.1f}s] {str(r.get('event')):<12} "
+                         f"devices={r.get('devices')}"
+                         + (f" {extra}" if extra else ""))
+        elif r.get("event") == "grow-back":
+            lines.append(f"  [+{dt:7.1f}s] grow-back    "
+                         f"{r.get('name')}: {len(r.get('devices') or [])} "
+                         f"-> {r.get('target_devices')} devices at step "
+                         f"{r.get('global_step')}")
+        else:
+            lines.append(f"  [+{dt:7.1f}s] migration    "
+                         f"{r.get('name')}: preempted off "
+                         f"{r.get('devices') if r.get('devices') is not None else 'its slice'}"
+                         f" ({r.get('reason')}) at step "
+                         f"{r.get('global_step')}")
+
+
 def build_fleet_report(records: list[dict]) -> str:
     """Render the fleet-level report for a merged multi-tenant record
     stream (utils/telemetry.merge_streams): the orchestration timeline,
+    the device-health timeline (quarantines, migrations, grow-backs),
     one resilience timeline per tenant, per-tenant recovery/repair/resume
     counts, the injected-fault ledger, and the unrecovered-failure
     ledger."""
@@ -460,6 +515,8 @@ def build_fleet_report(records: list[dict]) -> str:
             lines.append(f"  [+{dt:7.1f}s] {str(r.get('name')):<12} "
                          f"{str(r.get('event')):<20}"
                          + (f" {extra}" if extra else ""))
+
+    _health_section(lines, records, t0)
 
     for tenant in tenants:
         recs = [r for r in records if r.get("tenant") == tenant]

@@ -20,9 +20,18 @@ Modes:
   models, CPU-friendly, seconds-to-a-minute; the ``chaos`` pytest tier
   runs it on every CI pass (tests/test_soak.py).
 * ``long`` — repeated campaigns with derived seeds until
-  ``--duration-s`` wall clock is spent (hours for a real soak); each
+  ``--duration-s`` wall clock is spent (hours for a real soak; a tiny
+  budget still runs one full campaign — the CI-bounded smoke); each
   campaign is the fast campaign's shape scaled by ``--tenants`` /
   ``--epochs``.
+
+Scenarios (``--scenario``): ``chaos`` (the campaign above) or
+``degradation`` — the device-health drill (utils/health.py): an
+injected ``slow_device`` ramp must get its slice quarantined, its
+tenant proactively migrated through the preempt-checkpoint path
+(dp4 -> dp2), and grown back to the requested dp at the exact global
+step after probation, with a sub-threshold ``flaky_sync`` bystander as
+the false-positive control (see ``run_degradation_campaign``).
 
 Every campaign gates on the same four invariants and exits non-zero when
 any fails:
@@ -76,6 +85,14 @@ if (os.environ.get("JAX_PLATFORMS") == "cpu"
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mode", default="fast", choices=["fast", "long"])
+    p.add_argument("--scenario", default="chaos",
+                   choices=["chaos", "degradation"],
+                   help="chaos: the heterogeneous fault campaign; "
+                        "degradation: the device-health drill — an "
+                        "injected slow_device straggler must be "
+                        "quarantined and its tenant migrated (shrunk "
+                        "dp4->dp2) and grown back to its requested dp at "
+                        "the exact global step (utils/health.py)")
     p.add_argument("--seed", default=0, type=int,
                    help="campaign seed: fault kinds/sites, priorities and "
                         "event rounds all derive from it — same seed, "
@@ -372,33 +389,192 @@ def run_campaign(args, workdir: str, seed: int) -> tuple[dict, bool]:
     return out, ok
 
 
+# ---------------------------------------------------------------------------
+# the degradation scenario: straggler quarantine -> migration -> grow-back
+# ---------------------------------------------------------------------------
+
+def run_degradation_campaign(args, workdir: str, seed: int
+                             ) -> tuple[dict, bool]:
+    """The device-health drill (utils/health.py), end to end on the real
+    stack: a ``slow_device`` degradation ramps up on the victim tenant's
+    dp=4 slice until the health sentinel quarantines it; the orchestrator
+    proactively migrates the victim through the ordinary
+    preempt-checkpoint path onto the only free devices (dp=2 — migrated
+    AND shrunk below its requested dp); after probation the quarantined
+    devices are reinstated and the grow-back pass expands the victim
+    back to dp=4 at the exact global step. A ``flaky_sync`` degradation
+    rides on the bystander tenant with a sub-threshold magnitude — the
+    negative control: intermittent jitter must NOT cost it its slice.
+
+    Gates (non-zero exit when any fails):
+
+    1. the victim's whole degraded slice is quarantined within 8 steps
+       of the slow_device injection firing;
+    2. the victim is migrated onto disjoint devices at dp=2 (shrunk);
+    3. it is back at its requested dp=4 by campaign end (>= 1 grow-back)
+       and EVERY resume landed at the exact global step (the bitwise
+       resume accounting the orchestrator keeps);
+    4. zero unrecovered tenants, everyone completes;
+    5. the bystander's devices are never quarantined and it is never
+       preempted (no false-positive quarantine from sub-threshold
+       jitter).
+    """
+    from distributed_model_parallel_tpu.config import RecoveryConfig
+    from distributed_model_parallel_tpu.orchestrator import (
+        Orchestrator,
+        TenantSpec,
+    )
+    from distributed_model_parallel_tpu.utils.health import (
+        DeviceHealthMonitor,
+        HealthPolicy,
+    )
+    from distributed_model_parallel_tpu.utils.telemetry import (
+        merge_streams,
+        read_records,
+    )
+    from scripts.dmp_report import build_fleet_report
+
+    # Sized for the fast tier: ~3 outlier steps to quarantine, 3 quiet
+    # ticks to reinstate; the absolute outlier floor (0.25s) keeps CI
+    # host jitter from tripping the drill while the 0.4s-ramp injection
+    # clears it on its first degraded step.
+    monitor = DeviceHealthMonitor(HealthPolicy(
+        warmup=3, outlier_factor=3.0, min_outlier_s=0.25,
+        outlier_penalty=0.25, quarantine_below=0.35,
+        reinstate_above=0.8, min_probation_ticks=3, idle_credit=0.25))
+    orch = Orchestrator(workdir=os.path.join(workdir, "fleet"),
+                        quantum=args.quantum, health=monitor)
+    # The victim: requested dp=4, a slow_device ramp firing at step 6
+    # (after the health baseline warms up), per-step drains so every
+    # degraded step is an observation.
+    victim_cfg = _cnn_config(
+        workdir, "victim", 4, 6,
+        recovery=RecoveryConfig(max_retries=1,
+                                faults=("slow_device@6:0.4",)),
+        max_inflight_steps=1)
+    # The bystander: dp=2, long enough to hold its slice through the
+    # victim's whole journey, with sub-threshold intermittent sync
+    # stalls (0.03s << the 0.25s outlier floor).
+    steady_cfg = _cnn_config(
+        workdir, "steady", 2, 10,
+        recovery=RecoveryConfig(max_retries=1,
+                                faults=("flaky_sync@1:0.03",)),
+        max_inflight_steps=1)
+    victim = orch.submit(TenantSpec(name="victim", workload="cnn",
+                                    config=victim_cfg))
+    orch.submit(TenantSpec(name="steady", workload="cnn",
+                           config=steady_cfg))
+
+    t0 = time.time()
+    summary = orch.run(max_rounds=2000)
+    orch.close(rounds=summary["rounds"])
+
+    merged = merge_streams(orch.telemetry_paths())
+    print(build_fleet_report(merged))
+
+    fleet = read_records(os.path.join(workdir, "fleet", "fleet.jsonl"))
+    quarantined = sorted({d for r in fleet if r.get("kind") == "health"
+                          and r.get("event") == "quarantine"
+                          for d in r.get("devices", [])})
+    reinstated = sorted({d for r in fleet if r.get("kind") == "health"
+                         and r.get("event") == "reinstate"
+                         for d in r.get("devices", [])})
+    vt = summary["tenants"]["victim"]
+    st = summary["tenants"]["steady"]
+    grants = {t: [a["devices"] for a in summary["assignments"]
+                  if a["tenant"] == t] for t in ("victim", "steady")}
+    fire_step = next((r.get("index") for r in merged
+                      if r.get("kind") == "fault"
+                      and r.get("fault") == "slow_device"), None)
+    migrate_step = next((r.get("global_step") for r in fleet
+                         if r.get("kind") == "tenant"
+                         and r.get("event") == "preempt-requested"
+                         and str(r.get("reason", ""))
+                         .startswith("device-degraded")), None)
+    incomplete = [n for n, t in summary["tenants"].items()
+                  if t["state"] != "completed"]
+    first_slice = set(grants["victim"][0]) if grants["victim"] else set()
+    migrated = [g for g in grants["victim"][1:] if not set(g) & first_slice]
+    out = {
+        "soak": "degradation-campaign",
+        "scenario": "degradation",
+        "seed": seed,
+        "rounds": summary["rounds"],
+        "wall_s": round(time.time() - t0, 1),
+        "tenants": {n: t["state"] for n, t in summary["tenants"].items()},
+        "quarantined_devices": quarantined,
+        "reinstated_devices": reinstated,
+        "slow_device_fired_at_step": fire_step,
+        "migrated_at_step": migrate_step,
+        "victim_grants": grants["victim"],
+        "victim_grow_backs": vt["grow_backs"],
+        "victim_requested": vt["requested_devices"],
+        "victim_granted_sizes": vt["granted_sizes"],
+        "steady_preemptions": st["preemptions"],
+        "resumes_exact": summary["all_resumes_exact"],
+        "unrecovered": summary["unrecovered"],
+        "telemetry": orch.telemetry_paths(),
+    }
+    steady_slice = set(grants["steady"][0]) if grants["steady"] else set()
+    ok = (not summary["unrecovered"]
+          and not incomplete
+          # gate 1: the degraded slice quarantined, promptly
+          and set(quarantined) == first_slice and bool(first_slice)
+          and fire_step is not None and migrate_step is not None
+          and 0 <= migrate_step - fire_step <= 8
+          # gate 2: migrated onto disjoint devices, shrunk below request
+          and bool(migrated) and len(migrated[0]) == 2
+          # gate 3: grown back to the requested dp at the exact step
+          and vt["grow_backs"] >= 1
+          and vt["granted_sizes"][-1] == vt["requested_devices"] == 4
+          and summary["all_resumes_exact"]
+          # gate 4: probation healed the quarantined devices
+          and set(reinstated) == set(quarantined)
+          # gate 5: the flaky-but-healthy bystander kept its slice
+          and not (set(quarantined) & steady_slice)
+          and st["preemptions"] == 0)
+    _ = victim
+    return out, ok
+
+
+def run_long(args, workdir: str) -> tuple[dict, bool]:
+    """Long mode: campaign after campaign with derived seeds until the
+    wall-clock budget is spent; one failure fails the soak. At least one
+    campaign always runs (a small ``--duration-s`` is the CI-bounded
+    smoke of this very loop)."""
+    campaign = (run_degradation_campaign if args.scenario == "degradation"
+                else run_campaign)
+    t0 = time.time()
+    campaigns, all_ok = [], True
+    i = 0
+    while i == 0 or time.time() - t0 < args.duration_s:
+        sub = os.path.join(workdir, f"campaign_{i}")
+        os.makedirs(sub, exist_ok=True)
+        summary, ok = campaign(args, sub, args.seed + i)
+        campaigns.append({"seed": summary["seed"], "ok": ok,
+                          "wall_s": summary["wall_s"],
+                          "faults": summary.get("faults_injected", []),
+                          "unrecovered": summary["unrecovered"],
+                          "unpaired": summary.get("faults_unpaired", [])})
+        all_ok = all_ok and ok
+        i += 1
+    return ({"soak": "long", "scenario": args.scenario,
+             "campaigns": campaigns, "n_campaigns": i,
+             "wall_s": round(time.time() - t0, 1),
+             "all_ok": all_ok}, all_ok)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     workdir = args.workdir or tempfile.mkdtemp(prefix="dmp_soak_")
     if args.mode == "fast":
-        summary, ok = run_campaign(args, workdir, args.seed)
+        campaign = (run_degradation_campaign
+                    if args.scenario == "degradation" else run_campaign)
+        summary, ok = campaign(args, workdir, args.seed)
         print(json.dumps(summary), flush=True)
         return 0 if ok else 1
-    # long mode: campaign after campaign with derived seeds until the
-    # wall-clock budget is spent; one failure fails the soak.
-    t0 = time.time()
-    campaigns, all_ok = [], True
-    i = 0
-    while time.time() - t0 < args.duration_s:
-        sub = os.path.join(workdir, f"campaign_{i}")
-        os.makedirs(sub, exist_ok=True)
-        summary, ok = run_campaign(args, sub, args.seed + i)
-        campaigns.append({"seed": summary["seed"], "ok": ok,
-                          "wall_s": summary["wall_s"],
-                          "faults": summary["faults_injected"],
-                          "unrecovered": summary["unrecovered"],
-                          "unpaired": summary["faults_unpaired"]})
-        all_ok = all_ok and ok
-        i += 1
-    print(json.dumps({"soak": "long", "campaigns": campaigns,
-                      "n_campaigns": i,
-                      "wall_s": round(time.time() - t0, 1),
-                      "all_ok": all_ok}), flush=True)
+    summary, all_ok = run_long(args, workdir)
+    print(json.dumps(summary), flush=True)
     return 0 if all_ok else 1
 
 

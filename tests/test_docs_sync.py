@@ -1,0 +1,60 @@
+"""Doc-sync lint: every typed telemetry record kind the code can emit
+must have a schema row in docs/OBSERVABILITY.md.
+
+The record table is the contract consumers (dmp_report.py, the soak
+gates, external ingestion) build against; a new `.record("kind", ...)`
+call shipped without a row is an undocumented wire format. This test
+greps the emitting code for literal record kinds and fails naming the
+missing ones — so the fix is always "add the row", never archaeology."""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Everywhere TelemetryRun records are emitted from: the package itself,
+# the bench/report/soak drivers, and the benchmark harnesses.
+EMITTING_ROOTS = (
+    REPO / "distributed_model_parallel_tpu",
+    REPO / "scripts",
+    REPO / "benchmarks",
+)
+EMITTING_FILES = (REPO / "bench.py",)
+
+RECORD_RE = re.compile(r'\.record\(\s*"([a-z_]+)"')
+
+
+def _emitted_kinds() -> set[str]:
+    kinds: set[str] = set()
+    files = [p for root in EMITTING_ROOTS for p in root.rglob("*.py")]
+    files += list(EMITTING_FILES)
+    for path in files:
+        kinds |= set(RECORD_RE.findall(path.read_text()))
+    return kinds
+
+
+def _documented_kinds() -> set[str]:
+    """Kind names from the first column of the record-schema table in
+    docs/OBSERVABILITY.md (rows like ``| `step` | ... |``; combined rows
+    like ``| `bench` / `cost_analysis` / `profile` | ... |`` list several
+    kinds in one cell)."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    kinds: set[str] = set()
+    for line in doc.splitlines():
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        kinds |= set(re.findall(r"`([a-z_]+)`", first_cell))
+    return kinds
+
+
+def test_every_emitted_record_kind_is_documented():
+    emitted = _emitted_kinds()
+    # Sanity: the grep actually found the core kinds — an empty emitted
+    # set would make this lint vacuously green.
+    assert {"run_start", "step", "failure", "recovery", "tenant"} <= emitted
+    missing = sorted(emitted - _documented_kinds())
+    assert not missing, (
+        f"telemetry record kinds emitted but missing from the "
+        f"docs/OBSERVABILITY.md record table: {missing} — add a schema "
+        f"row for each (kind, payload keys, writer)")

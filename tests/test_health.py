@@ -1,0 +1,430 @@
+"""Device-health sentinel (utils/health.py) + its orchestration wiring:
+scoring/hysteresis units, DevicePool quarantine/reinstate (and the
+previously-untested revoke/restore edges), the persistent degradation
+fault kinds, and end-to-end straggler quarantine -> proactive migration
+-> grow-back through the real orchestrator."""
+
+import pytest
+
+from distributed_model_parallel_tpu.config import MeshConfig
+from distributed_model_parallel_tpu.orchestrator import (
+    DevicePool,
+    Orchestrator,
+    TenantSpec,
+    TenantState,
+)
+from distributed_model_parallel_tpu.utils.health import (
+    DeviceDegradedError,
+    DeviceHealthMonitor,
+    HealthPolicy,
+)
+
+from tests.conftest import tiny_train_config
+
+
+def _policy(**kw):
+    base = dict(warmup=2, outlier_factor=3.0, min_outlier_s=0.1,
+                outlier_penalty=0.25, stall_penalty=0.5,
+                recovery_credit=0.05, idle_credit=0.5,
+                quarantine_below=0.35, reinstate_above=0.8,
+                min_probation_ticks=2)
+    base.update(kw)
+    return HealthPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# scoring units (pure bookkeeping, no jax)
+# ---------------------------------------------------------------------------
+
+def test_policy_rejects_inverted_hysteresis():
+    with pytest.raises(ValueError, match="hysteresis"):
+        HealthPolicy(quarantine_below=0.9, reinstate_above=0.5)
+
+
+def test_outliers_penalize_and_quarantine_with_hysteresis():
+    m = DeviceHealthMonitor(_policy())
+    ids = (0, 1)
+    for _ in range(3):
+        m.observe("step", ids, 0.01)        # warmup + 1 healthy
+    assert m.score(0) == 1.0
+    for i in range(3):                       # 3 outliers -> 0.25 <= 0.35
+        m.observe("step", ids, 5.0)
+    assert m.state(0) == "quarantined" and m.state(1) == "quarantined"
+    events = m.tick()                        # delivery tick: no probation
+    kinds = [e["event"] for e in events]
+    assert kinds.count("quarantine") == 2
+    assert "degrading" in kinds
+    # hysteresis: one probation tick is not enough (min_probation_ticks=2;
+    # 0.25 + 0.5 idle credit = 0.75 < reinstate_above 0.8 either way)
+    m.tick()
+    assert m.state(0) == "quarantined"
+    m.tick()
+    # 2 probation ticks, score healed past 0.8: reinstated
+    assert m.state(0) == "healthy"
+    ev = m.tick()
+    assert not ev                            # reinstate drained last tick
+
+
+def test_reinstate_events_carry_probation():
+    m = DeviceHealthMonitor(_policy())
+    for _ in range(3):
+        m.observe("step", (7,), 0.01)
+    for _ in range(3):
+        m.observe("step", (7,), 9.0)
+    m.tick()                                 # delivery
+    m.tick()                                 # probation 1
+    events = m.tick()                        # probation 2 -> reinstate
+    re = [e for e in events if e["event"] == "reinstate"]
+    assert re and re[0]["devices"] == [7]
+    assert re[0]["probation_ticks"] == 2
+
+
+def test_first_window_compile_spike_does_not_poison_baseline():
+    """The warmup baseline is the MINIMUM of warmup observations: a
+    first-window jit compile (seconds) must not blind the outlier test
+    to later real degradations (the exact failure mode the degradation
+    soak first hit)."""
+    m = DeviceHealthMonitor(_policy())
+    m.observe("step", (0,), 2.0)             # compile window
+    m.observe("step", (0,), 0.02)
+    m.observe("step", (0,), 0.02)            # warmup done, baseline 0.02
+    m.observe("step", (0,), 1.0)             # real degradation
+    assert m.score(0) == 0.75
+
+
+def test_healthy_observations_credit_back():
+    m = DeviceHealthMonitor(_policy())
+    for _ in range(3):
+        m.observe("step", (0,), 0.01)
+    m.observe("step", (0,), 5.0)
+    assert m.score(0) == 0.75
+    for _ in range(3):
+        m.observe("step", (0,), 0.011)
+    assert m.score(0) == pytest.approx(0.9)
+
+
+def test_outliers_do_not_teach_the_baseline():
+    m = DeviceHealthMonitor(_policy())
+    for _ in range(3):
+        m.observe("step", (0,), 0.01)
+    for _ in range(20):
+        m.observe("step", (0,), 5.0)
+    # baseline still ~0.01: a persistent straggler never becomes "normal"
+    assert m._baseline[("step", (0,))][0] < 0.02
+
+
+def test_per_slice_and_per_signal_baselines_are_independent():
+    m = DeviceHealthMonitor(_policy())
+    for _ in range(3):
+        m.observe("step", (0, 1), 0.01)      # fast CNN slice
+        m.observe("step", (2, 3), 2.0)       # slow LM slice
+        m.observe("io", (0, 1), 1.0)         # slow I/O, same devices
+    m.observe("step", (2, 3), 2.1)           # normal for ITS baseline
+    m.observe("io", (0, 1), 1.1)
+    assert m.score(2) >= 1.0 - 1e-9
+    assert m.score(0) >= 1.0 - 1e-9
+    m.observe("step", (0, 1), 2.0)           # outlier for the fast slice
+    assert m.score(0) == 0.75
+
+
+def test_stall_is_a_hard_penalty():
+    m = DeviceHealthMonitor(_policy())
+    m.observe_stall((0, 1, 2, 3), 12.0)
+    assert m.score(0) == 0.5
+    m.observe_stall((0,), 12.0)
+    assert m.state(0) == "quarantined"       # 0.0 <= quarantine_below
+
+
+def test_assert_usable_raises_typed_error():
+    m = DeviceHealthMonitor(_policy())
+    for _ in range(3):
+        m.observe("step", (4,), 0.01)
+    for _ in range(3):
+        m.observe("step", (4,), 9.0)
+    m.assert_usable([1, 2, 3])
+    with pytest.raises(DeviceDegradedError, match=r"\[4\]"):
+        m.assert_usable([3, 4])
+
+
+def test_module_observe_functions_noop_without_monitor():
+    from distributed_model_parallel_tpu.utils import health
+
+    assert health.installed() is None
+    health.observe_step((0,), 1.0)           # must not raise
+    health.observe_stall((0,), 1.0)
+    m = health.install(DeviceHealthMonitor(_policy(warmup=1)))
+    try:
+        health.observe_step((0,), 0.01)
+        health.observe_step((0,), 0.01)
+        health.observe_step((0,), 9.0)
+        assert m.score(0) == 0.75
+    finally:
+        health.uninstall()
+    assert health.installed() is None
+
+
+# ---------------------------------------------------------------------------
+# DevicePool: quarantine/reinstate + the revoke/restore edge branches
+# ---------------------------------------------------------------------------
+
+def test_pool_quarantine_free_and_held(devices):
+    pool = DevicePool(devices)
+    pool.assign("a", 4)                      # 0..3; free 4..7
+    out = pool.quarantine([2, 5])
+    assert out == (2, 5)
+    assert pool.quarantined_ids == (2, 5)
+    assert 5 not in pool.free_ids
+    assert pool.holders_of_quarantined() == ["a"]
+    # idempotent re-quarantine
+    assert pool.quarantine([2]) == ()
+    # release of a held quarantined id must NOT re-free it
+    pool.release("a")
+    assert set(pool.free_ids) == {0, 1, 3, 4, 6, 7}
+    # reinstate returns everything to service
+    assert pool.reinstate() == (2, 5)
+    assert set(pool.free_ids) == {0, 1, 2, 3, 4, 5, 6, 7}
+
+
+def test_pool_reinstate_held_id_in_place(devices):
+    pool = DevicePool(devices)
+    pool.assign("a", 2)
+    pool.quarantine([0])
+    assert pool.reinstate([0]) == (0,)
+    assert 0 not in pool.free_ids            # still held by a
+    pool.release("a")
+    assert 0 in pool.free_ids                # back to free on release
+
+
+def test_pool_quarantine_conflicts_and_unknown_ids(devices):
+    pool = DevicePool(devices)
+    pool.revoke(1)                           # takes id 7 (highest free)
+    with pytest.raises(ValueError, match="revoked"):
+        pool.quarantine([7])
+    with pytest.raises(KeyError):
+        pool.quarantine([99])
+
+
+def test_pool_revoke_skips_quarantined_held(devices):
+    pool = DevicePool(devices)
+    pool.assign("a", 8)                      # whole pool held
+    pool.quarantine([6, 7])
+    revoked = pool.revoke(2)                 # must take 4, 5 — not 6, 7
+    assert revoked == (4, 5)
+    with pytest.raises(ValueError, match="in service"):
+        pool.revoke(7)
+
+
+def test_pool_assign_never_grants_quarantined(devices):
+    pool = DevicePool(devices)
+    pool.quarantine([0, 1, 2, 3, 4, 5])
+    with pytest.raises(RuntimeError, match="only"):
+        pool.assign("a", 3)
+    got = pool.assign("b", 2)
+    assert {d.id for d in got} == {6, 7}
+
+
+# -- satellite: the previously-untested restore branches (scheduler.py) -----
+
+def test_pool_restore_unrevokes_held_ids_in_place(devices):
+    pool = DevicePool(devices)
+    pool.assign("a", 6)                      # 0..5; free 6, 7
+    pool.revoke(3)                           # 7, 6 free + 5 held in place
+    assert pool.holders_of_revoked() == ["a"]
+    back = pool.restore()
+    assert back == (5, 6, 7)
+    # 5 is still HELD by a: un-revoked in place, not freed
+    assert set(pool.free_ids) == {6, 7}
+    assert pool.holders_of_revoked() == []
+    pool.release("a")
+    assert pool.n_free == len(devices)
+
+
+def test_pool_partial_restore_and_holders_of_revoked(devices):
+    pool = DevicePool(devices)
+    pool.assign("a", 7)                      # 0..6; free: 7
+    revoked = pool.revoke(3)                 # 7 free + 6, 5 held
+    assert revoked == (5, 6, 7)
+    assert pool.holders_of_revoked() == ["a"]
+    # partial restore returns the LOWEST revoked ids first: 5, 6 (held ->
+    # un-revoked in place), leaving 7 revoked
+    back = pool.restore(2)
+    assert back == (5, 6)
+    assert pool.revoked_ids == (7,)
+    # every still-revoked id is free-pool-side now: no holder to preempt
+    assert pool.holders_of_revoked() == []
+    assert pool.free_ids == ()
+    pool.restore()
+    assert pool.free_ids == (7,)
+
+
+# ---------------------------------------------------------------------------
+# degradation fault kinds (utils/faults.py)
+# ---------------------------------------------------------------------------
+
+def test_degradation_kinds_parse_and_sites():
+    from distributed_model_parallel_tpu.utils.faults import (
+        DEGRADATION_KINDS,
+        FAULT_SITES,
+        parse_faults,
+    )
+
+    specs = parse_faults("slow_device@3:0.5,flaky_sync@1:0.2")
+    assert [s.kind for s in specs] == ["slow_device", "flaky_sync"]
+    assert FAULT_SITES["slow_device"] == "step"
+    assert FAULT_SITES["flaky_sync"] == "sync"
+    assert DEGRADATION_KINDS == {"slow_device", "flaky_sync"}
+
+
+def test_slow_device_ramps_and_flaky_sync_is_intermittent(monkeypatch):
+    from distributed_model_parallel_tpu.utils import faults
+
+    sleeps: list[float] = []
+    monkeypatch.setattr(faults.time, "sleep", sleeps.append)
+    inj = faults.FaultInjector(("slow_device@1:0.1", "flaky_sync@0:0.2"))
+    for _ in range(6):
+        inj.poll("step")
+    # fired at occurrence 1; ramp 0.1 * min(n, 4): 0.1 .. 0.4, capped
+    assert sleeps == pytest.approx([0.1, 0.2, 0.3, 0.4, 0.4])
+    assert [s.kind for s in inj.active_degradations] == ["slow_device"]
+    sleeps.clear()
+    for _ in range(5):
+        inj.poll("sync")
+    # fired at occurrence 0; sleeps every 2nd sync after firing
+    assert sleeps == pytest.approx([0.2, 0.2])
+    assert [s.kind for s in inj.active_degradations] == \
+        ["slow_device", "flaky_sync"]
+    # degradations fire once on the ledger (persistent effect, one record)
+    assert [s.kind for s in inj.fired] == ["slow_device", "flaky_sync"]
+
+
+# ---------------------------------------------------------------------------
+# orchestrated end to end: quarantine -> migration -> grow-back
+# ---------------------------------------------------------------------------
+
+def _tenant_cfg(tmp_path, name, dp, epochs, **kw):
+    base = dict(
+        mesh=MeshConfig(data=dp),
+        epochs=epochs,
+        log_dir=str(tmp_path / name / "log"),
+        checkpoint_dir=str(tmp_path / name / "ckpt"),
+        log_name=name, eval_every=100,
+    )
+    base.update(kw)
+    return tiny_train_config(tmp_path, **base)
+
+
+def test_quarantine_migrates_tenant_then_grows_back(tmp_path):
+    """Scripted health observations drive the full self-healing loop on
+    the real orchestrator: the victim's slice is quarantined, the victim
+    is preempt-checkpointed and re-admitted shrunk (dp4 -> dp2) on the
+    only healthy devices, and after probation the reinstated devices
+    trigger a grow-back to the requested dp=4 — every resume at the
+    exact global step. Observations are injected (not slept), so the
+    test is timing-independent."""
+    # min_outlier_s=5.0 shields the drill from the trainers' own (real,
+    # jittery) timing feeds: only the scripted 10.0s observations can be
+    # outliers, so the test is deterministic on any host.
+    monitor = DeviceHealthMonitor(_policy(warmup=1, outlier_penalty=0.5,
+                                          min_outlier_s=5.0,
+                                          idle_credit=0.5,
+                                          min_probation_ticks=2))
+    orch = Orchestrator(workdir=str(tmp_path / "fleet"), quantum=1,
+                        health=monitor)
+    victim = orch.submit(TenantSpec(
+        name="victim", workload="cnn",
+        config=_tenant_cfg(tmp_path, "victim", 4, 4)))
+    orch.submit(TenantSpec(
+        name="steady", workload="cnn",
+        config=_tenant_cfg(tmp_path, "steady", 2, 4)))
+
+    first_slice = {0, 1, 2, 3}
+    probes = {"n": 0, "stop": False}
+
+    def on_round(o, r):
+        # The degradation ends once the slice is quarantined (the device
+        # "cools down" off-duty — same story as the soak's injected
+        # slow_device, which is stripped on re-admission): probing must
+        # not re-degrade the reinstated devices after the grow-back.
+        if probes["stop"] or monitor.quarantined_ids:
+            probes["stop"] = True
+            return
+        v = o.tenants["victim"]
+        if (v.state is TenantState.RUNNING
+                and {d.id for d in v.devices} == first_slice):
+            ids = sorted(d.id for d in v.devices)
+            # one warmup seed, then outliers until quarantine
+            probes["n"] += 1
+            monitor.observe("probe", ids,
+                            0.01 if probes["n"] == 1 else 10.0)
+
+    summary = orch.run(on_round=on_round, max_rounds=300)
+    orch.close()
+    assert summary["unrecovered"] == {}
+    assert all(t["state"] == "completed"
+               for t in summary["tenants"].values()), summary
+    vt = summary["tenants"]["victim"]
+    grants = [a["devices"] for a in summary["assignments"]
+              if a["tenant"] == "victim"]
+    # migrated off the quarantined slice, shrunk below request
+    assert len(grants) >= 3
+    assert set(grants[1]).isdisjoint(first_slice)
+    assert len(grants[1]) == 2
+    # grown back to the requested dp on the reinstated devices
+    assert vt["grow_backs"] == 1
+    assert len(grants[-1]) == vt["requested_devices"] == 4
+    assert vt["resumed_exact_step"] == [True] * len(vt["resumed_exact_step"])
+    assert summary["all_resumes_exact"]
+    # the bystander was never disturbed
+    assert summary["tenants"]["steady"]["preemptions"] == 0
+    # the fleet stream carries the typed health records
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+    fleet = read_records(str(tmp_path / "fleet" / "fleet.jsonl"))
+    health = [r for r in fleet if r.get("kind") == "health"]
+    assert {r["event"] for r in health} >= {"degrading", "quarantine",
+                                            "reinstate"}
+    assert sorted({d for r in health if r["event"] == "quarantine"
+                   for d in r["devices"]}) == sorted(first_slice)
+    reasons = {r.get("reason") for r in fleet if r.get("kind") == "tenant"}
+    assert "device-degraded" in reasons and "grow-back" in reasons
+    assert victim.trainer is not None
+
+
+def test_grow_back_after_topology_grow(tmp_path):
+    """A tenant admitted onto a maintenance-shrunken pool (below its
+    requested dp) expands back through the same grow-back pass when the
+    revoked devices return."""
+    orch = Orchestrator(workdir=str(tmp_path / "fleet"), quantum=1)
+    orch.shrink(6)                           # 2 devices left in service
+    tenant = orch.submit(TenantSpec(
+        name="t", workload="cnn",
+        config=_tenant_cfg(tmp_path, "t", 4, 3)))
+    while tenant.state is not TenantState.RUNNING:
+        orch.run_round()
+    assert len(tenant.devices) == 2          # admitted shrunk
+    orch.grow()                              # maintenance over
+    summary = orch.run(max_rounds=300)
+    orch.close()
+    t = summary["tenants"]["t"]
+    assert t["state"] == "completed"
+    assert t["grow_backs"] == 1
+    assert t["granted_sizes"] == [2, 4]
+    assert summary["all_resumes_exact"]
+
+
+def test_grow_back_flag_off_keeps_shrunken_slice(tmp_path):
+    orch = Orchestrator(workdir=str(tmp_path / "fleet"), quantum=1,
+                        grow_back=False)
+    orch.shrink(6)
+    tenant = orch.submit(TenantSpec(
+        name="t", workload="cnn",
+        config=_tenant_cfg(tmp_path, "t", 4, 2)))
+    while tenant.state is not TenantState.RUNNING:
+        orch.run_round()
+    orch.grow()
+    summary = orch.run(max_rounds=300)
+    orch.close()
+    t = summary["tenants"]["t"]
+    assert t["state"] == "completed"
+    assert t["grow_backs"] == 0
+    assert t["granted_sizes"] == [2]

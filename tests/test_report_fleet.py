@@ -141,6 +141,49 @@ def test_build_fleet_report_renders_tenants_and_ledger():
     assert "(none — every injected fault was detected and recovered" in out
 
 
+def test_fleet_report_renders_health_timeline():
+    records = [
+        {"kind": "tenant", "ts": 1, "name": "v", "event": "admitted",
+         "devices": [0, 1, 2, 3]},
+        {"kind": "health", "ts": 2, "event": "degrading",
+         "devices": [0, 1, 2, 3], "signal": "step", "score": 0.75,
+         "value": 1.6, "baseline": 0.02},
+        {"kind": "health", "ts": 3, "event": "quarantine", "devices": [3],
+         "score": 0.25},
+        {"kind": "tenant", "ts": 4, "name": "v",
+         "event": "preempt-requested", "reason": "device-degraded",
+         "global_step": 10},
+        {"kind": "health", "ts": 5, "event": "reinstate", "devices": [3],
+         "score": 1.0, "probation_ticks": 3},
+        {"kind": "tenant", "ts": 6, "name": "v", "event": "grow-back",
+         "devices": [6, 7], "target_devices": 4, "global_step": 12},
+    ]
+    out = build_fleet_report(records)
+    assert "== device health (3 events, 1 quarantines, 1 reinstates) ==" \
+        in out
+    assert "degrading" in out and "signal=step" in out
+    assert "quarantine" in out and "reinstate" in out
+    assert "migration    v: preempted off" in out
+    assert "grow-back    v: 2 -> 4 devices at step 12" in out
+
+
+def test_pair_faults_skips_persistent_degradations():
+    """slow_device/flaky_sync are not event faults: their audit trail is
+    the health timeline, so the ledger must not report them unpaired."""
+    from scripts.dmp_report import pair_faults
+
+    records = [
+        _rec("fault", ts=1, fault="slow_device", site="step", index=6),
+        _rec("fault", ts=2, fault="flaky_sync", site="sync", index=1),
+        _rec("fault", ts=3, fault="nan_loss", site="step", index=2),
+        _rec("failure", ts=4, error="non-finite"),
+        _rec("recovery", ts=5, action="restored"),
+    ]
+    ledger = pair_faults(records)
+    assert [row["fault"] for row in ledger] == ["nan_loss"]
+    assert ledger[0]["paired"]
+
+
 # ---------------------------------------------------------------------------
 # roofline: frac > 1 is a measurement error, not a fact
 # ---------------------------------------------------------------------------

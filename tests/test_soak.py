@@ -34,3 +34,56 @@ def test_soak_fast_campaign_smoke(tmp_path):
     assert summary["unrecovered"] == {}
     assert summary["preemptions"]
     assert summary["resumes_exact"]
+
+
+@pytest.mark.chaos
+def test_soak_degradation_campaign(tmp_path):
+    """The ISSUE-7 acceptance drill: an injected slow_device straggler's
+    slice is health-quarantined within 8 steps of the injection firing,
+    its tenant is proactively migrated (preempt-checkpointed onto the
+    only healthy devices, dp4 -> dp2) with zero unrecovered tenants, and
+    grows back to its requested dp=4 at the exact global step once the
+    quarantined devices pass probation — while the flaky-but-healthy
+    bystander (sub-threshold flaky_sync) is never preempted."""
+    from scripts.dmp_soak import parse_args, run_degradation_campaign
+
+    args = parse_args(["--scenario", "degradation"])
+    summary, ok = run_degradation_campaign(args, str(tmp_path), 0)
+    assert ok, summary
+    assert summary["tenants"] == {"victim": "completed",
+                                  "steady": "completed"}
+    # quarantined exactly the degraded slice, then healed it back
+    assert summary["quarantined_devices"] == [0, 1, 2, 3]
+    assert summary["reinstated_devices"] == [0, 1, 2, 3]
+    assert 0 <= (summary["migrated_at_step"]
+                 - summary["slow_device_fired_at_step"]) <= 8
+    # migrated (disjoint slice) + shrunk + grown back to request
+    assert summary["victim_granted_sizes"] == [4, 2, 4]
+    assert set(summary["victim_grants"][1]).isdisjoint(
+        summary["victim_grants"][0])
+    assert summary["victim_grow_backs"] == 1
+    # exact-step resume accounting across BOTH moves
+    assert summary["resumes_exact"]
+    assert summary["steady_preemptions"] == 0
+    assert summary["unrecovered"] == {}
+
+
+@pytest.mark.chaos
+def test_soak_long_mode_bounded_smoke(tmp_path):
+    """The long-campaign path (derived-seed loop, ROADMAP item 5's "run
+    the long mode for real") exercised in CI with a bounded wall-clock
+    budget: a tiny --duration-s still runs at least one full campaign
+    through the exact code path `--mode long` uses."""
+    from scripts.dmp_soak import parse_args, run_long
+
+    args = parse_args(["--mode", "long", "--duration-s", "1",
+                       "--seed", "3"])
+    summary, ok = run_long(args, str(tmp_path))
+    assert ok, summary
+    assert summary["soak"] == "long"
+    assert summary["n_campaigns"] >= 1
+    assert len(summary["campaigns"]) == summary["n_campaigns"]
+    first = summary["campaigns"][0]
+    assert first["ok"] and first["seed"] == 3
+    assert first["unrecovered"] == {} and first["unpaired"] == []
+    assert summary["all_ok"]

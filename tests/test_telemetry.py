@@ -147,6 +147,67 @@ def test_metrics_counters_are_deltas_since_stream_open(tmp_path):
     assert metrics["histograms"]["step_time_s"]["count"] == 1
 
 
+def test_counter_increments_attributed_to_tenant_scope():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("work_units")
+    with telemetry.tenant_scope("a"):
+        c.inc(2)
+    with telemetry.tenant_scope("b"):
+        c.inc(3)
+    c.inc(5)                                    # unscoped: fleet-only
+    assert reg.snapshot()["counters"]["work_units"] == 10
+    assert reg.snapshot(tenant="a")["counters"]["work_units"] == 2
+    assert reg.snapshot(tenant="b")["counters"]["work_units"] == 3
+    assert reg.snapshot(tenant="nobody")["counters"]["work_units"] == 0
+
+
+def test_tenant_tagged_stream_reports_per_tenant_counter_deltas(tmp_path):
+    """The OBSERVABILITY.md caveat this replaces: a co-resident tenant's
+    final metrics record used to carry fleet-total counter deltas; with
+    per-tenant attribution it carries only the increments made inside
+    ITS tenant_scope."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("jax_compiles").inc(4)          # pre-campaign noise
+    with telemetry.tenant_scope("a"):
+        run_a = telemetry.TelemetryRun(str(tmp_path / "a.jsonl"), run="a",
+                                       registry_=reg, track_compiles=False)
+        reg.counter("jax_compiles").inc(2)      # tenant a's compiles
+    with telemetry.tenant_scope("b"):
+        run_b = telemetry.TelemetryRun(str(tmp_path / "b.jsonl"), run="b",
+                                       registry_=reg, track_compiles=False)
+        reg.counter("jax_compiles").inc(7)      # tenant b's compiles
+    run_a.finish()
+    run_b.finish()
+    (ma,) = [r for r in telemetry.read_records(run_a.path)
+             if r["kind"] == "metrics"]
+    (mb,) = [r for r in telemetry.read_records(run_b.path)
+             if r["kind"] == "metrics"]
+    assert ma["counters"]["jax_compiles"] == 2
+    assert mb["counters"]["jax_compiles"] == 7
+
+
+def test_tenant_counter_attribution_is_thread_local():
+    import threading
+
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("steps")
+
+    def work(name, n):
+        with telemetry.tenant_scope(name):
+            for _ in range(n):
+                c.inc()
+
+    threads = [threading.Thread(target=work, args=("a", 30)),
+               threading.Thread(target=work, args=("b", 50))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.snapshot(tenant="a")["counters"]["steps"] == 30
+    assert reg.snapshot(tenant="b")["counters"]["steps"] == 50
+    assert reg.snapshot()["counters"]["steps"] == 80
+
+
 def test_read_records_skips_truncated_tail(tmp_path):
     path = tmp_path / "r.jsonl"
     path.write_text('{"ts": 1, "kind": "step"}\n{"ts": 2, "ki')
