@@ -78,11 +78,12 @@ def main():
         images, labels = next(iter(t.train_loader))
         rng = jax.random.key(0)
         if trainer_cls is Trainer:
-            im, lb = t._shard_batch(images, labels)
-
             def step():
                 nonlocal rng
                 rng, sub = jax.random.split(rng)
+                # Shard per call: the step donates its batch buffers, so
+                # a once-sharded batch dies at the first dispatch.
+                im, lb = t._shard_batch(images, labels)
                 t.state, m = t._train_step(t.state, sub, im, lb)
                 return m["loss"]
         else:

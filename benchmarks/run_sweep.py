@@ -87,12 +87,16 @@ def batch_sweep(args, results):
         )
         t = Trainer(cfg)
         images, labels = next(iter(t.train_loader))
-        im, lb = t._shard_batch(images, labels)
         rng = jax.random.key(0)
 
         def step():
             nonlocal rng
             rng, sub = jax.random.split(rng)
+            # Shard per call: the train step donates its batch buffers,
+            # so a once-sharded batch would be invalidated after the
+            # first dispatch (and the per-step upload is part of the
+            # streaming step cost being measured).
+            im, lb = t._shard_batch(images, labels)
             t.state, m = t._train_step(t.state, sub, im, lb)
             return m["loss"]
 

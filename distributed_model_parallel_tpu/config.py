@@ -82,6 +82,15 @@ class OptimizerConfig:
     # Exponential moving average of the weights (e.g. 0.999); evaluation and
     # best-acc selection use the averaged weights. None disables.
     ema_decay: float | None = None
+    # Fused optimizer update (ops/pallas_optim.py): apply
+    # SGD+momentum+weight-decay+LR in ONE Pallas TPU kernel over flat
+    # coalesced parameter buckets instead of optax's per-leaf elementwise
+    # op chain (pure-XLA fallback off-TPU, parity-tested against the optax
+    # path). Only valid with name="sgd" — other optimizers reject it
+    # loudly. Composes with grad_clip_norm and accum_steps; the LR
+    # schedule stays a closure, so recovery-time lr_shrink rebuilds keep
+    # the opt_state structure (docs/PERFORMANCE.md).
+    fused: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,6 +137,16 @@ class DataConfig:
     # 224px backbone, reference Readme.md:186-196).
     synthetic_native_size: int | None = None
     prefetch: int = 2                       # host-thread prefetch depth (0 = off)
+    # Device-resident input prefetch (data/loader.DevicePrefetchLoader):
+    # keep this many batches ahead of the consumed one already uploaded —
+    # the sharded jax.device_put for batch k+1..k+depth is issued while
+    # step k runs, so the step never waits on the host→device wire. 0
+    # disables (the epoch loop falls back to a per-step device_put).
+    # Composes with `prefetch` (host thread assembles, this stage
+    # uploads); exact-resume semantics are untouched — the loader cursor
+    # is consumer-driven (BatchLoader.position), and run-ahead uploads
+    # are never counted as consumed (docs/PERFORMANCE.md).
+    device_prefetch: int = 2
     use_native: bool = False                # C++ row-gather batch assembly
     # File-backed datasets (ImageFolder / CUB): True streams pixels from
     # disk per batch (host memory = the path list), False decodes the
@@ -203,6 +222,16 @@ class TrainConfig:
     strategy: str = "gspmd"
     ddp_bucket_bytes: int | None = None     # None = per-leaf psum
     ddp_allreduce: str = "psum"             # "psum" | "bucketed" | "ring"
+    # Bucketed gradient allreduce cap in MiB — the DDP Reducer's
+    # bucket_cap_mb knob (reference Readme.md:148-157). With
+    # strategy="ddp" this routes the gradient averaging through
+    # ops/collectives.bucketed_psum (reverse-leaf-order size-capped flat
+    # buckets, so early buckets fire while the backward still runs and
+    # XLA overlaps the collectives with compute). Only meaningful on the
+    # explicit DDP path: the gspmd/fsdp strategies leave the reduction to
+    # XLA's partitioner, so setting it there raises — no silent ignores.
+    # Overrides ddp_bucket_bytes when both are set.
+    grad_bucket_mb: float | None = None
     log_dir: str = "./log"
     log_name: str = "train"
     checkpoint_dir: str = "./checkpoint"

@@ -292,6 +292,84 @@ def maybe_prefetch(loader: Iterable, depth: int) -> Iterable:
     return PrefetchLoader(loader, depth=depth) if depth > 0 else loader
 
 
+class DevicePrefetchLoader:
+    """Device-resident double-buffered input prefetch.
+
+    Wraps a host batch iterable and eagerly issues ``put_fn`` (the sharded
+    ``jax.device_put`` — e.g. ``Trainer._shard_batch``) for the next
+    ``depth`` batches while the consumer's current step runs, so at every
+    yield up to ``depth`` future batches are already in flight to (or
+    resident on) the accelerators. ``jax.device_put`` enqueues the
+    transfer asynchronously, so run-ahead here IS compute/H2D overlap —
+    no extra thread needed on top of the host-side :class:`PrefetchLoader`
+    (which overlaps batch *assembly*; this stage overlaps the *upload*).
+
+    Resume semantics are untouched by design: the persistent loader cursor
+    is consumer-driven (``BatchLoader.position`` called by the epoch
+    drivers per *consumed* batch), so run-ahead uploads are never counted
+    as consumed — a kill mid-epoch resumes at the exact next batch the
+    trainer dispatched, bitwise-identically (tests/test_perf_pipeline.py).
+
+    Abandoning iteration mid-epoch (preemption break, train-step
+    exception) closes the underlying iterator, propagating the shutdown
+    to a PrefetchLoader worker / generator source. Per-iteration transfer
+    stats land in :attr:`last_stats` (``puts`` issued, ``max_lead`` =
+    the largest number of uploaded-but-unconsumed batches observed) — the
+    no-silent-fallback proof bench.py's ``step_phase`` record carries.
+    """
+
+    def __init__(self, loader: Iterable, put_fn, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"device prefetch depth must be >= 1, "
+                             f"got {depth}")
+        self.loader = loader
+        self.put_fn = put_fn
+        self.depth = depth
+        self.last_stats = {"puts": 0, "max_lead": 0}
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        stats = {"puts": 0, "max_lead": 0}
+        self.last_stats = stats
+        it = iter(self.loader)
+        buf: list = []          # uploaded, not yet consumed (FIFO)
+        exhausted = False
+        try:
+            while True:
+                while not exhausted and len(buf) <= self.depth:
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    buf.append(self.put_fn(*batch))
+                    stats["puts"] += 1
+                if not buf:
+                    return
+                # Lead = batches in flight beyond the one about to be
+                # consumed; the smoke test pins this at >= depth.
+                stats["max_lead"] = max(stats["max_lead"], len(buf) - 1)
+                yield buf.pop(0)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:   # noqa: BLE001 - already shutting down
+                    pass
+
+
+def maybe_device_prefetch(loader: Iterable, put_fn, depth: int) -> Iterable:
+    """Wrap ``loader`` so it yields device-resident batches: a
+    :class:`DevicePrefetchLoader` when ``depth > 0``, else a plain
+    per-batch ``put_fn`` map (the historical per-step device_put)."""
+    if depth > 0:
+        return DevicePrefetchLoader(loader, put_fn, depth=depth)
+    return (put_fn(*batch) for batch in loader)
+
+
 def resolve_input_size(images_shape, image_size: int) -> tuple[int | None, int]:
     """(resize_to, input_hw) for the on-device resize input stage.
 

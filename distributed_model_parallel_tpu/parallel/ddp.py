@@ -164,7 +164,11 @@ def make_ddp_train_step(model: StagedModel, tx: optax.GradientTransformation,
         in_specs=(state_specs, P(), P(axis), P(axis)),
         out_specs=(state_specs, P()),
         check_vma=False)
-    return jax.jit(shard_fn, donate_argnums=(0,))
+    # Donate the state (in-place update) AND the batch buffers — each
+    # sharded batch is consumed exactly once, and handing ownership to
+    # the runtime frees its device memory at dispatch (see the GSPMD
+    # step in train/trainer._build_steps for the full rationale).
+    return jax.jit(shard_fn, donate_argnums=(0, 2, 3))
 
 
 def make_ddp_eval_step(model: StagedModel, spec: MeshSpec, *, mean, std,

@@ -136,6 +136,14 @@ class LMTrainer:
             raise ValueError(
                 "ema_decay is implemented by the data-parallel Trainer "
                 "(gspmd/fsdp), not the LM trainer — no silent ignores")
+        if config.optimizer.fused:
+            raise ValueError(
+                "OptimizerConfig.fused runs the update over flat "
+                "coalesced parameter buckets; the LM trainer's params are "
+                "stage/tensor-sharded (spmd_pipeline.shard_params), so "
+                "the flat concat would gather them to full size every "
+                "step — use it on the replicated-param CNN trainer paths "
+                "(gspmd/ddp) — no silent ignores")
         self.tx = make_optimizer(config.optimizer, config.steps_per_epoch,
                                  config.epochs)
         self._step = make_spmd_train_step(
@@ -357,17 +365,32 @@ class LMTrainer:
         return out
 
     def evaluate(self) -> float:
-        """Mean held-out loss over the fixed eval batches."""
+        """Mean held-out loss over the fixed eval batches.
+
+        All batches are dispatched back-to-back and fetched with ONE
+        host sync (vectorized numpy mean) — the per-batch ``float()``
+        drain serialized upload/compute across eval batches through a
+        remote device transport (one blocking round trip each)."""
         if self._eval_loss is None:
             raise ValueError("eval disabled (eval_batches=0 or "
                              "eval_fraction=0)")
-        total, n = 0.0, 0
         eval_params = self._canonical_params()
+        # Bounded run-ahead (the Trainer.evaluate _max_inflight pattern):
+        # a large explicit eval_batches must not hold every batch's
+        # input buffers + in-flight computations on device at once.
+        max_inflight = 8
+        vals: list = []
+        pending: list = []
         for toks, tgts in self.eval_batches():
-            total += float(self._eval_loss(eval_params, jnp.asarray(toks),
+            pending.append(self._eval_loss(eval_params, jnp.asarray(toks),
                                            jnp.asarray(tgts)))
-            n += 1
-        return total / max(1, n)
+            if len(pending) >= max_inflight:
+                vals.extend(jax.device_get(pending))
+                pending.clear()
+        vals.extend(jax.device_get(pending))
+        if not vals:
+            return 0.0
+        return float(np.mean(np.asarray(vals, dtype=np.float64)))
 
     # ----------------------------------------------------------- checkpoint
     def _ckpt_meta(self):

@@ -19,7 +19,9 @@ subtracts the separately-measured fetch round-trip cost.
 from __future__ import annotations
 
 import contextlib
+import re
 import time
+import warnings
 from typing import Callable
 
 import jax
@@ -104,7 +106,15 @@ def compiled_cost_analysis(jitted: Callable, *args) -> dict:
     train step), or as a lower-bound cross-check next to an analytic
     count such as :func:`lm_model_flops`."""
     try:
-        compiled = jitted.lower(*args).compile()
+        return cost_analysis_of(jitted.lower(*args).compile())
+    except Exception:
+        return {}
+
+
+def cost_analysis_of(compiled) -> dict:
+    """Cost analysis of an already-compiled program (see
+    :func:`compiled_cost_analysis` for the blind spots); empty on failure."""
+    try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):    # older JAX: one dict per comp
             ca = ca[0] if ca else {}
@@ -122,6 +132,107 @@ def compiled_flops(jitted: Callable, *args) -> float | None:
 def peak_hbm_bytes_per_chip(device=None) -> float | None:
     """HBM bandwidth (bytes/s) for ``device``; None when unknown."""
     return match_device_kind(TPU_PEAK_HBM_BYTES, device)
+
+
+# ---------------------------------------------------------------------------
+# Buffer-donation audit: trace-time proof that donation held.
+# ---------------------------------------------------------------------------
+
+class DonationError(AssertionError):
+    """An expected buffer donation was dropped (or never set up) by XLA.
+
+    Dropped donation is a *silent* perf/memory regression: the step still
+    computes the same numbers, it just holds two copies of the state —
+    which is exactly how an OOM or a 2x live-memory surprise ships.
+    """
+
+
+# One alias entry of the HLO module header's input_output_alias field,
+# e.g. ``{0}: (0, {}, may-alias)`` — (output index): (param number,
+# param index, kind).
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[\d,\s]*\}:\s*\(\s*(\d+)\s*,\s*\{[\d,\s]*\}\s*,\s*"
+    r"(may-alias|must-alias)\s*\)")
+
+
+def aot_compile(jitted: Callable, *args, **kwargs):
+    """``jitted.lower(*args).compile()`` with lowering warnings captured:
+    returns ``(compiled, warnings_list)``. One AOT compile serves cost
+    analysis AND the donation report (bench.py does both from it).
+    ``args`` may be concrete arrays or ``jax.ShapeDtypeStruct``s."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = jitted.lower(*args, **kwargs).compile()
+    return compiled, list(caught)
+
+
+def donation_report(compiled, caught=()) -> dict:
+    """What happened to a compiled program's donated buffers:
+    ``{"n_aliased", "aliased_params", "dropped"}``.
+
+    * ``n_aliased`` — input→output alias pairs XLA committed to (the
+      ``input_output_alias`` field of the compiled module header): these
+      buffers are genuinely reused in place.
+    * ``dropped`` — donations XLA could NOT use (jax's "Some donated
+      buffers were not usable" lowering warning from ``caught``, captured
+      instead of printed), as the warned shape strings, e.g.
+      ``["uint8[512,32,32,3]"]``. Caveat: the warning fires at *lowering*
+      — a jit whose lowering was already cached (the function was called
+      before) re-raises nothing, so dropped-detection needs a fresh
+      jitted fn (or the trainers' build-time audit).
+    """
+    dropped: list[str] = []
+    for w in caught:
+        msg = str(w.message)
+        if "donated buffers were not usable" in msg:
+            dropped += re.findall(r"ShapedArray\(([^)]+)\)", msg) or [msg]
+    # The alias field's nested braces defeat a simple field-isolating
+    # regex; the entry pattern's literal "may-alias)" is unambiguous in
+    # the whole module header, so match entries directly. The header is
+    # everything before the first computation body.
+    header = compiled.as_text().split("ENTRY", 1)[0]
+    entries = _ALIAS_ENTRY_RE.findall(header)
+    return {
+        "n_aliased": len(entries),
+        "aliased_params": sorted({int(p) for p, _ in entries}),
+        "dropped": dropped,
+    }
+
+
+def donation_audit(jitted: Callable, *args, **kwargs) -> dict:
+    """AOT-compile ``jitted(*args)`` and return its :func:`donation_report`.
+    A real (cache-miss) XLA compile of the program — use at trace/startup
+    time, not per step."""
+    return donation_report(*aot_compile(jitted, *args, **kwargs))
+
+
+def assert_donation(jitted: Callable, *args, min_aliased: int = 1,
+                    allow_dropped: tuple[str, ...] = (), **kwargs) -> dict:
+    """Fail loudly when an expected donation was dropped by XLA.
+
+    Asserts the compiled program carries at least ``min_aliased``
+    input→output buffer aliases AND that every dropped donation matches an
+    ``allow_dropped`` prefix (e.g. ``("uint8", "int32")`` for the batch
+    buffers, which have no same-shaped output to alias with but are still
+    donated so the runtime frees them at dispatch). Returns the
+    :func:`donation_audit` report on success; raises :class:`DonationError`
+    otherwise. The CI smoke (tests/test_perf_pipeline.py) pins both
+    failure modes on toy functions.
+    """
+    report = donation_audit(jitted, *args, **kwargs)
+    unexpected = [d for d in report["dropped"]
+                  if not any(d.startswith(p) for p in allow_dropped)]
+    if unexpected:
+        raise DonationError(
+            f"XLA dropped donation for {unexpected} (aliased "
+            f"{report['n_aliased']} buffers) — an expected in-place "
+            f"update silently became a copy; see donation_audit()")
+    if report["n_aliased"] < min_aliased:
+        raise DonationError(
+            f"expected >= {min_aliased} donated input→output aliases, "
+            f"compiled program has {report['n_aliased']} — donation is "
+            f"not set up (missing donate_argnums?)")
+    return report
 
 
 def demand_frac_of_peak(bytes_per_s: float | None,

@@ -105,6 +105,21 @@ def parse_args():
     p.add_argument("--no-augment", action="store_true")
     p.add_argument("--prefetch", default=2, type=int,
                    help="host prefetch depth (0 disables)")
+    p.add_argument("--device-prefetch", default=2, type=int, metavar="N",
+                   help="device-resident input prefetch: keep N batches' "
+                        "sharded uploads in flight ahead of the running "
+                        "step (0 = per-step device_put; "
+                        "docs/PERFORMANCE.md)")
+    p.add_argument("--grad-bucket-mb", default=None, type=float,
+                   metavar="MB",
+                   help="bucketed gradient allreduce cap (DDP only): "
+                        "route grads through flat reverse-order buckets "
+                        "overlapping the backward (the Reducer's "
+                        "bucket_cap_mb; overrides --bucket-mb)")
+    p.add_argument("--fused-opt", action="store_true",
+                   help="fused Pallas SGD optimizer kernel "
+                        "(ops/pallas_optim.py; sgd only, pure-XLA "
+                        "fallback off-TPU)")
     p.add_argument("--native-loader", action="store_true",
                    help="assemble batches with the C++ row-gather")
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute")
@@ -169,6 +184,9 @@ def main():
         sys.exit("--sync-bn and --no-bn are mutually exclusive")
     if args.sync_bn and args.model.endswith("_nobn"):
         sys.exit(f"--sync-bn conflicts with the BN-free model {args.model!r}")
+    if not args.ddp and args.grad_bucket_mb is not None:
+        sys.exit("--grad-bucket-mb routes gradients through bucketed_psum, "
+                 "which needs the explicit DDP path; add --ddp")
     if not args.ddp and (args.allreduce != "psum" or args.bucket_mb):
         print("warning: --allreduce/--bucket-mb select the explicit DDP "
               "gradient transport; without --ddp the GSPMD path lets XLA "
@@ -195,6 +213,7 @@ def main():
                         image_size=args.image_size,
                         batch_size=args.batch_size, num_workers=args.workers,
                         augment=not args.no_augment, prefetch=args.prefetch,
+                        device_prefetch=args.device_prefetch,
                         use_native=args.native_loader),
         optimizer=OptimizerConfig(
             name=args.optimizer,
@@ -202,7 +221,8 @@ def main():
             weight_decay=args.wd,
             warmup_steps=args.warmup_epochs * steps_per_epoch,
             accum_steps=args.accum_steps,
-            ema_decay=args.ema_decay),
+            ema_decay=args.ema_decay,
+            fused=args.fused_opt),
         mesh=MeshConfig(data=n, dcn_data=args.dcn_data),
         epochs=args.epochs,
         resume=args.resume,
@@ -214,6 +234,7 @@ def main():
         strategy="ddp" if args.ddp else ("fsdp" if args.fsdp else "gspmd"),
         ddp_bucket_bytes=args.bucket_mb * 1024 * 1024 or None,
         ddp_allreduce=args.allreduce,
+        grad_bucket_mb=args.grad_bucket_mb,
         check_finite_every=args.check_finite_every,
         stall_budget_s=args.stall_budget,
         consistency_every=args.consistency_every,

@@ -1,0 +1,152 @@
+"""Bucketed allreduce (ops/collectives.plan_buckets / bucketed_psum):
+the DDP Reducer's coalescing trick (reference Readme.md:148-157), pinned
+at the collective layer — bucket-plan invariants and numerical
+equivalence with the per-leaf psum on a ragged mixed-dtype pytree.
+
+These properties are what TrainConfig.grad_bucket_mb rides on
+(docs/PERFORMANCE.md lever 3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distributed_model_parallel_tpu.ops.collectives import (
+    bucketed_psum,
+    plan_buckets,
+    psum_mean,
+)
+
+pytestmark = pytest.mark.perf
+
+
+def _ragged_tree():
+    """Mixed shapes AND dtypes: f32 matrices, an f32 vector, a bf16
+    block, a tiny f32 scalar-ish leaf — the shape of a real model's
+    gradient pytree, none of it bucket-aligned."""
+    rng = np.random.default_rng(7)
+    return {
+        "conv": {"w": jnp.asarray(rng.normal(size=(9, 7)), jnp.float32),
+                 "b": jnp.asarray(rng.normal(size=(13,)), jnp.float32)},
+        "bn": jnp.asarray(rng.normal(size=(1,)), jnp.float32),
+        "head": jnp.asarray(rng.normal(size=(6, 5, 4)), jnp.bfloat16),
+        "bias": jnp.asarray(rng.normal(size=(31,)), jnp.float32),
+    }
+
+
+def _leaf_bytes(leaf) -> int:
+    return leaf.size * np.dtype(leaf.dtype).itemsize
+
+
+# ---------------------------------------------------------------------------
+# plan_buckets invariants
+# ---------------------------------------------------------------------------
+
+def test_plan_buckets_reverse_order_invariant():
+    """Buckets fill in reverse leaf order (the Reducer's trick: the last
+    layers' grads are produced first by the backward, so their bucket can
+    fire while earlier layers still compute), and the plan is a partition
+    — every leaf exactly once."""
+    tree = _ragged_tree()
+    n = len(jax.tree.leaves(tree))
+    buckets = plan_buckets(tree, bucket_bytes=200)
+    flat = [i for b in buckets for i in b]
+    assert flat == list(reversed(range(n)))
+
+
+def test_plan_buckets_cap_respected():
+    """No bucket exceeds the byte cap unless a single oversize leaf
+    forces its own bucket."""
+    tree = _ragged_tree()
+    leaves = jax.tree.leaves(tree)
+    cap = 150
+    for bucket in plan_buckets(tree, bucket_bytes=cap):
+        total = sum(_leaf_bytes(leaves[i]) for i in bucket)
+        assert total <= cap or len(bucket) == 1
+
+
+def test_plan_buckets_single_bucket_when_cap_huge():
+    tree = _ragged_tree()
+    buckets = plan_buckets(tree, bucket_bytes=1 << 30)
+    assert len(buckets) == 1
+
+
+def test_plan_buckets_oversize_leaf_isolated():
+    tree = {"big": jnp.zeros((64, 64), jnp.float32),   # 16 KiB
+            "s1": jnp.zeros((4,), jnp.float32),
+            "s2": jnp.zeros((4,), jnp.float32)}
+    buckets = plan_buckets(tree, bucket_bytes=64)
+    leaves = jax.tree.leaves(tree)
+    big_idx = max(range(len(leaves)), key=lambda i: leaves[i].size)
+    solo = [b for b in buckets if big_idx in b]
+    assert solo and solo[0] == [big_idx]
+
+
+# ---------------------------------------------------------------------------
+# bucketed_psum numerical equivalence with the per-leaf psum
+# ---------------------------------------------------------------------------
+
+def _allreduce_both(tree, mesh8, **bucket_kw):
+    """Run bucketed_psum and psum_mean over per-replica-distinct copies
+    of ``tree`` inside one shard_map; returns (bucketed, per_leaf)."""
+
+    def body(t):
+        # Distinct per-replica contribution so the reduction is real.
+        i = jax.lax.axis_index("data")
+        t = jax.tree.map(
+            lambda x: x * (1.0 + i.astype(jnp.float32)).astype(x.dtype), t)
+        return (bucketed_psum(t, "data", **bucket_kw),
+                psum_mean(t, "data"))
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh8.mesh, in_specs=(P(),),
+                               out_specs=(P(), P()), check_vma=False))
+    return fn(tree)
+
+
+@pytest.mark.parametrize("cap", [64, 150, 1 << 20])
+def test_bucketed_psum_matches_psum_mean_ragged(mesh8, cap):
+    """Equivalence across bucket layouts: one giant bucket, several
+    small ones, and per-leaf-ish tiny caps all reproduce the per-leaf
+    allreduce-mean on the ragged mixed-dtype tree."""
+    tree = _ragged_tree()
+    bucketed, per_leaf = _allreduce_both(tree, mesh8, bucket_bytes=cap)
+    for a, b in zip(jax.tree.leaves(bucketed), jax.tree.leaves(per_leaf)):
+        assert a.dtype == b.dtype          # leaf dtypes restored
+        a32 = np.asarray(a, np.float32)
+        b32 = np.asarray(b, np.float32)
+        # bf16 leaves promoted into a mixed bucket reduce at a different
+        # precision than the per-leaf transport; everything else exact.
+        tol = 1e-2 if jnp.bfloat16 in (a.dtype,) else 1e-6
+        np.testing.assert_allclose(a32, b32, rtol=tol, atol=tol)
+
+
+def test_bucketed_psum_accum_dtype_f32_matches_f32_reference(mesh8):
+    """accum_dtype=f32: bf16 gradients reduce (and mean-divide) in f32 —
+    the fp32-reduce comm-hook trade. Must match an all-f32 reference
+    reduction downcast at the end."""
+    rng = np.random.default_rng(3)
+    bf = jnp.asarray(rng.normal(size=(17, 3)), jnp.bfloat16)
+    tree = {"g": bf}
+    bucketed, _ = _allreduce_both(tree, mesh8,
+                                  accum_dtype=jnp.float32)
+    # reference: same per-replica scaling in f32, mean over replicas 1..8
+    scale = np.mean(np.arange(1, 9, dtype=np.float32))
+    ref = (np.asarray(bf, np.float32) * scale).astype(jnp.bfloat16)
+    assert bucketed["g"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(bucketed["g"], np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_bucketed_psum_sum_mode(mesh8):
+    """mean=False sums like a raw psum."""
+    tree = {"x": jnp.ones((5,), jnp.float32)}
+
+    def body(t):
+        return bucketed_psum(t, "data", mean=False)
+
+    out = jax.jit(jax.shard_map(body, mesh=mesh8.mesh, in_specs=(P(),),
+                                out_specs=P(), check_vma=False))(tree)
+    np.testing.assert_allclose(np.asarray(out["x"]), 8.0)

@@ -1,0 +1,193 @@
+"""Fused SGD optimizer (ops/pallas_optim.py): parity against the optax
+chain it replaces, on both the pure-XLA fallback (bit-identical for f32)
+and the Pallas kernel (via the interpreter on CPU — the
+ops/pallas_attention.py idiom), plus the structural properties the
+trainers depend on (schedule-closure lr_shrink rebuilds, make_optimizer
+dispatch, end-to-end training)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_model_parallel_tpu.config import OptimizerConfig
+from distributed_model_parallel_tpu.ops.pallas_optim import (
+    FusedSGDState,
+    fused_sgd,
+)
+from distributed_model_parallel_tpu.train.optim import make_optimizer
+
+pytestmark = pytest.mark.perf
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "conv": {"w": jnp.asarray(rng.normal(size=(9, 7)), jnp.float32),
+                 "b": jnp.asarray(rng.normal(size=(13,)), jnp.float32)},
+        "head": jnp.asarray(rng.normal(size=(6, 5, 4)), jnp.float32),
+        "scale": jnp.asarray(rng.normal(size=(1,)), jnp.float32),
+    }
+
+
+def _optax_ref(lr, momentum, wd, nesterov):
+    parts = []
+    if wd:
+        parts.append(optax.add_decayed_weights(wd))
+    parts.append(optax.sgd(learning_rate=lr, momentum=momentum or None,
+                           nesterov=nesterov))
+    return optax.chain(*parts)
+
+
+def _run(tx, steps=4, seed=0):
+    params = _tree(seed)
+    state = tx.init(params)
+    rng = np.random.default_rng(seed + 100)
+    for k in range(steps):
+        grads = jax.tree.map(
+            lambda p: p * 0.05 + jnp.asarray(
+                rng.normal(size=p.shape), p.dtype) * 0.1, params)
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    return params, state
+
+
+@pytest.mark.parametrize("momentum,wd,nesterov", [
+    (0.9, 1e-4, False),
+    (0.9, 1e-4, True),
+    (0.9, 0.0, False),
+    (0.0, 1e-4, False),
+])
+def test_xla_fallback_bitwise_matches_optax(momentum, wd, nesterov):
+    """The fallback path is the SAME expression tree as the optax chain
+    — bit-identical f32 params after several steps, every variant."""
+    sched = optax.cosine_decay_schedule(0.4, 10)
+    ref, _ = _run(_optax_ref(sched, momentum, wd, nesterov))
+    got, _ = _run(fused_sgd(sched, momentum=momentum, weight_decay=wd,
+                            nesterov=nesterov, use_pallas=False))
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_kernel_matches_optax():
+    """The kernel path (interpret mode off-TPU) — elementwise-equal
+    within f32 rounding: same math, flat-bucket evaluation order."""
+    sched = optax.cosine_decay_schedule(0.4, 10)
+    ref, _ = _run(_optax_ref(sched, 0.9, 1e-4, False))
+    got, _ = _run(fused_sgd(sched, momentum=0.9, weight_decay=1e-4,
+                            use_pallas=True))
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_no_momentum_carries_no_trace_state():
+    """momentum=0.0: no params-sized trace buffer exists (the optax
+    path's footprint), and both kernel and fallback still match optax."""
+    ref, _ = _run(_optax_ref(0.1, 0.0, 1e-4, False))
+    for use_pallas in (False, True):
+        tx = fused_sgd(0.1, momentum=0.0, weight_decay=1e-4,
+                       use_pallas=use_pallas)
+        got, state = _run(tx)
+        assert state.momentum is None
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_pallas_kernel_small_bucket_cap():
+    """Multiple buckets (cap below the tree size) reproduce the single
+    bucket result — the split is layout, not math."""
+    one, _ = _run(fused_sgd(0.1, momentum=0.9, weight_decay=1e-4,
+                            use_pallas=True))
+    many, _ = _run(fused_sgd(0.1, momentum=0.9, weight_decay=1e-4,
+                             use_pallas=True, bucket_bytes=256))
+    for a, b in zip(jax.tree.leaves(one), jax.tree.leaves(many)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_schedule_counts_updates():
+    """The LR schedule sees the applied-update count: after k updates the
+    state count is k (how MultiSteps/accum and lr curves stay aligned
+    with the optax path)."""
+    tx = fused_sgd(optax.cosine_decay_schedule(0.4, 10), momentum=0.9,
+                   use_pallas=False)
+    _, state = _run(tx, steps=3)
+    assert int(state.count) == 3
+
+
+def test_make_optimizer_dispatch_and_rejects():
+    """OptimizerConfig.fused routes sgd through fused_sgd; other
+    optimizer names reject loudly (no silent ignores)."""
+    tx = make_optimizer(OptimizerConfig(name="sgd", fused=True,
+                                        learning_rate=0.1), 10, 2)
+    params = _tree()
+    state = tx.init(params)
+
+    def _contains_fused(s):
+        if isinstance(s, FusedSGDState):
+            return True
+        return isinstance(s, tuple) and any(_contains_fused(x) for x in s)
+
+    assert _contains_fused(state)
+    updates, _ = tx.update(jax.tree.map(jnp.ones_like, params), state,
+                           params)
+    assert jax.tree.structure(updates) == jax.tree.structure(params)
+    with pytest.raises(ValueError, match="fused"):
+        make_optimizer(OptimizerConfig(name="adamw", fused=True), 10, 2)
+
+
+def test_lr_shrink_rebuild_keeps_state_structure():
+    """The recovery-time lr_shrink path rebuilds the optimizer at a
+    scaled LR; the fused opt_state structure must carry over (the
+    schedule is a closure, not state)."""
+    cfg = OptimizerConfig(name="sgd", fused=True, learning_rate=0.4,
+                          momentum=0.9)
+    tx = make_optimizer(cfg, 10, 2)
+    params = _tree()
+    state = tx.init(params)
+    _, state = tx.update(jax.tree.map(jnp.ones_like, params), state, params)
+    shrunk = make_optimizer(dataclasses.replace(cfg, learning_rate=0.2),
+                            10, 2)
+    assert (jax.tree.structure(shrunk.init(params))
+            == jax.tree.structure(state))
+    updates, _ = shrunk.update(jax.tree.map(jnp.ones_like, params), state,
+                               params)
+    assert jax.tree.structure(updates) == jax.tree.structure(params)
+
+
+def test_fused_with_accum_and_clip_composes():
+    """grad_clip_norm chains in front, MultiSteps wraps around — the
+    same composition surface as the optax path."""
+    cfg = OptimizerConfig(name="sgd", fused=True, learning_rate=0.1,
+                          momentum=0.9, grad_clip_norm=1.0, accum_steps=2)
+    tx = make_optimizer(cfg, 10, 2)
+    params = _tree()
+    state = tx.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    u1, state = tx.update(g, state, params)
+    # first micro-step of 2: params must hold still
+    assert all(float(np.abs(np.asarray(x)).max()) == 0.0
+               for x in jax.tree.leaves(u1))
+    u2, state = tx.update(g, state, params)
+    assert any(float(np.abs(np.asarray(x)).max()) > 0.0
+               for x in jax.tree.leaves(u2))
+
+
+def test_trainer_fit_with_fused_optimizer(tmp_path):
+    """End to end: the gspmd Trainer trains with the fused optimizer
+    (XLA fallback on CPU) — finite loss, checkpointable state."""
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    cfg = tiny_train_config(tmp_path, epochs=1)
+    cfg = cfg.replace(optimizer=dataclasses.replace(
+        cfg.optimizer, name="sgd", fused=True))
+    t = Trainer(cfg)
+    hist = t.fit()
+    assert np.isfinite(hist[0]["loss_train"])
