@@ -20,6 +20,11 @@ long-context Transformer train step (DMP_BENCH_SEQ, default 8192;
 DMP_BENCH_REMAT=full|dots selects the block remat policy;
 DMP_BENCH_LOSS_CHUNK is the chunked cross-entropy head's chunk size in
 tokens, e.g. 8192 — 0 = dense head) measured in tokens/s/chip.
+DMP_BENCH_WORKLOAD=decode is the dense-cache batch decode bench;
+DMP_BENCH_WORKLOAD=serve replays a seeded open-loop Poisson trace through
+the continuous-batching serving engine (serve/) against the static-batch
+baseline and reports tokens/s/chip + p50/p99 TTFT/per-token latency +
+page-pool occupancy (DMP_BENCH_SERVE_* knobs; docs/SERVING.md).
 
 Failure semantics: first device contact retries with backoff
 (DMP_BENCH_RETRIES, DMP_BENCH_RETRY_DELAY_S); a permanently unreachable
@@ -340,8 +345,238 @@ def bench_decode() -> None:
     }
     if frac_err:
         out["demand_frac_error"] = frac_err
+    # Phase attribution (prefill / per-token decode / sampling) so a
+    # decode regression is attributable like a training one.
+    try:
+        phase = decode_phase_record(info, params, prompt, dt)
+    except Exception as e:   # noqa: BLE001 - attribution must not kill bench
+        phase = {"pipeline": None, "phases": None,
+                 "reason": f"decode-phase probe failed: {type(e).__name__}"}
+    telemetry.record("step_phase", **phase)
+    out["step_phase"] = phase
     telemetry.step(step=0, step_time_s=dt / max(1, steps),
                    tokens_per_s=toks_per_s)
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    telemetry.finish()
+    print(json.dumps(out))
+
+
+def decode_phase_record(info: dict, params, prompt, dt_total: float) -> dict:
+    """``step_phase``-style attribution for the decode bench: where the
+    generate program's wall time goes — prompt prefill vs per-token
+    cached decode vs sampling — so a serving regression is attributable
+    to a phase like a training one (the train bench's host/h2d/device
+    split). Measured as serialized sub-program probes (each jitted and
+    synced on its own), with the per-token decode derived as the
+    remainder of the measured total; on CPU the phase timings are
+    omitted honestly (dispatch overhead swamps sub-millisecond
+    phases there), but the pipeline identity is still recorded."""
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.utils.profiling import (
+        fetch,
+        fetch_overhead,
+    )
+
+    cfg, batch = info["cfg"], info["batch"]
+    t0_len, steps = info["prompt_len"], info["gen_steps"]
+    rec: dict = {"pipeline": {
+        "workload": "decode",
+        "batch": batch, "prompt_len": t0_len, "gen_steps": steps,
+        "kv_cache": "dense",           # bench_decode times generate()'s
+                                       # dense read-boundary cache; the
+                                       # paged engine is BENCH_serve
+        "read_segment": tfm.DECODE_READ_SEG,
+    }}
+    if jax.devices()[0].platform == "cpu":
+        rec["phases"] = None
+        rec["reason"] = ("cpu: per-phase probe times are dominated by "
+                         "dispatch overhead, not attributable phase cost")
+        return rec
+    t_fetch = fetch_overhead()
+
+    def timed(fn, *args, n=3):
+        fetch(fn(*args))               # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        fetch(out)
+        return max(0.0, (time.perf_counter() - t0 - t_fetch) / n)
+
+    # Prefill proxy: one full forward over the prompt (the batched
+    # prefill is exactly one forward that also writes the cache).
+    # Reduce to the last position's argmax INSIDE the jitted fn — what
+    # prefill actually consumes — so the timed bracket's closing fetch
+    # moves [B] ints, not the whole [B, T, V] logits (a ~65 MB D2H over
+    # the tunnel would swamp the compute being attributed).
+    prefill_s = timed(jax.jit(
+        lambda p, pr: jnp.argmax(tfm.apply(p, pr, cfg)[:, -1], axis=-1)),
+        params, prompt)
+    # Sampling: the per-step argmax over [B, V] logits.
+    logits = jnp.zeros((batch, cfg.vocab_size), cfg.dtype)
+    sample_token_s = timed(jax.jit(
+        lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32)), logits)
+    decode_token_s = max(
+        0.0, dt_total - prefill_s - steps * sample_token_s) / steps
+    # Per-token convenience values ride in the pipeline identity; the
+    # ``phases`` dict keeps UNIFORM units (wall seconds of the whole
+    # generate run) so the report's share computation is meaningful —
+    # mixing a per-run prefill with per-token decode would attribute
+    # regressions to the wrong phase.
+    rec["pipeline"]["decode_token_s"] = round(decode_token_s, 6)
+    rec["pipeline"]["sample_token_s"] = round(sample_token_s, 6)
+    rec["phases"] = {
+        "prefill_s": round(prefill_s, 6),
+        "decode_s": round(decode_token_s * steps, 6),
+        "sample_s": round(sample_token_s * steps, 6),
+        "n_steps": steps,
+        "derivation": "decode_s = total - prefill - n*sample_token",
+    }
+    return rec
+
+
+def build_serve_trace():
+    """Seeded open-loop serving trace: Poisson arrivals
+    (DMP_BENCH_SERVE_RATE req/s, exponential inter-arrivals), per-request
+    prompt/generation lengths drawn uniform from env-configured ranges.
+    The SAME trace drives both the continuous engine and the static
+    baseline, so the speedup is a property of the scheduler, not the
+    workload draw. Returns ``(trace, model_cfg)``."""
+    from distributed_model_parallel_tpu.models import transformer as tfm
+
+    rng = np.random.default_rng(int(os.environ.get(
+        "DMP_BENCH_SERVE_SEED", "0")))
+    n_reqs = int(os.environ.get("DMP_BENCH_SERVE_REQS", "48"))
+    rate = float(os.environ.get("DMP_BENCH_SERVE_RATE", "50"))
+    p_lo, p_hi = (int(x) for x in os.environ.get(
+        "DMP_BENCH_SERVE_PROMPT", "16,96").split(","))
+    g_lo, g_hi = (int(x) for x in os.environ.get(
+        "DMP_BENCH_SERVE_GEN", "16,256").split(","))
+    # Generation lengths are EOS-terminated in real traffic — roughly
+    # geometric, not uniform. Default: exponential with mean at a
+    # quarter of the cap, clipped to [g_lo, g_hi]; the heavy tail is
+    # exactly what makes static batching pay for its stragglers.
+    # DMP_BENCH_SERVE_GEN_DIST=uniform flattens it.
+    gen_dist = os.environ.get("DMP_BENCH_SERVE_GEN_DIST", "exp")
+
+    def draw_gen() -> int:
+        if gen_dist == "uniform":
+            return int(rng.integers(g_lo, g_hi + 1))
+        return int(min(g_hi, g_lo + rng.exponential((g_hi - g_lo) / 4)))
+    cfg = tfm.TransformerConfig(
+        vocab_size=int(os.environ.get("DMP_BENCH_SERVE_VOCAB", "8192")),
+        d_model=int(os.environ.get("DMP_BENCH_SERVE_DMODEL", "512")),
+        n_heads=8,
+        n_layers=int(os.environ.get("DMP_BENCH_SERVE_LAYERS", "4")),
+        d_ff=int(os.environ.get("DMP_BENCH_SERVE_DFF", "2048")),
+        max_seq_len=p_hi + g_hi, pos_embedding="rope",
+        dtype=jnp.bfloat16)
+    t = 0.0
+    trace = []
+    for i in range(n_reqs):
+        t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.0
+        trace.append(dict(
+            arrival_s=t,
+            prompt=[int(x) for x in rng.integers(0, cfg.vocab_size,
+                                                 rng.integers(p_lo,
+                                                              p_hi + 1))],
+            max_new_tokens=draw_gen(),
+            seed=i))
+    return trace, cfg
+
+
+def bench_serve() -> None:
+    """Continuous-batching serving bench (``DMP_BENCH_WORKLOAD=serve``).
+
+    Replays one seeded open-loop Poisson trace through the serving
+    engine twice — continuous (iteration-level join/evict) and the
+    static-batch baseline (admission only when the whole batch drained)
+    — and reports tokens/s/chip, p50/p99 TTFT and per-token latency,
+    page-pool occupancy and the continuous-vs-static speedup. The
+    acceptance bar this bench exists to measure: continuous >= 1.5x
+    static tokens/s/chip at no worse p99 TTFT on the same trace.
+
+    Env knobs: DMP_BENCH_SERVE_{REQS,RATE,SEED,PROMPT,GEN,SLOTS,PAGE,
+    VOCAB,DMODEL,LAYERS,DFF} (see build_serve_trace).
+    """
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import Engine, ServeConfig
+
+    trace, cfg = build_serve_trace()
+    n_chips = len(jax.devices())
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots = int(os.environ.get("DMP_BENCH_SERVE_SLOTS", "8"))
+    page = int(os.environ.get("DMP_BENCH_SERVE_PAGE", "16"))
+    pages_per_seq = -(-cfg.max_seq_len // page)
+    telemetry = _telemetry_run("serve", dict(
+        n_requests=len(trace), n_slots=n_slots, page_size=page,
+        d_model=cfg.d_model, n_layers=cfg.n_layers))
+
+    def make_config(policy: str) -> ServeConfig:
+        return ServeConfig(
+            n_slots=n_slots, page_size=page,
+            # Pool sized for a full batch of worst-case requests plus one
+            # waiting admission: slots are the backpressure point, the
+            # pool the safety margin (occupancy reported either way).
+            n_pages=(n_slots + 1) * pages_per_seq,
+            max_seq_len=cfg.max_seq_len,
+            prefill_chunk=int(os.environ.get(
+                "DMP_BENCH_SERVE_CHUNK", "32")),
+            policy=policy)
+
+    # Warmup: the step builders are memoized per geometry, so one tiny
+    # engine run compiles the prefill + decode programs both timed runs
+    # (continuous AND static — policy is host-side) then share; compile
+    # is excluded from both walls, like every other bench here.
+    warm = Engine(params, cfg, make_config("continuous"),
+                  slo_metrics=False)   # keep warmup out of the registry
+    warm.submit(trace[0]["prompt"], 2, seed=0)
+    warm.run()
+    _log("serve: programs warmed (compile excluded from timed runs)")
+
+    def run(policy: str) -> dict:
+        engine = Engine(params, cfg, make_config(policy),
+                        telemetry=telemetry)
+        for r in trace:
+            engine.submit(r["prompt"], r["max_new_tokens"],
+                          arrival_s=r["arrival_s"], seed=r["seed"])
+        summary = engine.run()
+        _log(f"serve[{policy}]: {summary['tokens_generated']} tokens in "
+             f"{summary['wall_s']:.1f}s "
+             f"({summary['tokens_per_s'] or 0:.1f} tok/s, "
+             f"slot util {summary['slot_utilization']:.2f})")
+        return summary
+
+    cont = run("continuous")
+    static = run("static")
+    tok_s = (cont["tokens_per_s"] or 0.0) / n_chips
+    static_tok_s = (static["tokens_per_s"] or 0.0) / n_chips
+    out = {
+        "metric": f"lm_serve_bs{n_slots}_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,   # the reference has no serving path at all
+        "mfu": None,
+        "static_tokens_per_s_per_chip": round(static_tok_s, 1),
+        "speedup_vs_static": (round(tok_s / static_tok_s, 3)
+                              if static_tok_s else None),
+        "ttft_p50_s": round(cont["ttft_s"].get("p50", 0), 4),
+        "ttft_p99_s": round(cont["ttft_s"].get("p99", 0), 4),
+        "static_ttft_p99_s": round(static["ttft_s"].get("p99", 0), 4),
+        "token_latency_p50_s": round(
+            cont["token_latency_s"].get("p50", 0), 5),
+        "token_latency_p99_s": round(
+            cont["token_latency_s"].get("p99", 0), 5),
+        "queue_wait_p99_s": round(cont["queue_wait_s"].get("p99", 0), 4),
+        "slot_utilization": round(cont["slot_utilization"], 3),
+        "static_slot_utilization": round(static["slot_utilization"], 3),
+        "page_occupancy_mean": round(
+            cont["page_occupancy"].get("mean", 0), 3),
+        "page_occupancy_max": round(
+            cont["page_occupancy"].get("max", 0), 3),
+        "requests": len(trace),
+        "requests_completed": cont["requests_completed"],
+    }
     telemetry.memory()
     telemetry.record("bench", **out)
     telemetry.finish()
@@ -555,6 +790,9 @@ def _run_workload() -> None:
         return
     if os.environ.get("DMP_BENCH_WORKLOAD") == "decode":
         bench_decode()
+        return
+    if os.environ.get("DMP_BENCH_WORKLOAD") == "serve":
+        bench_serve()
         return
 
     n_chips = len(jax.devices())

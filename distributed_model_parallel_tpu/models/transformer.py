@@ -217,19 +217,26 @@ def apply_rope(x: jax.Array, positions: jax.Array,
                theta: float = 10000.0) -> jax.Array:
     """Rotary position embedding (GPT-NeoX half-split convention).
 
-    x: [B, T, H, Dh] (Dh even), positions: [T] absolute token positions.
-    Rotates each (x[..., i], x[..., i + Dh/2]) pair by position * theta^(-2i/Dh);
-    q·k then depends only on relative position, which is what makes the
-    per-shard global offsets under sequence parallelism (and the per-step
-    offsets in cached decoding) compose exactly with full attention.
+    x: [B, T, H, Dh] (Dh even), positions: [T] absolute token positions
+    shared across the batch, or [B, T] per-row positions (the serving
+    engine's continuous decode batch, where every row sits at its own
+    offset). Rotates each (x[..., i], x[..., i + Dh/2]) pair by
+    position * theta^(-2i/Dh); q·k then depends only on relative
+    position, which is what makes the per-shard global offsets under
+    sequence parallelism (and the per-step offsets in cached decoding)
+    compose exactly with full attention.
     """
     dh = x.shape[-1]
     if dh % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {dh}")
     inv_freq = theta ** (-jnp.arange(0, dh, 2, dtype=jnp.float32) / dh)
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None]  # [T, Dh/2]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    # [T, Dh/2] (shared) or [B, T, Dh/2] (per-row); the trailing [T, 1, F]
+    # broadcast shape is the same either way.
+    ang = positions.astype(jnp.float32)[..., :, None] * inv_freq
+    cos = jnp.cos(ang)[..., :, None, :]
+    sin = jnp.sin(ang)[..., :, None, :]
+    if positions.ndim == 1:
+        cos, sin = cos[None], sin[None]
     x1, x2 = x[..., :dh // 2], x[..., dh // 2:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
@@ -605,6 +612,40 @@ def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(keep, logits, -jnp.inf)
 
 
+def validate_sampling(cfg: TransformerConfig, temperature: float,
+                      top_k: int | None, top_p: float | None) -> None:
+    """The one set of sampling-knob rules ``generate`` and the serving
+    engine (serve/engine.py) both enforce."""
+    if (top_k is not None or top_p is not None) and temperature <= 0:
+        raise ValueError("top_k/top_p filter the sampling distribution; "
+                         "set temperature > 0 (greedy ignores them)")
+    if top_k is not None and not (1 <= top_k <= cfg.vocab_size):
+        raise ValueError(f"top_k must be in [1, {cfg.vocab_size}], got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def make_sampler(cfg: TransformerConfig, temperature: float,
+                 top_k: int | None, top_p: float | None):
+    """``sample(logits [B, V], key) -> [B] int32``: greedy argmax at
+    temperature 0, else temperature/top-k/nucleus sampling — the single
+    token-selection definition ``generate`` and the serving engine share
+    (one ``key`` drives the whole batch; per-row-keyed callers vmap it)."""
+    validate_sampling(cfg, temperature, top_k, top_p)
+
+    def sample(logits, sub):
+        if temperature > 0:
+            logits = logits / temperature
+            if top_k is not None:
+                logits = _filter_top_k(logits, top_k)
+            if top_p is not None:
+                logits = _filter_top_p(logits, top_p)
+            return jax.random.categorical(sub, logits).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return sample
+
+
 def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
              steps: int, *, rng: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
@@ -641,13 +682,6 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
     if total > cfg.max_seq_len:
         raise ValueError(f"prompt + steps = {total} exceeds max_seq_len "
                          f"{cfg.max_seq_len}")
-    if (top_k is not None or top_p is not None) and temperature <= 0:
-        raise ValueError("top_k/top_p filter the sampling distribution; "
-                         "set temperature > 0 (greedy ignores them)")
-    if top_k is not None and not (1 <= top_k <= cfg.vocab_size):
-        raise ValueError(f"top_k must be in [1, {cfg.vocab_size}], got {top_k}")
-    if top_p is not None and not (0.0 < top_p <= 1.0):
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if prefill_chunk is not None:
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
@@ -657,16 +691,7 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jax.Array,
                              f"prefill_chunk={prefill_chunk}")
     if rng is None:
         rng = jax.random.key(0)
-
-    def sample(logits, sub):
-        if temperature > 0:
-            logits = logits / temperature
-            if top_k is not None:
-                logits = _filter_top_k(logits, top_k)
-            if top_p is not None:
-                logits = _filter_top_p(logits, top_p)
-            return jax.random.categorical(sub, logits).astype(jnp.int32)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sample = make_sampler(cfg, temperature, top_k, top_p)
 
     rng, sub = jax.random.split(rng)
     if prefill_chunk is not None:

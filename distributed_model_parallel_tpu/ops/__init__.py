@@ -6,6 +6,9 @@
   (the DDP Reducer's wire algorithm) over neighbor ppermutes
 * ``ring_attention`` — ring + Ulysses sequence-parallel attention
 * ``pallas_attention`` — on-chip blockwise flash attention kernel
+* ``paged_attention`` — the serving engine's paged-KV-cache read: shared
+  attend math, XLA gather fallback, Pallas paged-decode kernel with
+  scalar-prefetched page tables (serve/, docs/SERVING.md)
 * ``sparse`` — COO embedding gradients + DDP-style sparse allreduce
 * ``moe`` — top-1 routed mixture-of-experts with expert-parallel all_to_all
 """

@@ -1,0 +1,231 @@
+"""Paged decode attention — the KV-cache read path of the serving engine.
+
+The dense decode cache (``models/transformer._cached_block``) is one
+``[L, B, T_max, Hkv, Dh]`` buffer padded to the longest sequence the batch
+will ever reach: a sequence that finished early keeps its whole slab until
+the batch drains, and the batch width is frozen at prefill. The serving
+engine (``serve/``) replaces it with a vLLM-style **paged** cache: a pool
+of fixed-size pages ``[n_pages, page_size, Hkv, Dh]`` per layer plus a
+per-sequence page table, so a sequence holds exactly
+``ceil(len / page_size)`` pages and returns them the moment it finishes.
+
+This module is the attention read over that pool. Three tiers, one math:
+
+* :func:`attend_rows` — the single softmax/score definition every path
+  shares (mirrors ``_cached_block``'s grouped-head scores + ``band_keep``
+  masking), so paged and dense decoding cannot diverge numerically;
+* :func:`paged_attention_xla` — gather the table's pages into a
+  contiguous ``[B, T, Hkv, Dh]`` view and run :func:`attend_rows`; works
+  on every backend (the off-TPU fallback and the prefill path);
+* :func:`paged_attention_kernel` — the Pallas TPU kernel: the page table
+  rides in scalar-prefetch SMEM and feeds the K/V block index maps, so
+  pages stream HBM→VMEM directly (``pl.when`` skips the DMA + copy for
+  logical pages past the sequence's length — the block-quantized-read
+  idiom from ``generate()``'s read-boundary segments, at page
+  granularity) and the gathered ``[B, T, ...]`` intermediate never
+  exists in HBM. The final grid step runs the *same* :func:`attend_rows`
+  on the VMEM-resident pages, which is what makes the kernel bitwise
+  against the XLA path in interpreter mode (the parity contract
+  tests/test_paged_attention.py pins).
+
+Masking is sanitizing, not just causal: positions past a row's length are
+zeroed in K/V *and* banded out of the scores, so stale page contents
+(freed pages are reused without clearing) contribute exact ``0.0`` to
+every reduction — a row's values depend only on its own written tokens,
+never on who held the page before. That invariant is what makes
+continuous batching per-request deterministic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_model_parallel_tpu.ops.pallas_attention import band_keep
+
+
+def attend_rows(q: jax.Array, kr: jax.Array, vr: jax.Array,
+                positions: jax.Array, lengths: jax.Array,
+                window: int | None = None) -> jax.Array:
+    """Grouped-head cached attention over per-row contiguous K/V.
+
+    q: [B, C, H, Dh] queries (C contiguous tokens per row); kr/vr:
+    [B, T, Hkv, Dh]; positions: [B, C] absolute token positions;
+    lengths: [B] valid K prefix per row (everything at k_pos >= length is
+    zeroed before any reduction — see module docstring). Returns
+    [B, C, H, Dh].
+
+    The score/softmax expression is ``_cached_block``'s exactly (query
+    head h attends kv head h // G; same ``band_keep`` predicate), so the
+    paged paths stay numerically on the dense path's definition.
+    """
+    b, c, h, dh = q.shape
+    t, hkv = kr.shape[1], kr.shape[2]
+    valid = jnp.arange(t)[None, :] < lengths[:, None]            # [B, T]
+    kr = jnp.where(valid[:, :, None, None], kr, 0)
+    vr = jnp.where(valid[:, :, None, None], vr, 0)
+    qg = q.reshape(b, c, hkv, h // hkv, dh)
+    # Scores and softmax accumulate in f32 regardless of the cache dtype
+    # (preferred_element_type): bf16-accumulated dots are not bitwise
+    # stable across lowerings (XLA gather path vs pallas interpret), and
+    # pinning the accumulator is also just better serving numerics.
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kr,
+                   preferred_element_type=jnp.float32) * (dh ** -0.5)
+    keep = band_keep(positions[:, :, None],
+                     jnp.arange(t)[None, None, :], window)       # [B, C, T]
+    keep = jnp.logical_and(keep, valid[:, None, :])
+    s = jnp.where(keep[:, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p,
+                   vr.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o.reshape(b, c, h, dh).astype(q.dtype)
+
+
+def paged_attention_xla(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                        tables: jax.Array, positions: jax.Array,
+                        lengths: jax.Array,
+                        window: int | None = None) -> jax.Array:
+    """Pure-XLA paged attention: gather then :func:`attend_rows`.
+
+    q: [B, C, H, Dh]; k_pool/v_pool: [P, page, Hkv, Dh] (ONE layer's
+    slab); tables: [B, N] physical page ids (rows padded with any
+    in-range id — padded pages are masked by ``lengths``); positions:
+    [B, C]; lengths: [B]. Materializes the gathered [B, N*page, Hkv, Dh]
+    view in HBM — fine off-TPU and for prefill chunks; the decode hot
+    loop on TPU wants :func:`paged_attention_kernel`.
+    """
+    b, n = tables.shape
+    page = k_pool.shape[1]
+    kr = k_pool[tables].reshape(b, n * page, *k_pool.shape[2:])
+    vr = v_pool[tables].reshape(b, n * page, *v_pool.shape[2:])
+    return attend_rows(q, kr, vr, positions, lengths, window)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (decode: one query token per row)
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         k_scr, v_scr, *, page: int, n_pages: int,
+                         hkv: int, dh: int, window: int | None):
+    """Grid: (B, n_pages). Scalar prefetch: tables [B, N], pos [B]. Each
+    minor step DMAs one of the row's pages (the index map reads the page
+    table; out-of-range steps re-map to the last used page so Mosaic
+    elides the repeat DMA) and copies it into the contiguous VMEM
+    scratch; ``pl.when`` skips the copy for logical pages past the row's
+    length, so a short sequence reads only its own pages. The last step
+    runs the shared :func:`attend_rows` on the assembled [T, Hkv, Dh]
+    scratch — same ops as the XLA path, which is the bitwise-parity
+    contract (interpreter). The dense-softmax-in-VMEM final step bounds
+    T at VMEM capacity (serving contexts; a multi-kilobyte-page online-
+    softmax variant is the long-context extension point).
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[b]
+
+    @pl.when(j <= pos // page)
+    def _copy():
+        k_scr[pl.dslice(j * page, page), :] = k_ref[0].reshape(
+            page, hkv * dh)
+        v_scr[pl.dslice(j * page, page), :] = v_ref[0].reshape(
+            page, hkv * dh)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        t = n_pages * page
+        q = q_ref[...][None]                           # [1, 1, H, Dh]
+        kr = k_scr[...].reshape(1, t, hkv, dh)
+        vr = v_scr[...].reshape(1, t, hkv, dh)
+        # lengths zeroes everything past pos (including scratch rows no
+        # copy step ever wrote — uninitialized VMEM must not reach a
+        # reduction even multiplied by an exact-zero weight).
+        o = attend_rows(q, kr, vr, pos[None, None], pos[None] + 1, window)
+        o_ref[...] = o[0].astype(o_ref.dtype)
+
+
+def paged_attention_kernel(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, tables: jax.Array,
+                           positions: jax.Array,
+                           window: int | None = None,
+                           interpret: bool | None = None) -> jax.Array:
+    """Pallas paged decode attention. q: [B, 1, H, Dh] (decode is one
+    token per row); pools [P, page, Hkv, Dh]; tables [B, N]; positions
+    [B] (the query token's absolute position; the row attends positions
+    [0, pos], band-clamped under ``window``). Returns [B, 1, H, Dh].
+
+    ``interpret=None`` auto-selects interpret mode off-TPU (tests run the
+    kernel on CPU; the engine only dispatches it on real TPUs).
+    """
+    if q.shape[1] != 1:
+        raise ValueError(f"the paged decode kernel takes one query token "
+                         f"per row, got C={q.shape[1]} (prefill chunks go "
+                         f"through paged_attention_xla)")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, _, h, dh = q.shape
+    n_total, page, hkv, _ = k_pool.shape
+    n = tables.shape[1]
+    t = n * page
+
+    def page_map(bi, j, tables_ref, pos_ref):
+        # Clamp to the row's last used page: out-of-band steps re-fetch
+        # an already-resident block (DMA elided) and pl.when skips them.
+        last = pos_ref[bi] // page
+        return (tables_ref[bi, jnp.minimum(j, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n),
+        in_specs=[
+            pl.BlockSpec((1, h, dh), lambda bi, j, tr, pr: (bi, 0, 0)),
+            pl.BlockSpec((1, page, hkv, dh), page_map),
+            pl.BlockSpec((1, page, hkv, dh), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, h, dh), lambda bi, j, tr, pr: (bi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t, hkv * dh), k_pool.dtype),
+            pltpu.VMEM((t, hkv * dh), v_pool.dtype),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, n_pages=n, hkv=hkv, dh=dh,
+        window=window)
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), positions.astype(jnp.int32),
+      q[:, 0], k_pool, v_pool)
+    return out[:, None]
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    tables: jax.Array, positions: jax.Array,
+                    lengths: jax.Array, window: int | None = None,
+                    impl: str = "auto") -> jax.Array:
+    """Dispatch: the Pallas kernel for single-token decode on TPU, the
+    XLA gather path everywhere else. ``impl``: "auto" | "xla" |
+    "pallas". The kernel is decode-only (C == 1); multi-token prefill
+    chunks take the gather path under EVERY impl — "pallas" forces the
+    kernel for the decode steps (interpret mode off-TPU), it does not
+    turn prefill into a kernel call."""
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown paged-attention impl {impl!r}; "
+                         f"known: auto, xla, pallas")
+    use_kernel = q.shape[1] == 1 and (
+        impl == "pallas"
+        or (impl == "auto" and jax.devices()[0].platform == "tpu"))
+    if use_kernel:
+        # Decode semantics: the one query token is the newest written
+        # position, so the valid prefix is exactly positions + 1 — the
+        # kernel derives lengths itself.
+        return paged_attention_kernel(q, k_pool, v_pool, tables,
+                                      positions[:, 0], window=window)
+    return paged_attention_xla(q, k_pool, v_pool, tables, positions,
+                               lengths, window=window)

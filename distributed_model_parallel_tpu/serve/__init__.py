@@ -1,0 +1,35 @@
+"""Continuous-batching decode service: paged KV cache + inflight scheduler.
+
+The training side of this repo already had the decode kernels
+(``ops/pallas_attention.py``, ``models/transformer.generate``); this
+package turns them into a serving engine:
+
+* :mod:`serve.paged_kv` — the device-resident page pool and host-side
+  page tables (vLLM-style paged KV cache);
+* :mod:`serve.model` — the paged prefill/decode forward over
+  ``models/transformer`` params (one jitted program each, any prompt
+  length — the compile-cache story);
+* :mod:`serve.scheduler` — request queue + iteration-level
+  (continuous/Orca-style) batching: admission by free pages, mid-batch
+  join/evict, chunked prefill interleaved with decode;
+* :mod:`serve.engine` — the loop wiring them together, with per-request
+  SLO accounting (TTFT, per-token latency, queue wait) in the telemetry
+  registry and typed ``serve`` records.
+
+See docs/SERVING.md for the anatomy and the BENCH_serve recipe.
+"""
+
+from distributed_model_parallel_tpu.serve.engine import (  # noqa: F401
+    Engine,
+    EngineKilled,
+    ServeConfig,
+)
+from distributed_model_parallel_tpu.serve.paged_kv import (  # noqa: F401
+    PagedKVCache,
+    PagePool,
+    PagePoolError,
+)
+from distributed_model_parallel_tpu.serve.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+)

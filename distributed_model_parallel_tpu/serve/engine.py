@@ -1,0 +1,395 @@
+"""The serving engine loop: continuous batching over the paged KV cache.
+
+One iteration = admit → prefill (a bounded number of chunks, interleaved
+so long prompts never stall the resident batch) → one decode step for
+every active slot → evict finished sequences (their slot and pages are
+reusable the very next iteration). The decode step runs at a fixed slot
+width with idle rows masked, so a request's tokens are a pure function of
+its own (prompt, seed) — joining a busy batch mid-flight decodes exactly
+what a solo run would (tests/test_serve.py pins this).
+
+SLO accounting: per-request TTFT, queue wait and per-token latency land
+in the process metrics registry (``serve_ttft_s`` / ``serve_queue_wait_s``
+/ ``serve_token_latency_s`` histograms, ``serve_page_occupancy`` gauge)
+and as typed ``serve`` telemetry records the report renders
+(docs/OBSERVABILITY.md). A killed engine never drops requests silently:
+every in-flight and queued request is marked failed with a typed error
+and a ``serve`` record before the exception propagates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_model_parallel_tpu.models.transformer import (
+    TransformerConfig,
+    validate_sampling,
+)
+from distributed_model_parallel_tpu.serve.model import (
+    make_decode_step,
+    make_prefill_step,
+)
+from distributed_model_parallel_tpu.serve.paged_kv import PagedKVCache
+from distributed_model_parallel_tpu.serve.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+    summarize,
+)
+from distributed_model_parallel_tpu.utils.telemetry import registry
+
+
+class EngineKilled(RuntimeError):
+    """The engine loop died mid-stream; every in-flight request has been
+    marked failed (typed) before this propagated."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine geometry + sampling policy (per-engine, compiled in).
+
+    ``n_pages`` is the pool capacity — the admission backpressure point;
+    ``max_seq_len`` bounds any single request (prompt + generation) and
+    sets the static per-sequence page-table width; ``prefill_chunk`` is
+    the one compiled prompt-chunk size (any prompt length = some number
+    of chunks, so repeated CLI calls hit the compile cache).
+    """
+
+    n_slots: int = 8
+    page_size: int = 16
+    n_pages: int = 256
+    max_seq_len: int = 512
+    prefill_chunk: int = 32
+    prefill_chunks_per_iter: int = 1
+    policy: str = "continuous"       # "continuous" | "static" (baseline)
+    attn_impl: str = "auto"          # paged-attention impl (ops/)
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    eos_id: int | None = None
+
+
+class Engine:
+    """Continuous-batching decode engine over one replicated model.
+
+    ``step_hook(iteration)`` (tests, chaos drills) runs once per loop
+    iteration; an exception it raises takes the typed-failure path like
+    any other engine death.
+    """
+
+    def __init__(self, params: dict, cfg: TransformerConfig,
+                 serve: ServeConfig, *, telemetry=None, step_hook=None,
+                 slo_metrics: bool = True):
+        if cfg.moe_experts:
+            raise ValueError(
+                "MoE decode routing is batch-coupled (expert-capacity "
+                "drops depend on co-resident tokens), which breaks "
+                "continuous batching's per-request determinism; decode "
+                "MoE models via models.transformer.generate")
+        if cfg.tp_axis is not None or cfg.sp_axis is not None:
+            raise ValueError("the serving engine runs replicated; build "
+                             "it with tp_axis=None/sp_axis=None (sharded "
+                             "decode stays on generate_sharded)")
+        if serve.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"serve max_seq_len {serve.max_seq_len} exceeds the "
+                f"model's max_seq_len {cfg.max_seq_len}")
+        if serve.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{serve.prefill_chunk}")
+        validate_sampling(cfg, serve.temperature, serve.top_k, serve.top_p)
+        self.params = params
+        self.cfg = cfg
+        self.serve = serve
+        self.telemetry = telemetry
+        self.step_hook = step_hook
+        # slo_metrics=False keeps this engine out of the process-wide
+        # registry (serve_* counters/histograms/gauge) — warmup/probe
+        # engines must not pollute the samples a telemetry stream's
+        # metrics record snapshots for the real runs.
+        self._slo_metrics = slo_metrics
+        self.cache = PagedKVCache(cfg, n_pages=serve.n_pages,
+                                  page_size=serve.page_size,
+                                  max_seq_len=serve.max_seq_len)
+        self.sched = Scheduler(self.cache, serve.n_slots,
+                               policy=serve.policy,
+                               prefill_chunks_per_iter=(
+                                   serve.prefill_chunks_per_iter))
+        self._sampled = serve.temperature > 0
+        kw = dict(page_size=serve.page_size, n_pages=serve.n_pages,
+                  impl=serve.attn_impl, temperature=serve.temperature,
+                  top_k=serve.top_k, top_p=serve.top_p)
+        self._prefill = make_prefill_step(cfg, chunk=serve.prefill_chunk,
+                                          **kw)
+        self._decode = make_decode_step(cfg, **kw)
+        self._requests: list[Request] = []
+        # Per-slot page tables, maintained incrementally: reservation ==
+        # allocation, so a request's table is final at admission — one
+        # host write per join, not a rebuild per decode step.
+        self._tables_np = np.zeros(
+            (serve.n_slots, self.cache.pages_per_seq), np.int32)
+        self._auto_rid = 0
+        self._iterations = 0
+        self._decode_steps = 0
+        self._decode_tokens = 0       # useful tokens out of decode steps
+        self._occupancy: list[float] = []
+        self._wall_s = 0.0
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, rid: str | None = None,
+               arrival_s: float = 0.0, seed: int = 0) -> Request:
+        prompt = [int(t) for t in prompt]
+        bad = [t for t in prompt if not (0 <= t < self.cfg.vocab_size)]
+        if bad:
+            raise ValueError(f"prompt tokens {bad} outside vocab "
+                             f"[0, {self.cfg.vocab_size})")
+        if rid is None:
+            rid = f"req-{self._auto_rid}"
+            self._auto_rid += 1
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      arrival_s=float(arrival_s), seed=int(seed))
+        self.sched.submit(req)
+        self._requests.append(req)
+        return req
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self, *, max_iterations: int | None = None) -> dict:
+        """Drive the loop until every submitted request is terminal (or
+        ``max_iterations``). Returns the summary dict (also emitted as
+        the ``serve`` summary telemetry record)."""
+        t0 = time.monotonic()
+        try:
+            while not self.sched.idle():
+                if (max_iterations is not None
+                        and self._iterations >= max_iterations):
+                    break
+                now = time.monotonic() - t0
+                if self.step_hook is not None:
+                    self.step_hook(self._iterations)
+                self._iterations += 1
+                made_progress = self._iterate(now, t0)
+                if not made_progress:
+                    nxt = self.sched.next_arrival()
+                    if nxt is not None:
+                        # Open loop: nothing resident, next request not
+                        # arrived yet — sleep to its arrival.
+                        time.sleep(max(0.0, min(nxt - now, 0.05)))
+        except BaseException as e:
+            self._fail_inflight(f"{type(e).__name__}: {e}")
+            self._wall_s = time.monotonic() - t0
+            if self.telemetry is not None:
+                self.telemetry.failure(
+                    "engine-killed", detail=f"{type(e).__name__}: {e}",
+                    iteration=self._iterations)
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit keep their semantics —
+                # the typed-failure bookkeeping above still ran.
+                raise
+            raise EngineKilled(
+                f"engine died at iteration {self._iterations}; "
+                f"in-flight requests marked failed") from e
+        self._wall_s = time.monotonic() - t0
+        return self.summary()
+
+    def _iterate(self, now: float, t0: float) -> bool:
+        progress = False
+        for req in self.sched.admit(now):
+            self._tables_np[req.slot] = self.cache.table_array(req.rid)
+            self._record_queue_wait(req)
+        for req in self.sched.prefilling():
+            self._prefill_chunk(req, t0)
+            progress = True
+        decoding = self.sched.decoding()
+        if decoding:
+            self._decode_round(decoding, t0)
+            progress = True
+        occ = self.cache.occupancy
+        self._occupancy.append(occ)
+        if self._slo_metrics:
+            registry().gauge("serve_page_occupancy").set(occ)
+        return progress
+
+    # -- prefill ------------------------------------------------------------
+
+    def _prefill_chunk(self, req: Request, t0: float) -> None:
+        chunk = self.serve.prefill_chunk
+        lo = req.prefill_cursor
+        n_valid = min(chunk, req.prompt_len - lo)
+        toks = np.zeros((1, chunk), np.int32)
+        toks[0, :n_valid] = req.prompt[lo:lo + n_valid]
+        table = jnp.asarray(self._tables_np[req.slot])
+        key = jax.random.key(req.seed)
+        self.cache.ck, self.cache.cv, tok = self._prefill(
+            self.params, self.cache.ck, self.cache.cv, jnp.asarray(toks),
+            jnp.int32(lo), jnp.int32(n_valid), table, key)
+        req.prefill_cursor = lo + n_valid
+        if req.prefill_cursor >= req.prompt_len:
+            # Final chunk: its sampled token is the request's first
+            # generated token (position t0) — TTFT stops here.
+            first = int(jax.device_get(tok)[0])
+            req.generated.append(first)
+            req.t_first_token = time.monotonic() - t0
+            req.state = RequestState.DECODE
+            self._record_ttft(req)
+            if self._finished(req, first):
+                self._complete(req, t0)
+
+    # -- decode -------------------------------------------------------------
+
+    def _decode_round(self, decoding: list[Request], t0: float) -> None:
+        b = self.serve.n_slots
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        seeds = np.zeros((b,), np.uint32)
+        for req in decoding:
+            s = req.slot
+            tokens[s] = req.generated[-1]
+            positions[s] = req.prompt_len + len(req.generated) - 1
+            active[s] = True
+            seeds[s] = req.seed
+        keys = (jax.vmap(jax.random.key)(jnp.asarray(seeds))
+                if self._sampled else None)
+        self.cache.ck, self.cache.cv, nxt = self._decode(
+            self.params, self.cache.ck, self.cache.cv,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(self._tables_np), jnp.asarray(active), keys)
+        nxt = np.asarray(jax.device_get(nxt))
+        self._decode_steps += 1
+        self._decode_tokens += len(decoding)
+        for req in decoding:
+            tok = int(nxt[req.slot])
+            req.generated.append(tok)
+            if self._finished(req, tok):
+                self._complete(req, t0)
+
+    def _finished(self, req: Request, tok: int) -> bool:
+        return (len(req.generated) >= req.max_new_tokens
+                or (self.serve.eos_id is not None
+                    and tok == self.serve.eos_id))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _complete(self, req: Request, t0: float) -> None:
+        req.t_done = time.monotonic() - t0
+        req.state = RequestState.COMPLETED
+        self.sched.evict(req)
+        token_s = None
+        if len(req.generated) > 1 and req.t_first_token is not None:
+            token_s = ((req.t_done - req.t_first_token)
+                       / (len(req.generated) - 1))
+        if self._slo_metrics:
+            reg = registry()
+            reg.counter("serve_requests_completed").inc()
+            reg.counter("serve_tokens_generated").inc(len(req.generated))
+            if token_s is not None:
+                reg.histogram("serve_token_latency_s").observe(token_s)
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "serve", event="completed", request=req.rid,
+                policy=self.serve.policy,
+                prompt_tokens=req.prompt_len,
+                new_tokens=len(req.generated),
+                queue_wait_s=self._queue_wait(req),
+                ttft_s=self._ttft(req), token_latency_s=token_s,
+                wall_s=req.t_done - req.arrival_s)
+
+    def _fail_inflight(self, detail: str) -> None:
+        for req in self._requests:
+            if req.done:
+                continue
+            if req.slot is not None:
+                self.sched.evict(req)
+            elif any(q is req for q in self.sched.queue):
+                self.sched.queue = deque(
+                    q for q in self.sched.queue if q is not req)
+            req.state = RequestState.FAILED
+            req.error = f"engine-killed: {detail}"
+            if self._slo_metrics:
+                registry().counter("serve_requests_failed").inc()
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "serve", event="failed", request=req.rid,
+                    policy=self.serve.policy,
+                    error="engine-killed", detail=detail,
+                    prompt_tokens=req.prompt_len,
+                    new_tokens=len(req.generated))
+
+    # -- SLO bookkeeping ----------------------------------------------------
+
+    def _queue_wait(self, req: Request) -> float | None:
+        if req.t_admitted is None:
+            return None
+        return max(0.0, req.t_admitted - req.arrival_s)
+
+    def _ttft(self, req: Request) -> float | None:
+        if req.t_first_token is None:
+            return None
+        return max(0.0, req.t_first_token - req.arrival_s)
+
+    def _record_queue_wait(self, req: Request) -> None:
+        w = self._queue_wait(req)
+        if w is not None and self._slo_metrics:
+            registry().histogram("serve_queue_wait_s").observe(w)
+
+    def _record_ttft(self, req: Request) -> None:
+        t = self._ttft(req)
+        if t is not None and self._slo_metrics:
+            registry().histogram("serve_ttft_s").observe(t)
+
+    # -- results ------------------------------------------------------------
+
+    def results(self) -> list[Request]:
+        return list(self._requests)
+
+    def summary(self) -> dict:
+        """Aggregate SLO + throughput view (and the ``serve`` summary
+        record when a telemetry stream is attached)."""
+        completed = [r for r in self._requests
+                     if r.state is RequestState.COMPLETED]
+        failed = [r for r in self._requests
+                  if r.state is RequestState.FAILED]
+        tokens = sum(len(r.generated) for r in completed)
+        token_lat = [
+            (r.t_done - r.t_first_token) / (len(r.generated) - 1)
+            for r in completed
+            if len(r.generated) > 1 and r.t_first_token is not None]
+        out = {
+            "policy": self.serve.policy,
+            "n_slots": self.serve.n_slots,
+            "requests_completed": len(completed),
+            "requests_failed": len(failed),
+            "tokens_generated": tokens,
+            "wall_s": self._wall_s,
+            "tokens_per_s": (tokens / self._wall_s if self._wall_s > 0
+                             else None),
+            "iterations": self._iterations,
+            "decode_steps": self._decode_steps,
+            # Slot efficiency: useful tokens per decode step over the
+            # batch width — the deterministic (timing-free) continuous-
+            # vs-static comparison the tests gate on.
+            "slot_utilization": (
+                self._decode_tokens
+                / (self._decode_steps * self.serve.n_slots)
+                if self._decode_steps else None),
+            "ttft_s": summarize(
+                [t for t in (self._ttft(r) for r in completed)
+                 if t is not None]),
+            "queue_wait_s": summarize(
+                [w for w in (self._queue_wait(r) for r in completed)
+                 if w is not None]),
+            "token_latency_s": summarize(token_lat),
+            "page_occupancy": summarize(self._occupancy),
+        }
+        if self.telemetry is not None:
+            self.telemetry.record("serve", event="summary", **out)
+        return out

@@ -1,0 +1,141 @@
+"""Paged KV cache: a device page pool + host-side page tables.
+
+The dense decode cache pads every sequence to the batch maximum and holds
+the slab until the whole batch drains. Here the cache is a pool of
+fixed-size pages — ``[L, n_pages, page_size, Hkv, Dh]`` per K and V on
+device — and each sequence owns exactly ``ceil(len / page_size)`` pages,
+recorded in a host-side page table. Pages return to the free list the
+moment a sequence finishes, so memory capacity (and therefore admission)
+is decoupled from both batch width and the longest co-resident sequence.
+
+Allocation is deterministic (FIFO free list): the same submit/finish
+order always produces the same physical placement, which keeps engine
+runs — and their telemetry — reproducible. Pages are **not** cleared on
+free: the attention read path masks by sequence length with exact zeros
+(ops/paged_attention.attend_rows), so stale contents are unreachable by
+construction rather than by memset.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagePoolError(RuntimeError):
+    """A page-accounting invariant was violated (double alloc/free) or an
+    allocation exceeded capacity that admission should have checked."""
+
+
+class PagePool:
+    """Host-side allocator over ``n_pages`` physical page ids.
+
+    FIFO free list: deterministic placement for a deterministic op
+    sequence. ``alloc`` raises :class:`PagePoolError` rather than
+    over-committing — the scheduler checks ``free_pages`` before
+    admitting, so a raise here is a scheduler bug, not backpressure.
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError(f"pool needs >= 1 page, got {n_pages}")
+        self.n_pages = n_pages
+        self._free: deque[int] = deque(range(n_pages))
+        self._used: set[int] = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._used)
+
+    def alloc(self, n: int) -> list[int]:
+        if n < 0:
+            raise ValueError(f"alloc count must be >= 0, got {n}")
+        if n > len(self._free):
+            raise PagePoolError(
+                f"allocation of {n} pages exceeds the {len(self._free)} "
+                f"free (of {self.n_pages}); admission must queue, not "
+                f"over-commit")
+        pages = [self._free.popleft() for _ in range(n)]
+        self._used.update(pages)
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p not in self._used:
+                raise PagePoolError(
+                    f"freeing page {p} that is not allocated (double "
+                    f"free, or a page the pool never handed out)")
+            self._used.remove(p)
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """Device page pools + per-sequence page tables for one model.
+
+    ``ck``/``cv``: [L, n_pages, page_size, Hkv, Dh] device arrays the
+    engine threads through its jitted steps (donated, so XLA updates
+    them in place). The page table of sequence ``sid`` maps logical page
+    ``i`` (tokens [i*page, (i+1)*page)) to a physical pool page;
+    :meth:`table_array` pads it to the static per-sequence maximum with
+    id 0 — padded entries are masked by length in the attention read, so
+    any in-range id is safe.
+    """
+
+    def __init__(self, cfg, *, n_pages: int, page_size: int,
+                 max_seq_len: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_seq_len < 1:
+            raise ValueError(f"max_seq_len must be >= 1, got {max_seq_len}")
+        self.cfg = cfg
+        self.page_size = page_size
+        self.max_seq_len = max_seq_len
+        self.pages_per_seq = -(-max_seq_len // page_size)
+        self.pool = PagePool(n_pages)
+        self._tables: dict[object, list[int]] = {}
+        shape = (cfg.n_layers, n_pages, page_size, cfg.kv_heads,
+                 cfg.head_dim)
+        self.ck = jnp.zeros(shape, cfg.dtype)
+        self.cv = jnp.zeros_like(self.ck)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def open(self, sid) -> None:
+        if sid in self._tables:
+            raise PagePoolError(f"sequence {sid!r} is already open")
+        self._tables[sid] = []
+
+    def ensure(self, sid, n_tokens: int) -> None:
+        """Grow ``sid``'s table to cover ``n_tokens`` positions. The
+        scheduler reserves capacity at admission, so a raise here means
+        an accounting bug, not load."""
+        if n_tokens > self.max_seq_len:
+            raise PagePoolError(
+                f"sequence {sid!r} wants {n_tokens} tokens > max_seq_len "
+                f"{self.max_seq_len}")
+        table = self._tables[sid]
+        need = self.pages_needed(n_tokens) - len(table)
+        if need > 0:
+            table.extend(self.pool.alloc(need))
+
+    def release(self, sid) -> None:
+        """Return every page of ``sid`` to the pool (eviction/completion)."""
+        self.pool.free(self._tables.pop(sid))
+
+    def table_array(self, sid) -> np.ndarray:
+        """[pages_per_seq] int32, padded with 0 (masked by length)."""
+        table = self._tables[sid]
+        out = np.zeros((self.pages_per_seq,), np.int32)
+        out[:len(table)] = table
+        return out
+
+    @property
+    def occupancy(self) -> float:
+        return self.pool.used_pages / self.pool.n_pages

@@ -73,6 +73,13 @@ health     event (degrading | quarantine | reinstate), devices, score,
            preempt-requested records with reason=device-degraded (the
            proactive migration), a reinstate by possible ``grow-back``
            records
+serve      event (completed | failed | summary) plus the per-request
+           SLO payload (prompt_tokens, new_tokens, queue_wait_s,
+           ttft_s, token_latency_s) or the engine-run aggregate
+           (policy, tokens_per_s, slot_utilization, page_occupancy) —
+           the serving engine's records (serve/engine.py; a failed
+           event carries the typed ``engine-killed`` error, never a
+           silent drop)
 ========== ==========================================================
 """
 

@@ -13,6 +13,10 @@ directory (utils/xplane op breakdown) and prints:
 * comm/compute overlap from the xplane device timeline (``--trace``): the
   comm-hidden fraction — how much of the collective time the backward
   actually covered;
+* serving SLOs (``serve`` records from serve/engine.py — per-request
+  TTFT / queue-wait / per-token-latency percentiles, tokens/s, slot
+  utilization, page-pool occupancy per engine run) on streams written by
+  BENCH_serve or any engine with a telemetry stream attached;
 * MFU against the profiling.py peak tables — or an honest "MFU unavailable"
   line when the device has no peak entry (CPU) or the run recorded no FLOPs;
 * HBM-roofline position when the run recorded demand bytes;
@@ -186,7 +190,12 @@ def _phase_section(lines: list[str], by_kind: dict) -> None:
     r = recs[-1]
     lines.append("== step phase breakdown ==")
     pipe = r.get("pipeline")
-    if pipe:
+    if pipe and pipe.get("workload"):
+        # Decode/serve-flavored record: the pipeline identity is its own
+        # key set (batch, prompt/gen lengths, cache kind) — render as-is.
+        lines.append("pipeline: " + "  ".join(
+            f"{k}={v}" for k, v in pipe.items()))
+    elif pipe:
         lines.append(
             (f"pipeline: input={pipe.get('input_path')}"
              if pipe.get("input_path") else "pipeline:")
@@ -212,18 +221,76 @@ def _phase_section(lines: list[str], by_kind: dict) -> None:
         lines.append("phase timing unavailable"
                      + (f" ({r.get('reason')})" if r.get("reason") else ""))
         return
-    total = sum(phases.get(k) or 0.0
-                for k in ("host_input_s", "h2d_s", "device_s"))
-    for key, label in (("host_input_s", "host-input"), ("h2d_s", "h2d"),
-                       ("device_s", "device")):
-        v = phases.get(key)
-        if isinstance(v, (int, float)):
-            share = f" ({v / total:5.1%})" if total > 0 else ""
-            lines.append(f"  {label:12s} {_fmt_s(v):>10s}/step{share}")
+    # Training records carry host-input/h2d/device; the decode bench's
+    # record carries prefill/decode_token/sample — render whatever
+    # ``*_s`` phases the record holds, in record order.
+    keys = [k for k in phases
+            if k.endswith("_s") and isinstance(phases.get(k), (int, float))]
+    total = sum(phases[k] for k in keys)
+    # Training records are per-step; decode records are per generate run
+    # (uniform within each record, so the shares are honest either way).
+    unit = "/run" if pipe and pipe.get("workload") else "/step"
+    for key in keys:
+        v = phases[key]
+        label = key[:-2].replace("_", "-")
+        share = f" ({v / total:5.1%})" if total > 0 else ""
+        lines.append(f"  {label:12s} {_fmt_s(v):>10s}{unit}{share}")
     lines.append(f"  (serialized attribution probe over "
                  f"{phases.get('n_steps')} steps — phases cannot hide "
                  f"behind one another here; the throughput number is the "
                  f"overlapped pipeline)")
+
+
+def _serving_section(lines: list[str], by_kind: dict) -> None:
+    """Serving SLOs from the engine's typed ``serve`` records
+    (serve/engine.py): per-request TTFT / queue wait / per-token latency
+    percentiles over the completed requests, failures, and each engine
+    run's summary line (policy, tokens/s, slot utilization, page-pool
+    occupancy) — BENCH_serve writes one summary per policy, so the
+    continuous-vs-static comparison reads directly off this section."""
+    recs = by_kind.get("serve") or []
+    if not recs:
+        return
+    completed = [r for r in recs if r.get("event") == "completed"]
+    failed = [r for r in recs if r.get("event") == "failed"]
+    summaries = [r for r in recs if r.get("event") == "summary"]
+    lines.append(f"== serving ({len(completed)} completed, "
+                 f"{len(failed)} failed) ==")
+    # One percentile block PER POLICY: BENCH_serve writes both the
+    # continuous and the static runs' per-request records onto one
+    # stream, and a blended percentile would describe neither run.
+    policies = sorted({str(r.get("policy")) for r in completed})
+    for policy in policies:
+        rows = [r for r in completed if str(r.get("policy")) == policy]
+        prefix = f"[{policy}] " if len(policies) > 1 else ""
+        for key, label in (("ttft_s", "TTFT"),
+                           ("queue_wait_s", "queue wait"),
+                           ("token_latency_s", "token latency")):
+            vals = [r[key] for r in rows
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                lines.append(
+                    f"{prefix}{label:14s} "
+                    f"p50 {_fmt_s(percentile(vals, 50))}   "
+                    f"p99 {_fmt_s(percentile(vals, 99))}   "
+                    f"max {_fmt_s(max(vals))}")
+    for s in summaries:
+        occ = s.get("page_occupancy") or {}
+        tps = s.get("tokens_per_s")
+        util = s.get("slot_utilization")
+        lines.append(
+            f"engine[{s.get('policy')}]: "
+            f"{s.get('tokens_generated')} tokens"
+            + (f" at {tps:,.1f} tokens/s" if isinstance(tps, (int, float))
+               else "")
+            + (f", slot utilization {util:.2f}"
+               if isinstance(util, (int, float)) else "")
+            + (f", page occupancy mean {occ.get('mean'):.2f} "
+               f"max {occ.get('max'):.2f}"
+               if isinstance(occ.get("mean"), (int, float)) else ""))
+    for r in failed:
+        lines.append(f"  FAILED {r.get('request')}: {r.get('error')} "
+                     f"({str(r.get('detail', ''))[:80]})")
 
 
 def _comm_section(lines: list[str], by_kind: dict) -> None:
@@ -397,6 +464,7 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     times = _steps_section(lines, steps)
     _mfu_section(lines, meta, device, by_kind, times)
     _phase_section(lines, by_kind)
+    _serving_section(lines, by_kind)
     _comm_section(lines, by_kind)
     _memory_section(lines, by_kind)
     _resilience_section(lines, by_kind)

@@ -1,11 +1,22 @@
 #!/usr/bin/env python
 """LM generation CLI: restore a train_lm.py checkpoint and decode.
 
-KV-cache autoregressive decoding (models/transformer.py:generate) with
-greedy, temperature, top-k, and nucleus (top-p) sampling. Model-shape flags
-must match the training run; the checkpoint is read from --checkpoint-dir
-(falling back to randomly initialized weights, clearly announced, so the
-decode path can be exercised without a training run).
+Single-host decoding routes through the serving engine (serve/ — the
+continuous-batching paged-KV path, here in its one-request degenerate
+case): the prompt prefills in fixed-size chunks against the paged cache,
+so ANY prompt length runs the same two compiled programs and repeated CLI
+calls hit jax's compile cache instead of re-jitting per prompt length
+(the pre-engine CLI re-traced the whole decode for every distinct
+prompt/gen shape). Greedy, temperature, top-k and nucleus (top-p)
+sampling; sampled streams are per-request (seeded) and differ from the
+pre-engine CLI's batch-keyed draws. Sharded decoding (--dp/--tp > 1) and
+MoE checkpoints stay on models/transformer.generate — the engine is
+replicated and rejects batch-coupled MoE routing.
+
+Model-shape flags must match the training run; the checkpoint is read
+from --checkpoint-dir (falling back to randomly initialized weights,
+clearly announced, so the decode path can be exercised without a
+training run).
 
 Example:
   python scripts/train_lm.py --layers 2 --d-model 64 --steps 50
@@ -38,7 +49,12 @@ def parse_args():
     p.add_argument("--prefill-chunk", type=int, default=None,
                    help="prefill the prompt in N-token slices against the "
                         "growing KV cache (peak attention memory O(N*T) "
-                        "instead of O(T0^2) — the long-prompt lever)")
+                        "instead of O(T0^2) — the long-prompt lever). On "
+                        "the engine path this is the compiled chunk size "
+                        "(default 32): prompts pad to a chunk multiple, so "
+                        "every prompt length reuses one program")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="KV-cache page size (tokens) on the engine path")
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
@@ -82,6 +98,11 @@ def main():
     if args.moe_experts and not (1 <= args.moe_top_k <= args.moe_experts):
         raise SystemExit(
             f"--moe-top-k must be in [1, --moe-experts={args.moe_experts}]")
+    if args.prefill_chunk is not None and args.prefill_chunk < 1:
+        raise SystemExit(f"--prefill-chunk must be >= 1, got "
+                         f"{args.prefill_chunk}")
+    if args.page_size < 1:
+        raise SystemExit(f"--page-size must be >= 1, got {args.page_size}")
     import jax
     import jax.numpy as jnp
 
@@ -112,6 +133,16 @@ def main():
                 f"model flags (--layers/--d-model/... must equal the "
                 f"training run's): {e}") from e
         params = restored["params"]
+        # Orbax partial restore leaves abstract placeholders for target
+        # leaves the checkpoint lacks (e.g. --kv-heads against a fused-
+        # wqkv checkpoint) — catch that here instead of deep in jit.
+        if any(isinstance(leaf, jax.ShapeDtypeStruct)
+               for leaf in jax.tree.leaves(params)):
+            raise SystemExit(
+                f"checkpoint under {args.checkpoint_dir} does not match "
+                f"the model flags (e.g. --kv-heads/--moe-experts change "
+                f"the parameter tree); flags must equal the training "
+                f"run's")
         # A 1f1b run with interleaved virtual stages checkpoints its block
         # rows in interleaved storage order (marker saved alongside) —
         # composing them in row order here would run a layer-permuted
@@ -162,13 +193,50 @@ def main():
             temperature=args.temperature,
             top_k=args.top_k, top_p=args.top_p,
             prefill_chunk=args.prefill_chunk)
-    else:
+        tokens = [int(t) for t in out[0]]
+    elif args.moe_experts:
+        # MoE routing is batch-coupled (capacity drops depend on
+        # co-resident tokens) — the engine rejects it; the single-batch
+        # generate path stays correct for one request.
+        print("MoE checkpoint: decoding via models.transformer.generate "
+              "(the serving engine rejects batch-coupled MoE routing)",
+              file=sys.stderr)
         out = tfm.generate(params, cfg, prompt, args.gen_steps,
                            rng=jax.random.key(args.seed + 1),
                            temperature=args.temperature,
                            top_k=args.top_k, top_p=args.top_p,
                            prefill_chunk=args.prefill_chunk)
-    print(",".join(str(int(t)) for t in out[0]))
+        tokens = [int(t) for t in out[0]]
+    else:
+        # Engine path (single-request degenerate case of continuous
+        # batching): fixed prefill chunk + fixed decode program, so any
+        # prompt length — and any later CLI call against the same model
+        # shape — reuses the same two compiled programs.
+        from distributed_model_parallel_tpu.serve import (
+            Engine,
+            ServeConfig,
+        )
+
+        chunk = args.prefill_chunk if args.prefill_chunk else 32
+        serve = ServeConfig(
+            n_slots=1, page_size=args.page_size,
+            n_pages=-(-cfg.max_seq_len // args.page_size) + 1,
+            max_seq_len=cfg.max_seq_len,
+            prefill_chunk=min(chunk, cfg.max_seq_len),
+            temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p)
+        engine = Engine(params, cfg, serve)
+        print(f"engine decode: paged KV (page={serve.page_size}, "
+              f"pool={serve.n_pages} pages), prefill chunk "
+              f"{serve.prefill_chunk} — prompt lengths bucket to one "
+              f"compiled program", file=sys.stderr)
+        req = engine.submit(prompt_ids, args.gen_steps,
+                            seed=args.seed + 1)
+        engine.run()
+        if req.error:
+            raise SystemExit(f"engine failed: {req.error}")
+        tokens = prompt_ids + req.generated
+    print(",".join(str(t) for t in tokens))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,149 @@
+"""Paged-attention parity: the serving cache's read path vs the dense
+cache, bitwise.
+
+The contract (docs/SERVING.md): the XLA gather path and the Pallas
+kernel (interpreter) produce BITWISE the dense-cache result — paging is
+an indirection, never a numeric change — and stale page contents are
+unreachable (masked to exact zeros), so a request's values cannot depend
+on who held its pages before."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.ops import paged_attention as pa
+
+pytestmark = pytest.mark.serve
+
+
+def _pool(seed, p=16, page=8, hkv=2, dh=16, dtype=jnp.float32):
+    k = jax.random.key(seed)
+    return (jax.random.normal(jax.random.fold_in(k, 0),
+                              (p, page, hkv, dh), dtype),
+            jax.random.normal(jax.random.fold_in(k, 1),
+                              (p, page, hkv, dh), dtype))
+
+
+def _case(h=4, dh=16, page=8, n=4):
+    kp, vp = _pool(0, page=page, dh=dh)
+    tables = jnp.asarray([[3, 7, 1, 0], [2, 5, 0, 0], [9, 8, 4, 6]],
+                         jnp.int32)[:, :n]
+    positions = jnp.asarray([19, 10, 31], jnp.int32)
+    q = jax.random.normal(jax.random.key(7), (3, 1, h, dh))
+    return q, kp, vp, tables, positions
+
+
+def _dense(q, kp, vp, tables, positions, window=None):
+    """The dense-cache reference: pages assembled contiguously in logical
+    order, shared attend math — what _cached_block computes."""
+    b, n = tables.shape
+    page = kp.shape[1]
+    kr = kp[tables].reshape(b, n * page, *kp.shape[2:])
+    vr = vp[tables].reshape(b, n * page, *vp.shape[2:])
+    return pa.attend_rows(q, kr, vr, positions[:, None], positions + 1,
+                          window)
+
+
+def test_xla_gather_matches_dense_bitwise():
+    q, kp, vp, tables, positions = _case()
+    out = pa.paged_attention_xla(q, kp, vp, tables, positions[:, None],
+                                 positions + 1)
+    assert (out == _dense(q, kp, vp, tables, positions)).all()
+
+
+def test_kernel_interpret_matches_dense_bitwise():
+    q, kp, vp, tables, positions = _case()
+    out = pa.paged_attention_kernel(q, kp, vp, tables, positions,
+                                    interpret=True)
+    assert (out == _dense(q, kp, vp, tables, positions)).all()
+
+
+def test_kernel_windowed_matches_dense_bitwise():
+    q, kp, vp, tables, positions = _case()
+    out = pa.paged_attention_kernel(q, kp, vp, tables, positions,
+                                    window=8, interpret=True)
+    assert (out == _dense(q, kp, vp, tables, positions, window=8)).all()
+
+
+def test_kernel_gqa_grouping_matches_dense():
+    # 8 query heads over 2 kv heads: head h reads kv head h // 4, the
+    # _cached_block mapping the shared math must reproduce.
+    q, kp, vp, tables, positions = _case(h=8)
+    out = pa.paged_attention_kernel(q, kp, vp, tables, positions,
+                                    interpret=True)
+    assert (out == _dense(q, kp, vp, tables, positions)).all()
+
+
+def test_stale_page_contents_unreachable():
+    """Rewriting every position past each row's length — including pages
+    the row's table points at but hasn't filled, with NaN — must not
+    change a single bit of the output: freed pages are reused without
+    clearing, so this is the isolation continuous batching rests on."""
+    q, kp, vp, tables, positions = _case()
+    ref = pa.paged_attention_xla(q, kp, vp, tables, positions[:, None],
+                                 positions + 1)
+    kn, vn = np.array(kp), np.array(vp)
+    page = kp.shape[1]
+    used = set()
+    for row, pos in zip(np.asarray(tables), np.asarray(positions)):
+        for j, pid in enumerate(row):
+            for off in range(page):
+                if j * page + off <= pos:
+                    used.add((int(pid), off))
+    for pid in range(kn.shape[0]):
+        for off in range(page):
+            if (pid, off) not in used:
+                kn[pid, off] = np.nan
+                vn[pid, off] = np.nan
+    out = pa.paged_attention_xla(q, jnp.asarray(kn), jnp.asarray(vn),
+                                 tables, positions[:, None], positions + 1)
+    assert (out == ref).all()
+    outk = pa.paged_attention_kernel(q, jnp.asarray(kn), jnp.asarray(vn),
+                                     tables, positions, interpret=True)
+    assert (outk == ref).all()
+
+
+def test_prefill_chunk_matches_whole_prompt():
+    """A C-token chunk read of the paged cache scores exactly what the
+    same positions score in a single whole-prompt pass (intra-chunk
+    causality comes from the shared band mask)."""
+    kp, vp = _pool(3)
+    table = jnp.asarray([[5, 2, 11, 4]], jnp.int32)
+    t0 = 24
+    q = jax.random.normal(jax.random.key(9), (1, t0, 4, 16))
+    whole = pa.paged_attention_xla(
+        q, kp, vp, table, jnp.arange(t0)[None], jnp.asarray([t0]))
+    chunk = 8
+    parts = [
+        pa.paged_attention_xla(
+            q[:, lo:lo + chunk], kp, vp, table,
+            (lo + jnp.arange(chunk))[None], jnp.asarray([lo + chunk]))
+        for lo in range(0, t0, chunk)
+    ]
+    assert (jnp.concatenate(parts, axis=1) == whole).all()
+
+
+def test_dispatch_rejects_unknown_impl_and_multi_token_kernel():
+    q, kp, vp, tables, positions = _case()
+    with pytest.raises(ValueError, match="impl"):
+        pa.paged_attention(q, kp, vp, tables, positions[:, None],
+                           positions + 1, impl="cuda")
+    with pytest.raises(ValueError, match="one query token"):
+        pa.paged_attention_kernel(jnp.tile(q, (1, 2, 1, 1)), kp, vp,
+                                  tables, positions, interpret=True)
+
+
+def test_bfloat16_kernel_parity():
+    kp, vp = _pool(5, dtype=jnp.bfloat16)
+    tables = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
+    positions = jnp.asarray([13], jnp.int32)
+    q = jax.random.normal(jax.random.key(11), (1, 1, 4, 16),
+                          jnp.bfloat16)
+    x = pa.paged_attention_xla(q, kp, vp, tables, positions[:, None],
+                               positions + 1)
+    k = pa.paged_attention_kernel(q, kp, vp, tables, positions,
+                                  interpret=True)
+    assert x.dtype == jnp.bfloat16
+    assert (jnp.asarray(x, jnp.float32) == jnp.asarray(k,
+                                                       jnp.float32)).all()
