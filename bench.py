@@ -69,6 +69,14 @@ from distributed_model_parallel_tpu.utils.profiling import (  # noqa: E402
     demand_frac_of_peak,
 )
 
+# Every headline record embeds the active parallel plan (axis degrees +
+# strategy, autotune/plan.py) so BENCH_*/MULTICHIP_* artifacts are
+# self-describing and the planner's measured validation shares one
+# record shape (docs/AUTOTUNE.md).
+from distributed_model_parallel_tpu.autotune.plan import (  # noqa: E402
+    plan_payload,
+)
+
 
 def is_backend_unavailable(err: BaseException) -> bool:
     """Does this exception mean the accelerator backend is gone — at
@@ -115,7 +123,8 @@ def _telemetry_run(workload: str, meta: dict, device: dict | None = None):
                         meta=dict(workload=workload, **meta), device=device)
 
 
-def build_lm_bench():
+def build_lm_bench(*, mesh=None, model=None, batch=None, seq=None,
+                   steps=None, num_microbatches=None, schedule=None):
     """Long-context Transformer train-step workload, env-configured
     (DMP_BENCH_SEQ/BATCH/MOE_EXPERTS/PP/...; module docstring).
 
@@ -124,7 +133,11 @@ def build_lm_bench():
     metrics, and ``info`` carries the static measurement identity (cfg,
     batch, seq, moe, n_chips, steps, tag). Shared with
     ``benchmarks/run_step_profile.py`` so the profiled program IS the
-    timed program by construction.
+    timed program by construction, and with the parallelism autotuner's
+    measured validation (``scripts/dmp_plan.py --measure``), whose
+    keyword overrides — per-candidate ``mesh``/``num_microbatches``, a
+    small ``model``, short ``steps`` — take precedence over the env knobs
+    so every candidate is timed through THIS builder.
     """
     from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.models import transformer as tfm
@@ -134,23 +147,30 @@ def build_lm_bench():
     )
 
     n_chips = len(jax.devices())
-    seq = int(os.environ.get("DMP_BENCH_SEQ", "8192"))
-    batch = int(os.environ.get("DMP_BENCH_BATCH", str(2 * n_chips)))
-    steps = max(4, int(os.environ.get("DMP_BENCH_STEPS", "16")))
+    if seq is None:
+        seq = (model.max_seq_len if model is not None
+               else int(os.environ.get("DMP_BENCH_SEQ", "8192")))
+    if batch is None:
+        batch = int(os.environ.get("DMP_BENCH_BATCH", str(2 * n_chips)))
+    if steps is None:
+        steps = max(4, int(os.environ.get("DMP_BENCH_STEPS", "16")))
     # DMP_BENCH_MOE_EXPERTS > 0 swaps every block's FFN for a top-k routed
     # MoE (DMP_BENCH_MOE_TOPK, default 2) — the on-chip MoE throughput row
     # (drop rate reported alongside; VERDICT r3 weak #5).
-    moe = int(os.environ.get("DMP_BENCH_MOE_EXPERTS", "0"))
-    # DMP_BENCH_PP/DMP_BENCH_MICRO/DMP_BENCH_SCHEDULE bench the pipeline
-    # schedules over a real stage axis (multi-chip rounds).
-    pp = int(os.environ.get("DMP_BENCH_PP", "1"))
-    if n_chips % pp:
-        raise SystemExit(
-            f"DMP_BENCH_PP={pp} must divide the chip count ({n_chips}); "
-            f"a partial mesh would silently under-report the per-chip "
-            f"numbers, which divide by all {n_chips} chips")
-    cfg = LMTrainConfig(
-        model=tfm.TransformerConfig(
+    moe = (model.moe_experts if model is not None
+           else int(os.environ.get("DMP_BENCH_MOE_EXPERTS", "0")))
+    if mesh is None:
+        # DMP_BENCH_PP/DMP_BENCH_MICRO/DMP_BENCH_SCHEDULE bench the
+        # pipeline schedules over a real stage axis (multi-chip rounds).
+        pp = int(os.environ.get("DMP_BENCH_PP", "1"))
+        if n_chips % pp:
+            raise SystemExit(
+                f"DMP_BENCH_PP={pp} must divide the chip count ({n_chips}); "
+                f"a partial mesh would silently under-report the per-chip "
+                f"numbers, which divide by all {n_chips} chips")
+        mesh = MeshConfig(stage=pp, data=n_chips // pp)
+    if model is None:
+        model = tfm.TransformerConfig(
             vocab_size=32_000, d_model=1024, n_heads=8, n_layers=8,
             d_ff=4096, max_seq_len=seq, pos_embedding="rope",
             moe_experts=moe,
@@ -158,14 +178,19 @@ def build_lm_bench():
             remat=True,
             remat_policy=os.environ.get("DMP_BENCH_REMAT", "dots"),
             loss_chunk=int(os.environ.get("DMP_BENCH_LOSS_CHUNK", "0")),
-            dtype=jnp.bfloat16),
+            dtype=jnp.bfloat16)
+    cfg = LMTrainConfig(
+        model=model,
         batch_size=batch, seq_len=seq, n_tokens=4 * batch * (seq + 1),
         # A throughput bench needs no held-out eval, and at small batch the
         # default 10% tail cannot fit one seq_len eval window (ADVICE r3).
         eval_batches=0,
-        mesh=MeshConfig(stage=pp, data=n_chips // pp),
-        num_microbatches=int(os.environ.get("DMP_BENCH_MICRO", "1")),
-        pipeline_schedule=os.environ.get("DMP_BENCH_SCHEDULE", "gpipe"),
+        mesh=mesh,
+        num_microbatches=(num_microbatches if num_microbatches is not None
+                          else int(os.environ.get("DMP_BENCH_MICRO", "1"))),
+        pipeline_schedule=(schedule if schedule is not None
+                           else os.environ.get("DMP_BENCH_SCHEDULE",
+                                               "gpipe")),
         # Interleaved virtual stages (1f1b only; DMP_BENCH_VS=2 on a
         # multi-chip stage axis).
         virtual_stages=int(os.environ.get("DMP_BENCH_VS", "1")),
@@ -256,6 +281,8 @@ def bench_lm() -> None:
         "unit": "tokens/s/chip",
         "vs_baseline": None,   # the reference has no LM workload to anchor on
         "mfu": mfu,
+        "plan": plan_payload(cfg.mesh, "spmd",
+                             num_microbatches=cfg.num_microbatches),
     }
     if moe:
         out["moe_drop_rate"] = round(float(m["moe_drop"]), 4)
@@ -296,6 +323,7 @@ def bench_decode() -> None:
     bandwidth-bound (each step streams all params + the KV cache for one
     token), so the companion number is the implied HBM traffic at the
     measured rate vs peak."""
+    from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.models import transformer as tfm
     from distributed_model_parallel_tpu.utils.profiling import (
         fetch,
@@ -342,6 +370,9 @@ def bench_decode() -> None:
         # hardware counter — same labeling convention as the CNN rows.
         "demand_gbs": round(implied / 1e9, 1),
         "demand_frac_of_peak": frac,
+        # generate() is one unsharded jit (default placement) — the plan
+        # says so rather than implying a mesh layout that isn't there.
+        "plan": plan_payload(MeshConfig(), "decode"),
     }
     if frac_err:
         out["demand_frac_error"] = frac_err
@@ -499,6 +530,7 @@ def bench_serve() -> None:
     Env knobs: DMP_BENCH_SERVE_{REQS,RATE,SEED,PROMPT,GEN,SLOTS,PAGE,
     VOCAB,DMODEL,LAYERS,DFF} (see build_serve_trace).
     """
+    from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.models import transformer as tfm
     from distributed_model_parallel_tpu.serve import Engine, ServeConfig
 
@@ -576,6 +608,9 @@ def bench_serve() -> None:
             cont["page_occupancy"].get("max", 0), 3),
         "requests": len(trace),
         "requests_completed": cont["requests_completed"],
+        # The engine's decode programs run on default placement (no mesh
+        # axes yet — ROADMAP item 3's TP engine will change this).
+        "plan": plan_payload(MeshConfig(), "serve"),
     }
     telemetry.memory()
     telemetry.record("bench", **out)
@@ -921,6 +956,9 @@ def _run_workload() -> None:
         "mfu": mfu,
         "demand_gbs": demand_gbs,
         "demand_frac_of_peak": demand_frac,
+        "plan": plan_payload(
+            trainer.config.mesh, trainer.config.strategy,
+            num_microbatches=trainer.config.num_microbatches),
     }
     if frac_err:
         out["demand_frac_error"] = frac_err

@@ -218,7 +218,14 @@ class TrainConfig:
     # Data-parallel engine: "gspmd" = sharded jit (XLA infers the allreduce);
     # "ddp" = explicit shard_map per-replica programs with psum gradient
     # averaging and per-replica BatchNorm (parallel/ddp.py); "fsdp" = ZeRO-3
-    # parameter+optimizer sharding over the data axis (parallel/fsdp.py).
+    # parameter+optimizer sharding over the data axis (parallel/fsdp.py);
+    # "spmd_pipeline" = single-jit GPipe/1F1B over the stage axis
+    # (parallel/spmd_cnn_pipeline.py); "auto" = cost-model-driven layout
+    # (autotune/, docs/AUTOTUNE.md): probe the model, enumerate feasible
+    # layouts of the LIVE device count, HBM-filter, rank with the
+    # alpha-beta comm/compute model, rewrite strategy + mesh from the
+    # winner and emit a typed `plan` telemetry record; elastic restarts
+    # re-plan on the refitted mesh instead of blindly shrinking dp.
     strategy: str = "gspmd"
     ddp_bucket_bytes: int | None = None     # None = per-leaf psum
     ddp_allreduce: str = "psum"             # "psum" | "bucketed" | "ring"

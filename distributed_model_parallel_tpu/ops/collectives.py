@@ -21,9 +21,13 @@ another named-axis context) over that axis.
 Every wrapper accounts its communication volume into the telemetry
 registry (``utils/telemetry.record_collective``) **at trace time** — once
 per compilation, tagged by kind and mesh axis, with per-device wire bytes
-under the ring cost model. ``scripts/dmp_report.py`` renders the totals;
-see the telemetry module docstring for the per-compile (not per-step)
-semantics.
+AND per-device message counts under the ring cost model
+(``wire_bytes_estimate`` / ``wire_ops_estimate`` — the beta and alpha
+terms of an alpha-beta comm model; the parallelism autotuner's cost model
+is built on the same two estimators, so its analytic schedule and this
+trace-time accounting are one currency, autotune/cost_model.py).
+``scripts/dmp_report.py`` renders the totals; see the telemetry module
+docstring for the per-compile (not per-step) semantics.
 """
 
 from __future__ import annotations

@@ -8,6 +8,15 @@
 * ``tensor_parallel`` — Megatron column/row PartitionSpecs (TP)
 """
 
+from distributed_model_parallel_tpu.parallel.auto_partition import (  # noqa: F401
+    # Public planner contract (docs/AUTOTUNE.md): the autotuner's compute
+    # term and the pipeline balancer share these.
+    auto_boundaries,
+    compiled_flops_probe,
+    cost_balanced_boundaries,
+    microbatch_rows,
+    unit_costs,
+)
 from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: F401
     data_parallel_apply,
     gather,

@@ -10,6 +10,15 @@ XLA's own compiled cost model (``lowered.compile().cost_analysis()`` FLOPs,
 with a parameter+activation-bytes fallback), and boundaries are chosen to
 minimize the bottleneck stage cost — the pipeline's steady-state throughput is
 set by its slowest stage, so minimax (not equal-count) is the right objective.
+
+**Public contract:** ``unit_costs``, ``cost_balanced_boundaries``,
+``auto_boundaries``, ``microbatch_rows`` and ``compiled_flops_probe`` are
+stable API, not pipeline-internal helpers — the parallelism autotuner
+(``autotune/``, docs/AUTOTUNE.md) builds its compute term on them, and
+``parallel/__init__`` re-exports them. Pinned properties: ``unit_costs``
+returns one strictly-positive float per unit, in unit order, at the given
+sample shape; ``cost_balanced_boundaries`` is a deterministic exact
+minimax DP whose ties keep the latest cut (front-loaded stages).
 """
 
 from __future__ import annotations
@@ -22,9 +31,20 @@ import numpy as np
 
 from distributed_model_parallel_tpu.models.staged import StagedModel
 
+__all__ = [
+    "auto_boundaries",
+    "compiled_flops_probe",
+    "cost_balanced_boundaries",
+    "microbatch_rows",
+    "unit_costs",
+]
 
-def _compiled_flops(fn, *args) -> float | None:
-    """XLA's FLOP estimate for ``fn(*args)``, or None if unavailable."""
+
+def compiled_flops_probe(fn, *args) -> float | None:
+    """XLA's FLOP estimate for ``fn(*args)``, or None if unavailable
+    (loop bodies counted once, custom calls zero — see
+    ``utils/profiling.compiled_cost_analysis`` for the blind spots; valid
+    for the loop-free per-unit programs this module costs)."""
     try:
         analysis = jax.jit(fn).lower(*args).compile().cost_analysis()
         if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
@@ -37,6 +57,10 @@ def _compiled_flops(fn, *args) -> float | None:
         return None
 
 
+# Historical private name (pre-autotune callers).
+_compiled_flops = compiled_flops_probe
+
+
 def unit_costs(model: StagedModel, sample_shape: Sequence[int],
                *, train: bool = True) -> list[float]:
     """Per-unit relative cost of one forward pass at ``sample_shape``.
@@ -46,6 +70,10 @@ def unit_costs(model: StagedModel, sample_shape: Sequence[int],
     once on whatever backend is active — the FLOP count is
     backend-independent. Falls back to parameter-count + activation-element
     proxies for units XLA cannot cost.
+
+    Stability pin (consumed by ``autotune/search.cnn_workload`` and the
+    pipeline balancer alike): returns ``model.num_units`` floats, each
+    ``>= 1.0``, in unit order.
     """
     x = jnp.zeros(tuple(sample_shape), jnp.float32)
     params, state = model.init(jax.random.key(0), x)
@@ -54,7 +82,7 @@ def unit_costs(model: StagedModel, sample_shape: Sequence[int],
         def fwd(p, s, a, _i=i):
             y, _ = model.apply_unit(_i, p, s, a, train=train)
             return y
-        flops = _compiled_flops(fwd, params[i], state[i], x)
+        flops = compiled_flops_probe(fwd, params[i], state[i], x)
         out = jax.eval_shape(fwd, params[i], state[i], x)
         if flops is None:
             n_params = sum(l.size for l in jax.tree.leaves(params[i]))

@@ -52,7 +52,18 @@ __all__ = [
     "TopologyMismatchError",
     "elastic_restore",
     "fit_mesh_to_devices",
+    "live_device_count",
 ]
+
+
+def live_device_count() -> int:
+    """Devices visible to this restart — the single seam every elastic
+    topology decision reads (``fit_mesh_to_devices`` callers AND the
+    autotuner's ``strategy="auto"`` re-plan, autotune/planner.py), so
+    tests and orchestrators can present a shrunk slice in one place."""
+    import jax
+
+    return len(jax.devices())
 
 
 def build_resume_tree(epoch: int, cursor: int, epoch_len: int,

@@ -54,6 +54,16 @@ def make_token_stream(vocab_size: int, n_tokens: int, seed: int = 0
 @dataclasses.dataclass(frozen=True)
 class LMTrainConfig:
     model: tfm.TransformerConfig = tfm.TransformerConfig()
+    # "spmd" = run the configured mesh as-is (the single-jit
+    # dp x pp x tp x sp x ep program); "auto" = let the parallelism
+    # autotuner (autotune/, docs/AUTOTUNE.md) pick the axis degrees for
+    # the LIVE device count — enumerate feasible factorizations, filter
+    # by HBM feasibility, rank with the alpha-beta comm/compute cost
+    # model, rewrite `mesh` (+ `num_microbatches`, and the model's
+    # sp_axis when a sequence axis is planned) from the winner, and emit
+    # a typed `plan` telemetry record. Elastic restarts re-plan on the
+    # refitted mesh instead of blindly shrinking dp.
+    strategy: str = "spmd"
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     optimizer: OptimizerConfig = dataclasses.field(
         default_factory=lambda: OptimizerConfig(learning_rate=0.1,
@@ -113,17 +123,44 @@ class LMTrainConfig:
 
 class LMTrainer:
     def __init__(self, config: LMTrainConfig, spec: MeshSpec | None = None):
+        if config.strategy not in ("spmd", "auto"):
+            raise ValueError(
+                f"LMTrainConfig.strategy must be 'spmd' or 'auto', got "
+                f"{config.strategy!r} — no silent ignores")
+        self.plan_decision = None
+        if config.strategy == "auto" and spec is not None:
+            raise ValueError(
+                "strategy='auto' plans the mesh layout itself and cannot "
+                "honor an explicit MeshSpec; resolve the plan first "
+                "(autotune.plan_for_lm) or pass strategy='spmd' — no "
+                "silent ignores")
+        if config.strategy == "auto" and spec is None:
+            # Cost-model-driven layout (autotune/, docs/AUTOTUNE.md):
+            # enumerate every feasible (dp, pp, tp, sp, ep) factorization
+            # of the LIVE device count, HBM-filter, rank alpha-beta, and
+            # rewrite mesh/microbatches/sp_axis from the winner. An
+            # elastic restart therefore RE-PLANS on the refitted mesh.
+            from distributed_model_parallel_tpu.autotune.planner import (
+                plan_for_lm,
+            )
+            from distributed_model_parallel_tpu.train.elastic import (
+                live_device_count,
+            )
+
+            config, self.plan_decision = plan_for_lm(config,
+                                                     live_device_count())
         self.elastic_decision = None
-        if config.elastic and spec is None:
+        if config.elastic and spec is None and self.plan_decision is None:
             # Elastic restart: refit the data axis to the live device count
             # (train/elastic.py); resume then reshards the checkpoint onto
-            # the rebuilt mesh.
+            # the rebuilt mesh. strategy="auto" replans above instead.
             from distributed_model_parallel_tpu.train.elastic import (
                 fit_mesh_to_devices,
+                live_device_count,
             )
 
             mesh_cfg, self.elastic_decision = fit_mesh_to_devices(
-                config.mesh, len(jax.devices()),
+                config.mesh, live_device_count(),
                 batch_size=config.batch_size)
             config = dataclasses.replace(config, mesh=mesh_cfg)
         self.config = config
@@ -308,6 +345,16 @@ class LMTrainer:
                                  for n in ("lm", "lm-preempt",
                                            "lm-emergency", "lm-good")):
             self._resume()
+        if self.plan_decision is not None:
+            # After _resume so an elastic re-plan is stamped with the
+            # exact global step the run continues from.
+            from distributed_model_parallel_tpu.autotune.planner import (
+                emit_plan_record,
+            )
+
+            emit_plan_record(self.logger.telemetry, self.plan_decision,
+                             global_step=self._global_step)
+            self.logger.log_line(self.plan_decision.describe())
 
     # ------------------------------------------------------------------ data
     def sample_batch(self, epoch: int | None = None,

@@ -35,6 +35,23 @@ from distributed_model_parallel_tpu.train.trainer import EpochResult, eval_now
 
 class PipelineTrainer:
     def __init__(self, config: TrainConfig, devices=None):
+        self.plan_decision = None
+        if config.strategy == "auto":
+            # Autotune the single-controller pipeline (autotune/,
+            # docs/AUTOTUNE.md): the stage count is fixed by the device
+            # list, so the planner picks the microbatch count (GPipe
+            # bubble vs boundary-latency alpha cost) and turns the
+            # cost-balanced stage cut on; the decision lands as a typed
+            # `plan` telemetry record below.
+            from distributed_model_parallel_tpu.autotune.planner import (
+                plan_for_stage_pipeline,
+            )
+
+            n_stages = (config.mesh.stage if config.mesh.stage > 1
+                        else len(devices if devices is not None
+                                 else jax.devices()))
+            config, self.plan_decision = plan_for_stage_pipeline(config,
+                                                                 n_stages)
         self.config = config
         if devices is None:
             devices = jax.devices()[:max(config.mesh.stage, 1)]
@@ -188,6 +205,16 @@ class PipelineTrainer:
                                            "pipeline-emergency",
                                            "pipeline-good")):
             self._resume()
+        if self.plan_decision is not None:
+            # After _resume so a re-plan is stamped with the exact global
+            # step the run continues from.
+            from distributed_model_parallel_tpu.autotune.planner import (
+                emit_plan_record,
+            )
+
+            emit_plan_record(self.logger.telemetry, self.plan_decision,
+                             global_step=self._global_step)
+            self.logger.log_line(self.plan_decision.describe())
 
     def _ckpt_meta(self):
         """Manifest stamp: saving topology + exact position

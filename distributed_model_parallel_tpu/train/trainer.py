@@ -219,18 +219,43 @@ class Trainer:
     def __init__(self, config: TrainConfig, spec: MeshSpec | None = None,
                  *, train_ds: ArrayDataset | None = None,
                  eval_ds: ArrayDataset | None = None):
+        self.plan_decision = None
+        if config.strategy == "auto" and spec is not None:
+            raise ValueError(
+                "strategy='auto' plans the mesh layout itself and cannot "
+                "honor an explicit MeshSpec; resolve the plan first "
+                "(autotune.plan_for_cnn) or pass a concrete strategy — "
+                "no silent ignores")
+        if config.strategy == "auto" and spec is None:
+            # Cost-model-driven layout (autotune/, docs/AUTOTUNE.md):
+            # probe the model, enumerate feasible (dp, pp) x strategy
+            # layouts of the LIVE device count, rank with the alpha-beta
+            # comm/compute model, and rewrite strategy + mesh from the
+            # winner. On an elastic restart this REPLANS on the refitted
+            # mesh instead of blindly shrinking dp.
+            from distributed_model_parallel_tpu.autotune.planner import (
+                plan_for_cnn,
+            )
+            from distributed_model_parallel_tpu.train.elastic import (
+                live_device_count,
+            )
+
+            config, self.plan_decision = plan_for_cnn(config,
+                                                      live_device_count())
         self.elastic_decision = None
-        if config.elastic and spec is None:
+        if config.elastic and spec is None and self.plan_decision is None:
             # Elastic restart: rebuild the mesh at the largest dp degree
             # the live device count supports (train/elastic.py) — the
             # degraded-slice restart path. An explicit `spec` means the
-            # caller already chose a topology.
+            # caller already chose a topology; strategy="auto" replans
+            # above instead.
             from distributed_model_parallel_tpu.train.elastic import (
                 fit_mesh_to_devices,
+                live_device_count,
             )
 
             mesh_cfg, self.elastic_decision = fit_mesh_to_devices(
-                config.mesh, len(jax.devices()),
+                config.mesh, live_device_count(),
                 batch_size=config.data.batch_size)
             config = config.replace(mesh=mesh_cfg)
         self.config = config
@@ -563,6 +588,16 @@ class Trainer:
                                  for n in ("ckpt", "preempt", "emergency",
                                            "good")):
             self._resume()
+        if self.plan_decision is not None:
+            # After _resume so an elastic re-plan is stamped with the
+            # exact global step the run continues from.
+            from distributed_model_parallel_tpu.autotune.planner import (
+                emit_plan_record,
+            )
+
+            emit_plan_record(self.logger.telemetry, self.plan_decision,
+                             global_step=self._global_step)
+            self.logger.log_line(self.plan_decision.describe())
 
     def _build_steps(self) -> None:
         """(Re)build the jitted step functions from the current config and

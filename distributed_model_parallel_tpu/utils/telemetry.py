@@ -109,6 +109,7 @@ __all__ = [
     "registry",
     "tenant_scope",
     "wire_bytes_estimate",
+    "wire_ops_estimate",
 ]
 
 
@@ -371,6 +372,34 @@ def wire_bytes_estimate(kind: str, payload_bytes: int, n_shards: int) -> float:
     return float(payload_bytes) * factor(n)
 
 
+# Per-device sequential message count of one execution under the same
+# ring algorithms — the ALPHA term of an alpha-beta cost model (each
+# message pays a launch/latency cost regardless of size, which is what
+# makes many small collectives slower than one big one even at equal
+# bytes). All-reduce = reduce-scatter (n-1 steps) + all-gather (n-1).
+_OP_FACTORS = {
+    "psum": lambda n: 2 * (n - 1),
+    "bucketed_psum": lambda n: 2 * (n - 1),
+    "reduce_scatter": lambda n: n - 1,
+    "all_gather": lambda n: n - 1,
+    "all_to_all": lambda n: n - 1,
+    "ppermute": lambda n: 1,
+}
+
+
+def wire_ops_estimate(kind: str, n_shards: int) -> float:
+    """Per-device message count for one execution of a collective over an
+    n-way axis (ring model; unknown kinds count one message). The
+    companion of :func:`wire_bytes_estimate`: together they are the
+    (alpha, beta) pair the autotuner's cost model prices collectives
+    with (autotune/cost_model.py)."""
+    n = max(1, int(n_shards))
+    factor = _OP_FACTORS.get(kind)
+    if factor is None:
+        factor = lambda n: 1.0  # noqa: E731 - unknown kinds count one op
+    return float(factor(n))
+
+
 def record_collective(kind: str, axis: Any, payload_bytes: Any,
                       n_shards: Any) -> None:
     """Account one collective call into the registry, tagged by mesh axis.
@@ -383,7 +412,10 @@ def record_collective(kind: str, axis: Any, payload_bytes: Any,
 
     * ``collective_traces{kind,axis}`` — times this collective traced;
     * ``collective_payload_bytes{kind,axis}`` — logical payload bytes;
-    * ``collective_wire_bytes_est{kind,axis}`` — ring-model wire bytes.
+    * ``collective_wire_bytes_est{kind,axis}`` — ring-model wire bytes;
+    * ``collective_ops_est{kind,axis}`` — ring-model per-device message
+      count (the alpha term of an alpha-beta cost model needs message
+      counts, not just bytes — autotune/cost_model.py seeds from both).
     """
     try:
         n = int(n_shards)
@@ -395,6 +427,8 @@ def record_collective(kind: str, axis: Any, payload_bytes: Any,
         reg.counter("collective_payload_bytes", **tags).inc(b)
         reg.counter("collective_wire_bytes_est", **tags).inc(
             wire_bytes_estimate(kind, b, n))
+        reg.counter("collective_ops_est", **tags).inc(
+            wire_ops_estimate(kind, n))
     except Exception:
         return
 

@@ -20,8 +20,12 @@ directory (utils/xplane op breakdown) and prints:
 * MFU against the profiling.py peak tables — or an honest "MFU unavailable"
   line when the device has no peak entry (CPU) or the run recorded no FLOPs;
 * HBM-roofline position when the run recorded demand bytes;
-* communication volume per collective kind x mesh axis (trace-time
-  estimates from ops/collectives.py);
+* communication volume AND message counts per collective kind x mesh axis
+  (trace-time ring-model estimates from ops/collectives.py — the beta and
+  alpha terms the autotuner's cost model prices with);
+* the parallelism-plan timeline (``plan`` records from the autotuner,
+  autotune/planner.py): chosen layout, cost breakdown, alternatives, and
+  the global step each (re-)plan landed at;
 * device memory watermarks and recompilation counts;
 * the failure/recovery/divergence timeline (injected faults, non-finite
   restores, stall escalations, torn-checkpoint fallbacks, cross-replica
@@ -293,6 +297,55 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
                      f"({str(r.get('detail', ''))[:80]})")
 
 
+def _plan_section(lines: list[str], by_kind: dict) -> None:
+    """Parallelism-plan records (autotune/planner.emit_plan_record): which
+    layout the autotuner chose, at which global step, and the nearest
+    alternatives — so a re-planned elastic restart is auditable."""
+    plans = by_kind.get("plan") or []
+    if not plans:
+        return
+    lines.append(f"== parallelism plan ({len(plans)} planned) ==")
+    for r in plans:
+        axes = r.get("axes") or {}
+        degrees = "x".join(f"{k}{v}" for k, v in axes.items()
+                           if isinstance(v, (int, float)) and v > 1) or "dp1"
+        cost = r.get("cost") or {}
+        # "measured" only when a measurement actually succeeded —
+        # error-only measured rows mean the analytic ranking stood.
+        how = ("measured" if any("measured_s" in m
+                                 for m in r.get("measured") or [])
+               else "analytic")
+        lines.append(
+            f"  step {r.get('global_step', 0):>6}: "
+            f"{r.get('strategy', '?')}[{degrees}] "
+            f"M={r.get('num_microbatches', 1)} on "
+            f"{r.get('n_devices', '?')} devices ({r.get('reason', '?')}, "
+            f"{how}; {r.get('n_feasible', '?')} feasible / "
+            f"{r.get('n_rejected', 0)} rejected)")
+        if cost.get("total_s") is not None:
+            lines.append(
+                f"      predicted {_fmt_s(cost['total_s'])}/step "
+                f"(compute {_fmt_s(cost.get('compute_s', 0))} x bubble "
+                f"{cost.get('bubble', 1):.2f}, comm "
+                f"{_fmt_s(cost.get('comm_s', 0))}, hidden "
+                f"{_fmt_s(cost.get('comm_hidden_s', 0))})")
+        # Alternatives = the analytic top minus the CHOSEN plan (which is
+        # not necessarily top[0] — a measurement may have overruled it;
+        # the model's preferred-but-rejected layout is then the most
+        # interesting line here).
+        chosen_key = (r.get("strategy"), axes, r.get("num_microbatches"))
+        alts = [a for a in (r.get("top") or [])
+                if (a.get("strategy"), a.get("axes"),
+                    a.get("num_microbatches")) != chosen_key]
+        for alt in alts[:3]:
+            a = alt.get("axes") or {}
+            ad = "x".join(f"{k}{v}" for k, v in a.items()
+                          if isinstance(v, (int, float)) and v > 1) or "dp1"
+            at = (alt.get("cost") or {}).get("total_s")
+            lines.append(f"      alt {alt.get('strategy', '?')}[{ad}]"
+                         + (f" {_fmt_s(at)}/step" if at else ""))
+
+
 def _comm_section(lines: list[str], by_kind: dict) -> None:
     snaps = by_kind.get("metrics") or []
     counters = snaps[-1].get("counters", {}) if snaps else {}
@@ -305,8 +358,13 @@ def _comm_section(lines: list[str], by_kind: dict) -> None:
         for key in sorted(comm):
             tags = key[key.index("{") + 1:-1]
             traces = counters.get(f"collective_traces{{{tags}}}", 0)
+            ops = counters.get(f"collective_ops_est{{{tags}}}")
+            # Message counts next to bytes: the alpha term of an
+            # alpha-beta comm model (autotune/cost_model.py) — many small
+            # collectives read differently from one big one here.
+            ops_txt = f", {ops:.0f} msgs" if ops is not None else ""
             lines.append(f"{tags:40s} {_fmt_bytes(comm[key]):>12s} wire "
-                         f"({traces:.0f} traces)")
+                         f"({traces:.0f} traces{ops_txt})")
     n_compiles = counters.get("jax_compiles")
     if n_compiles is not None:
         secs = counters.get("jax_compile_seconds", 0.0)
@@ -465,6 +523,7 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     _mfu_section(lines, meta, device, by_kind, times)
     _phase_section(lines, by_kind)
     _serving_section(lines, by_kind)
+    _plan_section(lines, by_kind)
     _comm_section(lines, by_kind)
     _memory_section(lines, by_kind)
     _resilience_section(lines, by_kind)
