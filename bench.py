@@ -123,6 +123,70 @@ def _telemetry_run(workload: str, meta: dict, device: dict | None = None):
                         meta=dict(workload=workload, **meta), device=device)
 
 
+def _maybe_gate(telemetry) -> dict | None:
+    """Run the cross-run perf regression gate (utils/baseline.py) on the
+    stream this bench just wrote: compare the headline metrics against
+    the baseline ledger's noise band and record a typed ``gate`` record.
+
+    Warn-only by default — the bench still prints its headline and exits
+    0; ``DMP_BENCH_GATE=strict`` makes :func:`_enforce_gate` exit 1 on a
+    regression (after the headline JSON printed — the driver contract),
+    ``DMP_BENCH_GATE=off`` skips entirely. ``DMP_BENCH_LEDGER`` points
+    at the ledger (default: the repo's committed BASELINE_LEDGER.jsonl);
+    ``DMP_BENCH_GATE_UPDATE=1`` appends a green run to it. The gate must
+    never take down a measurement that succeeded: any internal error
+    logs and returns None.
+    """
+    if os.environ.get("DMP_BENCH_GATE", "warn") == "off":
+        return None
+    try:
+        from distributed_model_parallel_tpu.utils import baseline as bl
+        from distributed_model_parallel_tpu.utils.telemetry import (
+            read_records,
+        )
+
+        ledger_path = os.environ.get("DMP_BENCH_LEDGER", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BASELINE_LEDGER.jsonl"))
+        recs = read_records(telemetry.path)
+        # The default stream path appends across bench invocations: gate
+        # only THIS run's records (from the last run_start header on).
+        last = max(i for i, r in enumerate(recs)
+                   if r.get("kind") == "run_start")
+        points = bl.extract_points(recs[last:])
+        if not points:
+            return None
+        result = bl.gate_points(points, bl.load_ledger(ledger_path))
+        bl.emit_gate_record(telemetry, result, ledger_path=ledger_path)
+        for v in result["regressions"]:
+            attr = v.get("attribution") or {}
+            where = attr.get("span") or attr.get("phase")
+            _log(f"gate: REGRESSION {v['metric']}: {v['value']:g} vs "
+                 f"baseline {v['baseline']:g} ± {v['tolerance']:g}"
+                 + (f" — {where!r} grew {attr.get('baseline_share'):.1%}"
+                    f" -> {attr.get('share'):.1%}" if where else ""))
+        if result["ok"]:
+            _log(f"gate: pass ({len(result['verdicts'])} metrics within "
+                 f"the noise band of {ledger_path})")
+            if os.environ.get("DMP_BENCH_GATE_UPDATE") == "1":
+                bl.append_entries(ledger_path, bl.entries_from_points(
+                    points, green=True,
+                    source=f"bench:{os.path.basename(telemetry.path)}"))
+        return result
+    except Exception as e:  # noqa: BLE001 - observability must not kill bench
+        _log(f"gate skipped: {type(e).__name__}: {e}")
+        return None
+
+
+def _enforce_gate(result: dict | None) -> None:
+    """Strict mode: fail the run AFTER the headline printed."""
+    if (result is not None and not result["ok"]
+            and os.environ.get("DMP_BENCH_GATE") == "strict"):
+        _log("gate: DMP_BENCH_GATE=strict — failing the run on the "
+             "regression above")
+        raise SystemExit(1)
+
+
 def build_lm_bench(*, mesh=None, model=None, batch=None, seq=None,
                    steps=None, num_microbatches=None, schedule=None):
     """Long-context Transformer train-step workload, env-configured
@@ -290,8 +354,10 @@ def bench_lm() -> None:
                    tokens_per_s=batch * seq / dt, mfu=mfu)
     telemetry.memory()
     telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
     telemetry.finish()
     print(json.dumps(out))
+    _enforce_gate(gate)
 
 
 def build_decode_bench():
@@ -389,8 +455,10 @@ def bench_decode() -> None:
                    tokens_per_s=toks_per_s)
     telemetry.memory()
     telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
     telemetry.finish()
     print(json.dumps(out))
+    _enforce_gate(gate)
 
 
 def decode_phase_record(info: dict, params, prompt, dt_total: float) -> dict:
@@ -614,8 +682,10 @@ def bench_serve() -> None:
     }
     telemetry.memory()
     telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
     telemetry.finish()
     print(json.dumps(out))
+    _enforce_gate(gate)
 
 
 def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int,
@@ -984,8 +1054,10 @@ def _run_workload() -> None:
     out["step_phase"] = phase
     telemetry.memory()
     telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
     telemetry.finish()
     print(json.dumps(out))
+    _enforce_gate(gate)
 
 
 if __name__ == "__main__":

@@ -34,7 +34,9 @@ from distributed_model_parallel_tpu.orchestrator.tenants import (
     TenantSpec,
     TenantState,
 )
+from distributed_model_parallel_tpu.utils import tracing
 from distributed_model_parallel_tpu.utils.telemetry import TelemetryRun
+from distributed_model_parallel_tpu.utils.tracing import span
 
 __all__ = ["Orchestrator", "UnschedulableError"]
 
@@ -348,22 +350,31 @@ class Orchestrator:
     def run_round(self) -> bool:
         """One scheduling round: admit, advance every running tenant by
         the quantum (admission order — deterministic), reap. Returns
-        whether any tenant advanced or changed state."""
+        whether any tenant advanced or changed state. Each round is a
+        ``round`` span on the fleet stream (utils/tracing.py) so the
+        control loop's own cadence — and which rounds spent their time
+        admitting/draining — renders on the fleet timeline next to the
+        tenant lifecycle records."""
         before = {n: t.state for n, t in self.tenants.items()}
-        self._apply_health()
-        admitted = self._admit()
-        self._maybe_grow_back()
-        moved = admitted > 0
-        for tenant in sorted(self._by_state(TenantState.RUNNING,
-                                            TenantState.PREEMPTING),
-                             key=lambda t: t.admit_seq):
-            if tenant.state is TenantState.PREEMPTING:
-                tenant.drain()
-                moved = True
-            elif tenant.alive:
-                tenant.grant_steps(self.quantum)
-                moved = True
-        self._reap()
+        with tracing.sink_scope(self.telemetry), \
+                span("round", round=self.rounds) as sp:
+            self._apply_health()
+            admitted = self._admit()
+            self._maybe_grow_back()
+            moved = admitted > 0
+            for tenant in sorted(self._by_state(TenantState.RUNNING,
+                                                TenantState.PREEMPTING),
+                                 key=lambda t: t.admit_seq):
+                if tenant.state is TenantState.PREEMPTING:
+                    with span("drain_tenant", tenant=tenant.name):
+                        tenant.drain()
+                    moved = True
+                elif tenant.alive:
+                    tenant.grant_steps(self.quantum)
+                    moved = True
+            with span("reap"):
+                self._reap()
+            sp.annotate(admitted=admitted)
         self.rounds += 1
         after = {n: t.state for n, t in self.tenants.items()}
         return moved or after != before

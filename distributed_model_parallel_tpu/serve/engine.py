@@ -42,7 +42,9 @@ from distributed_model_parallel_tpu.serve.scheduler import (
     Scheduler,
     summarize,
 )
+from distributed_model_parallel_tpu.utils import tracing
 from distributed_model_parallel_tpu.utils.telemetry import registry
+from distributed_model_parallel_tpu.utils.tracing import span
 
 
 class EngineKilled(RuntimeError):
@@ -168,21 +170,27 @@ class Engine:
         the ``serve`` summary telemetry record)."""
         t0 = time.monotonic()
         try:
-            while not self.sched.idle():
-                if (max_iterations is not None
-                        and self._iterations >= max_iterations):
-                    break
-                now = time.monotonic() - t0
-                if self.step_hook is not None:
-                    self.step_hook(self._iterations)
-                self._iterations += 1
-                made_progress = self._iterate(now, t0)
-                if not made_progress:
-                    nxt = self.sched.next_arrival()
-                    if nxt is not None:
-                        # Open loop: nothing resident, next request not
-                        # arrived yet — sleep to its arrival.
-                        time.sleep(max(0.0, min(nxt - now, 0.05)))
+            # Spans from the loop (prefill chunks, decode rounds,
+            # admissions) go to this engine's own stream for the scope
+            # of the run — the request-lifecycle timeline
+            # scripts/dmp_trace.py renders next to the per-request
+            # serve records.
+            with tracing.sink_scope(self.telemetry):
+                while not self.sched.idle():
+                    if (max_iterations is not None
+                            and self._iterations >= max_iterations):
+                        break
+                    now = time.monotonic() - t0
+                    if self.step_hook is not None:
+                        self.step_hook(self._iterations)
+                    self._iterations += 1
+                    made_progress = self._iterate(now, t0)
+                    if not made_progress:
+                        nxt = self.sched.next_arrival()
+                        if nxt is not None:
+                            # Open loop: nothing resident, next request
+                            # not arrived yet — sleep to its arrival.
+                            time.sleep(max(0.0, min(nxt - now, 0.05)))
         except BaseException as e:
             self._fail_inflight(f"{type(e).__name__}: {e}")
             self._wall_s = time.monotonic() - t0
@@ -221,6 +229,11 @@ class Engine:
     # -- prefill ------------------------------------------------------------
 
     def _prefill_chunk(self, req: Request, t0: float) -> None:
+        with span("prefill_chunk", request=req.rid,
+                  cursor=req.prefill_cursor):
+            self._prefill_chunk_inner(req, t0)
+
+    def _prefill_chunk_inner(self, req: Request, t0: float) -> None:
         chunk = self.serve.prefill_chunk
         lo = req.prefill_cursor
         n_valid = min(chunk, req.prompt_len - lo)
@@ -246,6 +259,10 @@ class Engine:
     # -- decode -------------------------------------------------------------
 
     def _decode_round(self, decoding: list[Request], t0: float) -> None:
+        with span("decode_round", batch=len(decoding)):
+            self._decode_round_inner(decoding, t0)
+
+    def _decode_round_inner(self, decoding: list[Request], t0: float) -> None:
         b = self.serve.n_slots
         tokens = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)

@@ -28,8 +28,11 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import time
 from collections import deque
 from typing import Any
+
+from distributed_model_parallel_tpu.utils import tracing
 
 
 class RequestState(enum.Enum):
@@ -140,10 +143,21 @@ class Scheduler:
     def admit(self, now: float) -> list[Request]:
         """Move arrived queue-head requests into free slots (continuous),
         or refill the whole batch once it has fully drained (static).
-        Allocates every admitted request's full page reservation."""
+        Allocates every admitted request's full page reservation. An
+        admission that placed someone records a span (utils/tracing.py)
+        so the page-table writes show up on the engine timeline; idle
+        passes stay span-free (one per engine iteration would drown the
+        trace)."""
         if self.policy == "static" and any(
                 r is not None for r in self.slots):
             return []
+        # Clock reads only when a span could actually be recorded — this
+        # runs once per engine iteration, and the tracing-off contract is
+        # "no clock call" (utils/tracing.py).
+        trace = tracing.installed() is not None and tracing.enabled()
+        if trace:
+            t0m = time.monotonic()
+            t0w = time.time()
         admitted: list[Request] = []
         for slot in range(self.n_slots):
             if self.slots[slot] is not None:
@@ -161,6 +175,10 @@ class Scheduler:
             req.t_admitted = now
             self.slots[slot] = req
             admitted.append(req)
+        if admitted and trace:
+            tracing.record_span(
+                "admit", time.monotonic() - t0m, t0=t0w, n=len(admitted),
+                requests=",".join(r.rid for r in admitted))
         return admitted
 
     # -- iteration views ----------------------------------------------------

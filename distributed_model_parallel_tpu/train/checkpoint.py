@@ -21,6 +21,7 @@ from typing import Any, Callable
 import jax
 import orbax.checkpoint as ocp
 
+from distributed_model_parallel_tpu.utils.tracing import span
 from distributed_model_parallel_tpu.utils.faults import (
     FaultInjector,
     InjectedFaultError,
@@ -253,7 +254,14 @@ class Checkpointer:
     def save(self, tree: Any, name: str = "ckpt", *, force: bool = True,
              wait: bool = True, keep: int | None = None,
              meta: dict | None = None) -> str:
-        del force  # kept for API compatibility; versioning never overwrites
+        # Checkpoint I/O on the span timeline (utils/tracing.py): saves
+        # sit on a trainer's critical path, so a slow disk shows up as a
+        # wide checkpoint_save bar, not an anonymous step-time bump.
+        with span("checkpoint_save", slot=name, wait=wait):
+            return self._save(tree, name, wait=wait, keep=keep, meta=meta)
+
+    def _save(self, tree: Any, name: str, *, wait: bool,
+              keep: int | None, meta: dict | None) -> str:
         self.wait_until_finished()  # the previous save has committed...
         versions = self._versions(name)
         # Retention is strictly per-slot: the version scan matches
@@ -322,6 +330,12 @@ class Checkpointer:
         failure/recovery telemetry). CheckpointIntegrityError when no
         version survives.
         """
+        with span("checkpoint_restore", slot=name):
+            return self._restore(target, name, allow_fallback=allow_fallback,
+                                 on_fallback=on_fallback)
+
+    def _restore(self, target: Any, name: str, *, allow_fallback: bool,
+                 on_fallback: Callable[[str, str], None] | None) -> Any:
         self.wait_until_finished()
         candidates = self._candidate_paths(name)
         if not candidates:
@@ -403,6 +417,15 @@ class Checkpointer:
         layouts against the same slot and must not re-read a multi-GB
         checkpoint directory once per layout (train/elastic.py).
         """
+        with span("checkpoint_restore", slot=name, resharded=True):
+            return self._restore_resharded(
+                target, name, allow_fallback=allow_fallback,
+                on_fallback=on_fallback, verify_memo=verify_memo)
+
+    def _restore_resharded(self, target: Any, name: str, *,
+                           allow_fallback: bool,
+                           on_fallback: Callable[[str, str], None] | None,
+                           verify_memo: dict | None) -> Any:
         self.wait_until_finished()
         candidates = self._candidate_paths(name)
         if not candidates:

@@ -40,6 +40,7 @@ import dataclasses
 from typing import Any, Callable, Sequence
 
 from distributed_model_parallel_tpu.config import MeshConfig
+from distributed_model_parallel_tpu.utils import tracing
 from distributed_model_parallel_tpu.train.checkpoint import (
     Checkpointer,
     CheckpointIntegrityError,
@@ -183,6 +184,15 @@ def elastic_restore(ckpt: Checkpointer, templates: Sequence[Any],
     if not ordered:
         raise FileNotFoundError(
             f"no checkpoint under any of {tuple(names)} in {ckpt.directory}")
+    with tracing.span("elastic_restore", slots=",".join(ordered)):
+        return _elastic_restore_ladder(ckpt, templates, ordered,
+                                       on_fallback=on_fallback)
+
+
+def _elastic_restore_ladder(ckpt: Checkpointer, templates: Sequence[Any],
+                            ordered: Sequence[str], *,
+                            on_fallback: Callable[[str, str], None] | None
+                            ) -> tuple[str, Any]:
     verify_memo: dict = {}
     seen_fallbacks: set[str] = set()
 

@@ -29,6 +29,8 @@ from distributed_model_parallel_tpu.parallel.spmd_pipeline import (
 )
 from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
 from distributed_model_parallel_tpu.train.logging_util import RunLogger
+from distributed_model_parallel_tpu.utils import tracing
+from distributed_model_parallel_tpu.utils.tracing import span
 from distributed_model_parallel_tpu.train.metrics import AverageMeter, StepTimer
 from distributed_model_parallel_tpu.train.optim import make_optimizer
 
@@ -275,6 +277,9 @@ class LMTrainer:
                       pipeline_schedule=config.pipeline_schedule,
                       model_flops_per_step=lm_model_flops(
                           cfg, config.batch_size, config.seq_len)))
+        # Span sink for this thread (utils/tracing.py) — resume/checkpoint
+        # spans below land on this run's stream.
+        tracing.install(self.logger.telemetry)
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )
@@ -681,11 +686,12 @@ class LMTrainer:
             eval_now,
         )
 
-        loss_val = (self.evaluate()
-                    if self._eval_loss is not None
-                    and eval_now(epoch, epochs,
-                                 self.config.eval_every)
-                    else None)
+        if (self._eval_loss is not None
+                and eval_now(epoch, epochs, self.config.eval_every)):
+            with span("evaluate", epoch=epoch):
+                loss_val = self.evaluate()
+        else:
+            loss_val = None
         record = dict(epoch=epoch, loss_train=meter.avg,
                       loss_val=loss_val,
                       time_per_batch=timer.step.avg,
@@ -715,7 +721,8 @@ class LMTrainer:
             epoch = self.start_epoch
             while epoch < epochs:
                 try:
-                    record = self._train_one_epoch(epoch, epochs)
+                    with span("train_epoch", epoch=epoch):
+                        record = self._train_one_epoch(epoch, epochs)
                 except NonFiniteError as e:
                     if self.resilience.recover_nonfinite(
                             e, epoch=epoch, restore=self._restore_good,

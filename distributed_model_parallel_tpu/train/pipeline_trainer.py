@@ -28,6 +28,8 @@ from distributed_model_parallel_tpu.models import get_model
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineRunner
 from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
 from distributed_model_parallel_tpu.train.logging_util import RunLogger
+from distributed_model_parallel_tpu.utils import tracing
+from distributed_model_parallel_tpu.utils.tracing import span
 from distributed_model_parallel_tpu.train.metrics import AverageMeter, StepTimer
 from distributed_model_parallel_tpu.train.optim import make_optimizer
 from distributed_model_parallel_tpu.train.trainer import EpochResult, eval_now
@@ -131,6 +133,9 @@ class PipelineTrainer:
                       n_stages=len(self.devices),
                       num_microbatches=config.num_microbatches,
                       pipeline_schedule=config.pipeline_schedule))
+        # Span sink for this thread (utils/tracing.py) — resume/checkpoint
+        # spans below land on this run's stream.
+        tracing.install(self.logger.telemetry)
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )
@@ -397,7 +402,7 @@ class PipelineTrainer:
         def drain():
             # The blocking fetch is the sync point — guard it (stall watch
             # + metric finiteness; train/guards.py:GuardRunner).
-            with self.guards.watch():
+            with span("drain", n=len(pending)), self.guards.watch():
                 finalized = [(self.runner.finalize_metrics(mm, b), b)
                              for mm, b in pending]
             if self.guards.enabled and finalized:
@@ -508,7 +513,8 @@ class PipelineTrainer:
             epoch = self.start_epoch
             while epoch < epochs:
                 try:
-                    tr = self._run_epoch(epoch, train=True)
+                    with span("train_epoch", epoch=epoch):
+                        tr = self._run_epoch(epoch, train=True)
                 except NonFiniteError as e:
                     if self.resilience.recover_nonfinite(
                             e, epoch=epoch, restore=self._restore_good,
@@ -535,9 +541,11 @@ class PipelineTrainer:
                                           epoch,
                                           global_step=self._global_step)
                     break
-                ev = (self._run_epoch(epoch, train=False)
-                      if eval_now(epoch, epochs, self.config.eval_every)
-                      else None)
+                if eval_now(epoch, epochs, self.config.eval_every):
+                    with span("evaluate", epoch=epoch):
+                        ev = self._run_epoch(epoch, train=False)
+                else:
+                    ev = None
                 record = dict(epoch=epoch, loss_train=tr.loss,
                               acc1_train=tr.acc1,
                               loss_val=ev.loss if ev else None,

@@ -40,7 +40,7 @@ import time
 from typing import Any, Callable, Sequence
 
 from distributed_model_parallel_tpu.config import RecoveryConfig
-from distributed_model_parallel_tpu.utils import health
+from distributed_model_parallel_tpu.utils import health, tracing
 from distributed_model_parallel_tpu.utils.faults import FaultInjector, FaultSpec
 
 
@@ -282,8 +282,9 @@ class RecoverySupervisor:
         if not self.enabled:
             return
         try:
-            t0 = time.perf_counter()
-            self.ckpt.save(tree_fn(), self.slot, wait=True)
+            with tracing.span("good_save", slot=self.slot):
+                t0 = time.perf_counter()
+                self.ckpt.save(tree_fn(), self.slot, wait=True)
             # Checkpoint-I/O latency feeds the health score: a device
             # whose HBM reads crawl shows up here long before it NaNs.
             health.observe_io(self.device_ids, time.perf_counter() - t0)
@@ -323,7 +324,9 @@ class RecoverySupervisor:
             return False
         self.retries_left -= 1
         try:
-            restore()
+            with tracing.span("recovery_restore", slot=self.slot,
+                              label=label):
+                restore()
         except FileNotFoundError:
             self.logger.log_line(
                 f"resilience: no {self.slot!r} checkpoint to restore — "

@@ -46,7 +46,8 @@ from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
 from distributed_model_parallel_tpu.train.logging_util import RunLogger
 from distributed_model_parallel_tpu.train.metrics import AverageMeter, StepTimer, topk_correct
 from distributed_model_parallel_tpu.train.optim import make_optimizer
-from distributed_model_parallel_tpu.utils import health
+from distributed_model_parallel_tpu.utils import health, tracing
+from distributed_model_parallel_tpu.utils.tracing import span
 
 
 def _filter_expected_batch_donation_warnings() -> None:
@@ -502,6 +503,11 @@ class Trainer:
                       mesh=config.mesh.axis_sizes(),
                       steps_per_dispatch=config.steps_per_dispatch
                       if config.device_resident_data else 1))
+        # Span sink for this thread (utils/tracing.py): every span opened
+        # while this trainer runs — including the resume/restore below and
+        # checkpoint I/O deep in train/checkpoint.py — lands on this run's
+        # stream (and inherits its tenant tag under the orchestrator).
+        tracing.install(self.logger.telemetry)
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )
@@ -914,7 +920,7 @@ class Trainer:
         at its cadence, fingerprints + repairs the live state
         (train/consistency.py).
         """
-        with self.guards.watch():
+        with span("drain", n=len(pending)), self.guards.watch():
             host = jax.device_get(pending)
         if host and (self.guards.enabled
                      or (sentinel and self.sentinel.enabled)):
@@ -1184,7 +1190,8 @@ class Trainer:
             epoch = self.start_epoch
             while epoch < epochs:
                 try:
-                    tr = self.train_epoch(epoch)
+                    with span("train_epoch", epoch=epoch):
+                        tr = self.train_epoch(epoch)
                 except NonFiniteError as e:
                     if self.resilience.recover_nonfinite(
                             e, epoch=epoch, restore=self._restore_good,
@@ -1210,9 +1217,11 @@ class Trainer:
                                           self.logger, epoch,
                                           global_step=self._global_step)
                     break
-                ev = (self.evaluate()
-                      if eval_now(epoch, epochs, self.config.eval_every)
-                      else None)
+                if eval_now(epoch, epochs, self.config.eval_every):
+                    with span("evaluate", epoch=epoch):
+                        ev = self.evaluate()
+                else:
+                    ev = None
                 record = dict(epoch=epoch, loss_train=tr.loss,
                               acc1_train=tr.acc1,
                               loss_val=ev.loss if ev else None,

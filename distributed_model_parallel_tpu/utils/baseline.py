@@ -1,0 +1,386 @@
+"""Cross-run performance baseline ledger + regression gate.
+
+The perf trajectory sat flat at MFU ~0.08 through BENCH r01-r04 and no
+machine noticed, because every artifact was judged in isolation. This
+module gives the repo a memory: a **JSONL ledger** of headline metrics
+per ``(metric, plan-payload)`` key — seeded from the checked-in
+``BENCH_*.json`` / ``MULTICHIP_*.json`` artifacts and appended by green
+runs — and a **gate** that compares a fresh run against the ledger's
+recent history with a noise band, so a slowdown fails loudly instead of
+shipping as the new normal.
+
+Gate policy (docs/TRACING.md "The regression gate"):
+
+* tracked metrics: ``throughput`` (samples/s/chip or tokens/s/chip —
+  the bench headline ``value``), ``mfu``, ``ttft_p99_s``,
+  ``token_latency_p99_s``, ``step_time_p50_s`` (p50 over the stream's
+  ``step`` records);
+* baseline = the **green** ledger entries sharing the fresh run's key
+  (same headline metric AND the same parallel plan payload — a dp4
+  number must never gate a dp8 run; entries predating plan embedding
+  match by metric name alone);
+* noise band = median ± ``k``·(1.4826·MAD) over the last ``history``
+  green values, floored at ``rel_floor``·|median| (a short or perfectly
+  repeatable history has MAD 0 — without the floor every run would trip
+  on measurement jitter);
+* regression = worse than the band edge in the metric's bad direction
+  (lower throughput/MFU, higher latency). No baseline for a key means
+  no verdict — the gate reports it and passes (you cannot regress
+  against nothing);
+* every verdict is written as ONE typed ``gate`` telemetry record, and
+  a flagged regression carries an **attribution**: the span (or
+  step-phase) whose share of the run's time grew most vs the baseline
+  entry — the "where to look first" pointer (utils/tracing.py).
+
+``scripts/dmp_gate.py`` is the CLI; ``bench.py`` runs the gate
+automatically after every headline measurement (warn-only by default,
+``DMP_BENCH_GATE=strict`` exits nonzero).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from statistics import median
+from typing import Any, Iterable
+
+__all__ = [
+    "DEFAULT_K",
+    "DEFAULT_REL_FLOOR",
+    "GATE_METRICS",
+    "append_entries",
+    "emit_gate_record",
+    "entries_from_points",
+    "entry_key",
+    "extract_points",
+    "gate_points",
+    "ingest_artifact",
+    "load_ledger",
+    "phase_shares",
+    "span_shares",
+]
+
+# metric name -> True when higher is better.
+GATE_METRICS: dict[str, bool] = {
+    "throughput": True,
+    "mfu": True,
+    "ttft_p99_s": False,
+    "token_latency_p99_s": False,
+    "step_time_p50_s": False,
+}
+
+DEFAULT_K = 3.0
+DEFAULT_REL_FLOOR = 0.05
+DEFAULT_HISTORY = 8
+
+
+def _canon_plan(plan: Any) -> str:
+    return json.dumps(plan, sort_keys=True) if plan else ""
+
+
+def entry_key(metric: str, plan: Any) -> str:
+    """The ledger key: headline metric name + canonicalized plan payload
+    (autotune/plan.plan_payload — strategy + axis degrees). Two runs
+    compare only when they measured the same thing on the same layout."""
+    canon = _canon_plan(plan)
+    return f"{metric}|{canon}" if canon else str(metric)
+
+
+# ---------------------------------------------------------------------------
+# Ledger I/O
+# ---------------------------------------------------------------------------
+
+def load_ledger(path: str) -> list[dict]:
+    """All ledger entries, oldest first; ``[]`` when the file does not
+    exist yet. Torn lines are skipped with the same warning counter as
+    any telemetry stream (a ledger is itself an append-only JSONL
+    stream a killed run may tear)."""
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+    try:
+        return read_records(path)
+    except FileNotFoundError:
+        return []
+
+
+def append_entries(path: str, entries: Iterable[dict]) -> int:
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    n = 0
+    with open(path, "a") as f:
+        for e in entries:
+            f.write(json.dumps(e, sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Seeding: the checked-in BENCH_*.json / MULTICHIP_*.json artifacts
+# ---------------------------------------------------------------------------
+
+def ingest_artifact(path: str) -> list[dict]:
+    """Ledger entries from one committed bench artifact.
+
+    * a BENCH artifact with a ``parsed`` headline record becomes a green
+      entry keyed by its metric (+plan when embedded — r01-r05 predate
+      plan embedding and match by metric name);
+    * a failed artifact (``rc != 0`` / no measurement) becomes a
+      **non-green** entry: the hole in the trajectory is recorded, never
+      used as a baseline;
+    * a MULTICHIP dry-run artifact (no headline number) becomes a
+      presence entry keyed ``multichip`` with its ``ok`` verdict.
+    """
+    with open(path) as f:
+        data = json.load(f)
+    source = os.path.basename(path)
+    ts = os.path.getmtime(path)
+    if "n_devices" in data and "parsed" not in data:     # MULTICHIP dryrun
+        return [{
+            "ts": ts, "key": "multichip", "metric": "multichip",
+            "workload": "multichip", "unit": None, "plan": None,
+            "green": bool(data.get("ok")) and data.get("rc", 1) == 0,
+            "source": source, "metrics": {},
+        }]
+    parsed = data.get("parsed") or {}
+    value = parsed.get("value")
+    if data.get("rc", 0) != 0 or value is None:
+        return [{
+            "ts": ts, "key": "bench-failure", "metric": parsed.get("metric"),
+            "workload": None, "unit": parsed.get("unit"), "plan": None,
+            "green": False, "source": source,
+            "metrics": {}, "error": parsed.get("error", f"rc {data.get('rc')}"),
+        }]
+    metrics: dict[str, float] = {"throughput": float(value)}
+    for src, dst in (("mfu", "mfu"), ("ttft_p99_s", "ttft_p99_s"),
+                     ("token_latency_p99_s", "token_latency_p99_s")):
+        v = parsed.get(src)
+        if isinstance(v, (int, float)):
+            metrics[dst] = float(v)
+    plan = parsed.get("plan")
+    phases = (parsed.get("step_phase") or {}).get("phases")
+    return [{
+        "ts": ts, "key": entry_key(parsed["metric"], plan),
+        "metric": parsed["metric"], "workload": None,
+        "unit": parsed.get("unit"), "plan": plan, "green": True,
+        "source": source, "metrics": metrics,
+        "phases": phases if phases else None,
+    }]
+
+
+# ---------------------------------------------------------------------------
+# Fresh-run extraction
+# ---------------------------------------------------------------------------
+
+def span_shares(records: list[dict]) -> dict[str, float] | None:
+    """Per-span-name share of total span time over a stream — the
+    fingerprint the gate diffs to say WHICH phase grew. All spans count
+    (shares are of the summed span time, parents and children alike), so
+    a child span growing shows up even when its parent absorbs it."""
+    totals: dict[str, float] = {}
+    for r in records:
+        if r.get("kind") != "span":
+            continue
+        d = r.get("dur_s")
+        if isinstance(d, (int, float)):
+            totals[str(r.get("name"))] = totals.get(str(r.get("name")),
+                                                    0.0) + float(d)
+    s = sum(totals.values())
+    if s <= 0:
+        return None
+    return {k: v / s for k, v in sorted(totals.items())}
+
+
+def phase_shares(phases: dict | None) -> dict[str, float] | None:
+    """Shares over a ``step_phase`` record's ``*_s`` keys."""
+    if not phases:
+        return None
+    vals = {k: float(v) for k, v in phases.items()
+            if k.endswith("_s") and isinstance(v, (int, float))}
+    s = sum(vals.values())
+    if s <= 0:
+        return None
+    return {k: v / s for k, v in sorted(vals.items())}
+
+
+def _median_of(xs: list[float]) -> float | None:
+    return median(xs) if xs else None
+
+
+def extract_points(records: list[dict]) -> list[dict]:
+    """Headline measurement points from a telemetry stream.
+
+    Every ``bench`` record becomes one point (keyed by its metric +
+    embedded plan). A stream without bench records (a trainer run)
+    yields one point keyed by its ``run_start`` run name + mesh — so the
+    gate also works on plain training streams, not only bench ones.
+    Each point carries the stream-level ``step_time_p50_s`` and the
+    span/phase share fingerprints for attribution.
+    """
+    by_kind: dict[str, list[dict]] = {}
+    for r in records:
+        by_kind.setdefault(str(r.get("kind")), []).append(r)
+    step_times = [r["step_time_s"] for r in by_kind.get("step", [])
+                  if isinstance(r.get("step_time_s"), (int, float))]
+    samples = [r["samples_per_s"] for r in by_kind.get("step", [])
+               if isinstance(r.get("samples_per_s"), (int, float))]
+    tokens = [r["tokens_per_s"] for r in by_kind.get("step", [])
+              if isinstance(r.get("tokens_per_s"), (int, float))]
+    # A stream carrying BOTH units (a fleet merge of CNN + LM tenants)
+    # has no single throughput number — a median over a mixed-unit pool
+    # would be a meaningless baseline, so the fallback point then gates
+    # on step time only.
+    thr_samples = (samples if samples and not tokens
+                   else tokens if tokens and not samples else [])
+    step_p50 = _median_of(step_times)
+    spans = span_shares(records)
+    last_phase = (by_kind.get("step_phase") or [{}])[-1].get("phases")
+    points: list[dict] = []
+    for b in by_kind.get("bench", []):
+        if b.get("value") is None:
+            continue
+        metrics: dict[str, float] = {"throughput": float(b["value"])}
+        for k in ("mfu", "ttft_p99_s", "token_latency_p99_s"):
+            if isinstance(b.get(k), (int, float)):
+                metrics[k] = float(b[k])
+        if step_p50 is not None:
+            metrics["step_time_p50_s"] = step_p50
+        points.append({
+            "metric": b.get("metric"), "unit": b.get("unit"),
+            "plan": b.get("plan"),
+            "key": entry_key(b.get("metric"), b.get("plan")),
+            "metrics": metrics, "span_shares": spans,
+            "phases": (b.get("step_phase") or {}).get("phases")
+            or last_phase,
+        })
+    if not points and (step_p50 is not None or thr_samples):
+        start = (by_kind.get("run_start") or [{}])[-1]
+        meta = start.get("meta") or {}
+        metric = (f"run_{start.get('run', 'unknown')}"
+                  f"_{meta.get('workload', 'unknown')}")
+        metrics = {}
+        if step_p50 is not None:
+            metrics["step_time_p50_s"] = step_p50
+        m = _median_of(sorted(thr_samples))
+        if m is not None:
+            metrics["throughput"] = m
+        points.append({
+            "metric": metric, "unit": None,
+            "plan": {"mesh": meta.get("mesh")} if meta.get("mesh") else None,
+            "key": entry_key(metric,
+                             {"mesh": meta.get("mesh")}
+                             if meta.get("mesh") else None),
+            "metrics": metrics, "span_shares": spans, "phases": last_phase,
+        })
+    return points
+
+
+def entries_from_points(points: list[dict], *, green: bool,
+                        source: str) -> list[dict]:
+    """Ledger entries for a fresh run's points (appended after a green
+    gate, so the observatory's history grows one honest sample per
+    run)."""
+    return [{
+        "ts": time.time(), "key": p["key"], "metric": p["metric"],
+        "workload": None, "unit": p.get("unit"), "plan": p.get("plan"),
+        "green": bool(green), "source": source, "metrics": p["metrics"],
+        "span_shares": p.get("span_shares"),
+        "phases": p.get("phases"),
+    } for p in points]
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+def _attribution(point: dict, baseline_entry: dict) -> dict | None:
+    """Which span's (else step-phase's) share of the run grew most vs
+    the baseline — the pointer a flagged regression starts from."""
+    for field, label in (("span_shares", "span"), ("phases", "phase")):
+        fresh = (point.get(field) if field == "span_shares"
+                 else phase_shares(point.get("phases")))
+        base = (baseline_entry.get(field) if field == "span_shares"
+                else phase_shares(baseline_entry.get("phases")))
+        if not fresh or not base:
+            continue
+        deltas = {k: fresh.get(k, 0.0) - base.get(k, 0.0)
+                  for k in set(fresh) | set(base)}
+        name, delta = max(deltas.items(), key=lambda kv: kv[1])
+        if delta > 0:
+            return {label: name, "share": round(fresh.get(name, 0.0), 4),
+                    "baseline_share": round(base.get(name, 0.0), 4),
+                    "grew_by": round(delta, 4)}
+    return None
+
+
+def gate_points(points: list[dict], ledger: list[dict], *,
+                k: float = DEFAULT_K, rel_floor: float = DEFAULT_REL_FLOOR,
+                history: int = DEFAULT_HISTORY) -> dict:
+    """Compare fresh measurement points against the ledger.
+
+    Returns ``{ok, regressions: [...], verdicts: [...], no_baseline:
+    [...], k, rel_floor}`` — the payload of the typed ``gate`` record.
+    Each verdict: ``{key, metric, value, baseline, tolerance, n_history,
+    ok}`` (``metric`` is ``<headline>:<tracked metric>``).
+    """
+    verdicts: list[dict] = []
+    regressions: list[dict] = []
+    no_baseline: list[str] = []
+    for pt in points:
+        hist = [e for e in ledger if e.get("green")
+                and e.get("key") == pt["key"] and e.get("metrics")]
+        if not hist:
+            # Entries predating plan embedding (BENCH r01-r05) carry no
+            # plan; ONLY those match by headline metric name — an entry
+            # measured under a *different* plan payload must never gate
+            # this one (a dp4 number is not a dp8 baseline).
+            hist = [e for e in ledger if e.get("green")
+                    and e.get("metric") == pt["metric"] and e.get("metrics")
+                    and e.get("plan") is None]
+        if not hist:
+            no_baseline.append(pt["key"])
+            continue
+        point_reg = None
+        for mname, higher_better in GATE_METRICS.items():
+            fresh = pt["metrics"].get(mname)
+            vals = [e["metrics"].get(mname) for e in hist[-history:]]
+            vals = [float(v) for v in vals if isinstance(v, (int, float))]
+            if not isinstance(fresh, (int, float)) or not vals:
+                continue
+            med = median(vals)
+            mad = median([abs(v - med) for v in vals])
+            tol = max(k * 1.4826 * mad, rel_floor * abs(med))
+            worse = (fresh < med - tol) if higher_better \
+                else (fresh > med + tol)
+            v = {"key": pt["key"], "metric": f"{pt['metric']}:{mname}",
+                 "value": round(float(fresh), 6), "baseline": round(med, 6),
+                 "tolerance": round(tol, 6), "n_history": len(vals),
+                 "ok": not worse}
+            verdicts.append(v)
+            if worse:
+                regressions.append(v)
+                point_reg = point_reg or v
+        if point_reg is not None:
+            point_reg["attribution"] = _attribution(pt, hist[-1])
+    return {
+        "ok": not regressions,
+        "regressions": regressions,
+        "verdicts": verdicts,
+        "no_baseline": no_baseline,
+        "k": k, "rel_floor": rel_floor,
+    }
+
+
+def emit_gate_record(sink, result: dict, *, ledger_path: str) -> None:
+    """Write the verdict as one typed ``gate`` record. ``sink`` is a
+    live TelemetryRun (bench) or a stream path (the CLI appending to a
+    finished run's stream — a raw JSONL line with the same schema, no
+    second ``run_start`` header)."""
+    fields = dict(result, ledger=ledger_path)
+    if hasattr(sink, "record"):
+        sink.record("gate", **fields)
+        return
+    line = json.dumps({"ts": time.time(), "kind": "gate", **fields},
+                      default=str)
+    with open(sink, "a") as f:
+        f.write(line + "\n")

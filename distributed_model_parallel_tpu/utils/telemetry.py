@@ -80,6 +80,18 @@ serve      event (completed | failed | summary) plus the per-request
            the serving engine's records (serve/engine.py; a failed
            event carries the typed ``engine-killed`` error, never a
            silent drop)
+span       name, t0 (wall-clock start), dur_s (monotonic duration),
+           sid, parent, depth, thread, plus site attrs — one timed
+           interval from the span API (utils/tracing.py): trainer
+           epochs/drains/evals, checkpoint I/O, engine prefill chunks
+           and decode rounds, orchestrator rounds; ``ts`` is the
+           wall-clock end. scripts/dmp_trace.py renders these as a
+           zoomable Chrome/Perfetto timeline
+gate       ok, regressions [{metric, value, baseline, tolerance}],
+           attribution {span|phase, share, baseline_share} — one
+           cross-run perf-regression-gate verdict (utils/baseline.py,
+           scripts/dmp_gate.py) comparing this run's headline metrics
+           against the baseline ledger's noise band
 ========== ==========================================================
 """
 
@@ -105,8 +117,10 @@ __all__ = [
     "device_memory_snapshot",
     "install_compile_tracking",
     "merge_streams",
+    "read_records",
     "record_collective",
     "registry",
+    "stream_parts",
     "tenant_scope",
     "wire_bytes_estimate",
     "wire_ops_estimate",
@@ -521,7 +535,17 @@ def merge_streams(paths: Iterable[str]) -> list[dict]:
     are skipped (a tenant killed before its header wrote nothing)."""
     merged: list[tuple[float, int, dict]] = []
     order = 0
+    paths = list(paths)
+    # A shell glob over a rotated stream lists run.jsonl AND its
+    # run.N.jsonl parts; read_records(run.jsonl) already folds the parts
+    # in, so a listed path that is some other listed path's rotation
+    # part must be skipped or its records would merge twice.
+    absorbed = {os.path.abspath(part)
+                for p in paths for part in stream_parts(p)
+                if os.path.abspath(part) != os.path.abspath(p)}
     for path in paths:
+        if os.path.abspath(path) in absorbed:
+            continue
         try:
             records = read_records(path)
         except FileNotFoundError:
@@ -573,8 +597,28 @@ class TelemetryRun:
                  registry_: MetricsRegistry | None = None,
                  track_compiles: bool = True,
                  device: Mapping[str, Any] | None = None,
-                 tenant: str | None = None):
+                 tenant: str | None = None,
+                 max_bytes: int | None = None):
         self.path = path
+        # Stream rotation for long runs: once the live file would exceed
+        # ``max_bytes`` it is renamed to the next ``{stem}.N.jsonl`` part
+        # and appends continue on a fresh file, so a long-mode soak
+        # campaign cannot grow one unbounded stream. read_records /
+        # merge_streams / the report glob the parts back in order
+        # (stream_parts). Default: env DMP_TELEMETRY_MAX_BYTES, else off.
+        if max_bytes is None:
+            env = os.environ.get("DMP_TELEMETRY_MAX_BYTES")
+            max_bytes = int(env) if env else None
+        if max_bytes is not None and max_bytes < 4096:
+            raise ValueError(
+                f"max_bytes={max_bytes} would rotate on nearly every "
+                f"record (one run_start header is hundreds of bytes); "
+                f"use >= 4096 or None")
+        self.max_bytes = max_bytes
+        try:
+            self._bytes = os.path.getsize(path)   # resumed stream appends
+        except OSError:
+            self._bytes = 0
         # Tenant tag: explicit, or inherited from the thread's
         # tenant_scope (how the orchestrator tags trainer-opened streams
         # without the trainers knowing). Stamped on every record.
@@ -582,7 +626,10 @@ class TelemetryRun:
         self.registry = registry_ if registry_ is not None else registry()
         self._lock = threading.Lock()
         self._finished = False
-        self._t0 = time.time()
+        # Monotonic pair for the run_end wall_s duration: an NTP step
+        # mid-run must not skew it (record ``ts`` stamps stay wall-clock
+        # for cross-stream correlation).
+        self._t0 = time.monotonic()
         # Counter baseline at stream open: the registry is process-global,
         # so a second run in the same process must not inherit the first
         # run's collective-volume / compile counts in its metrics record.
@@ -621,8 +668,25 @@ class TelemetryRun:
                            **{k: _coerce(v) for k, v in fields.items()}},
                           default=str)
         with self._lock:
+            n = len(line.encode("utf-8")) + 1    # bytes written, not chars
+            if (self.max_bytes is not None and self._bytes > 0
+                    and self._bytes + n > self.max_bytes):
+                self._rotate()
             with open(self.path, "a") as f:
                 f.write(line + "\n")
+            self._bytes += n
+
+    def _rotate(self) -> None:
+        """Rename the live file to the next ``{stem}.N.jsonl`` part
+        (called under the record lock)."""
+        stem, ext = os.path.splitext(self.path)
+        existing = _part_indices(self.path)
+        nxt = (max(existing) + 1) if existing else 1
+        try:
+            os.replace(self.path, f"{stem}.{nxt}{ext}")
+        except OSError:
+            return          # rotation is best-effort; keep appending
+        self._bytes = 0
 
     def step(self, **fields) -> None:
         """One training/bench step (or drain window) worth of timings.
@@ -701,20 +765,62 @@ class TelemetryRun:
             return
         self._finished = True
         self.metrics()
-        self.record("run_end", wall_s=time.time() - self._t0, **fields)
+        self.record("run_end", wall_s=time.monotonic() - self._t0, **fields)
+
+
+def _part_indices(path: str) -> list[int]:
+    """Existing rotation-part indices for a logical stream path."""
+    import re
+
+    stem, ext = os.path.splitext(os.path.basename(path))
+    parent = os.path.dirname(os.path.abspath(path))
+    pat = re.compile(re.escape(stem) + r"\.(\d+)" + re.escape(ext) + r"$")
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return []
+    return sorted(int(m.group(1)) for e in entries
+                  for m in [pat.match(e)] if m)
+
+
+def stream_parts(path: str) -> list[str]:
+    """Every on-disk file of a logical stream, oldest first: the rotated
+    ``{stem}.N.jsonl`` parts in numeric order, then the live file. A
+    never-rotated stream is just ``[path]``."""
+    stem, ext = os.path.splitext(path)
+    out = [f"{stem}.{i}{ext}" for i in _part_indices(path)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
 
 
 def read_records(path: str) -> list[dict]:
-    """Parse a telemetry JSONL file, skipping truncated trailing lines
-    (a killed run may leave a partial final record)."""
+    """Parse a telemetry JSONL stream — all rotated parts in order, then
+    the live file — skipping any truncated/corrupt line (a run killed
+    mid-write leaves a partial final record; it must cost a warning, not
+    poison a whole fleet merge). Every skipped line increments the
+    ``telemetry_torn_lines`` counter and one stderr warning names the
+    file. FileNotFoundError when no part of the stream exists."""
+    import sys
+
+    parts = stream_parts(path)
+    if not parts:
+        raise FileNotFoundError(path)
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+    for part in parts:
+        torn = 0
+        with open(part) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    torn += 1
+        if torn:
+            registry().counter("telemetry_torn_lines").inc(torn)
+            print(f"[telemetry] {part}: skipped {torn} unparseable "
+                  f"line(s) (torn tail from a killed run?)",
+                  file=sys.stderr)
     return out

@@ -1,0 +1,220 @@
+"""Span tracing: causal host timelines on the telemetry stream.
+
+The telemetry layer records *points* (step/epoch/failure records) and
+*aggregates* (counters, histograms) — but nothing says what a run was
+doing *between* the points, or which phase inside a step/request/round
+the wall time went to. This module is the missing interval primitive:
+
+* :func:`span` — a ``with``-statement context manager (usable as a
+  decorator) that measures one named interval with ``time.monotonic()``
+  (NTP-immune durations; the record's wall-clock ``t0``/``ts`` stay on
+  ``time.time()`` for cross-stream correlation) and writes one typed
+  ``span`` record onto the thread's bound :class:`~.telemetry.TelemetryRun`;
+* a **thread-local span stack**: spans opened inside spans record their
+  parent id and depth, so the trainer's ``train_epoch`` > ``drain`` >
+  ``checkpoint_save`` nesting is explicit in the stream and renders as
+  nested bars in the Chrome-trace export (``scripts/dmp_trace.py``);
+* :func:`install` / :func:`sink_scope` — per-thread sink binding. The
+  trainers install their run stream at construction (so resume/restore
+  spans land too); the serving engine and orchestrator bind theirs for
+  the scope of a run/round. Thread-local binding is what makes this
+  tenant-correct: the orchestrator runs each tenant's trainer on its own
+  thread inside a ``tenant_scope``, so every span lands on that tenant's
+  stream and inherits its ``tenant`` tag without the instrumentation
+  sites knowing tenancy exists.
+
+Overhead contract: with no sink bound (or tracing disabled via
+``DMP_TRACING=0`` / :func:`set_enabled`) a span is a no-op — two
+attribute reads, no allocation, no clock call. With a sink bound the
+cost is one JSONL append per span; instrumentation sites are chosen at
+window/epoch/round granularity (never inside the async dispatch hot
+loop), and tests/test_tracing.py asserts the measured per-span cost
+stays under 2% of the CPU perf smoke's p50 step time.
+
+Record schema (see docs/TRACING.md and the OBSERVABILITY.md record
+table): ``{kind: "span", name, t0, dur_s, sid, parent, depth, thread,
+**attrs}`` where ``t0`` is the wall-clock start (unix seconds), ``ts``
+(stamped by TelemetryRun at write) the wall-clock end, and ``dur_s`` the
+monotonic-clock duration.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = [
+    "enabled",
+    "install",
+    "installed",
+    "record_span",
+    "set_enabled",
+    "sink_scope",
+    "span",
+    "uninstall",
+]
+
+_state = threading.local()
+_ids = itertools.count(1)       # process-unique span ids (GIL-atomic)
+_enabled = os.environ.get("DMP_TRACING", "1") != "0"
+
+
+def enabled() -> bool:
+    """Is span recording globally enabled (``DMP_TRACING``, default on)?
+    A disabled process still *runs* every instrumented site — spans just
+    skip the stack push and the record write."""
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Flip span recording process-wide (the on/off lever the overhead
+    comparison in tests/test_tracing.py uses)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def install(sink) -> None:
+    """Bind ``sink`` (a :class:`~.telemetry.TelemetryRun`, or anything
+    with ``.record(kind, **fields)``) as THIS thread's span sink. The
+    trainers call this at construction with their run stream; a later
+    install on the same thread replaces the binding (last trainer wins —
+    exactly the stream the thread is currently writing)."""
+    _state.sink = sink
+
+
+def installed():
+    """This thread's bound span sink (None when spans are dropped)."""
+    return getattr(_state, "sink", None)
+
+
+def uninstall() -> None:
+    _state.sink = None
+
+
+class sink_scope:
+    """Bind a sink for a scope, restoring the previous binding on exit:
+    ``with tracing.sink_scope(run): ...``. A ``None`` sink leaves the
+    current binding in place (the serving engine runs with or without a
+    telemetry stream attached)."""
+
+    def __init__(self, sink):
+        self.sink = sink
+        self._prev = None
+
+    def __enter__(self):
+        if self.sink is not None:
+            self._prev = installed()
+            install(self.sink)
+        return self
+
+    def __exit__(self, *exc):
+        if self.sink is not None:
+            install(self._prev)
+        return False
+
+
+def _stack() -> list:
+    st = getattr(_state, "stack", None)
+    if st is None:
+        st = _state.stack = []
+    return st
+
+
+def record_span(name: str, dur_s: float, *, t0: float | None = None,
+                sink=None, **attrs: Any) -> None:
+    """Imperative form: write one ``span`` record for an interval timed
+    by the caller (sites where the span's worth is only known at the end
+    — e.g. the scheduler's admit pass records a span only when it
+    admitted someone, not once per idle iteration). Parent/depth come
+    from the thread's live span stack, so imperative spans nest under
+    whatever ``with span(...)`` is open."""
+    sink = sink if sink is not None else installed()
+    if sink is None or not _enabled:
+        return
+    st = _stack()
+    parent = st[-1][0] if st else None
+    try:
+        sink.record("span", name=name,
+                    t0=t0 if t0 is not None else time.time() - dur_s,
+                    dur_s=dur_s, sid=next(_ids), parent=parent,
+                    depth=len(st),
+                    thread=threading.current_thread().name, **attrs)
+    except Exception:
+        # A stale/unwritable sink must not take down the recording site:
+        # spans are observability, not control flow.
+        pass
+
+
+class span:
+    """``with span("drain", n=3): ...`` — or ``@span("evaluate")`` as a
+    decorator (each call gets its own span). Attributes land on the
+    record; :meth:`annotate` adds more from inside the body. An
+    exception inside the span still writes the record, with
+    ``error=<ExceptionType>`` — a timeline that loses its crashing span
+    hides exactly the interval being debugged."""
+
+    __slots__ = ("name", "attrs", "_sink", "_sid", "_parent", "_depth",
+                 "_t0m", "_t0w")
+
+    def __init__(self, name: str, **attrs: Any):
+        self.name = name
+        self.attrs = attrs
+        self._sink = None
+
+    def __enter__(self):
+        sink = installed()
+        if sink is None or not _enabled:
+            self._sink = None
+            return self
+        self._sink = sink
+        st = _stack()
+        self._parent = st[-1][0] if st else None
+        self._depth = len(st)
+        self._sid = next(_ids)
+        st.append((self._sid, self.name))
+        self._t0w = time.time()
+        self._t0m = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sink is None:
+            return False
+        dur = time.monotonic() - self._t0m
+        st = _stack()
+        # Pop our own frame; a mispaired stack (a site that leaked spans
+        # across threads) must not corrupt later spans' parents.
+        while st and st[-1][0] != self._sid:
+            st.pop()
+        if st:
+            st.pop()
+        fields = dict(self.attrs)
+        if exc_type is not None:
+            fields["error"] = exc_type.__name__
+        try:
+            self._sink.record("span", name=self.name, t0=self._t0w,
+                              dur_s=dur, sid=self._sid, parent=self._parent,
+                              depth=self._depth,
+                              thread=threading.current_thread().name,
+                              **fields)
+        except Exception:
+            # A full disk / closed stream must not take down the traced
+            # run: spans are observability, not control flow.
+            pass
+        self._sink = None
+        return False
+
+    def annotate(self, **attrs: Any) -> None:
+        """Add attributes from inside the body (values computed by the
+        spanned work itself, e.g. how many batches a drain folded)."""
+        self.attrs.update(attrs)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with span(self.name, **self.attrs):
+                return fn(*args, **kwargs)
+        return wrapped

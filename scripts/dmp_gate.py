@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Cross-run perf regression gate: fail the run when it got slower.
+
+Compares a fresh run's telemetry stream (or a committed ``BENCH_*.json``
+artifact) against the baseline ledger's recent green history with a
+noise band (median ± k·MAD, floored at ``--rel-floor`` of the median —
+utils/baseline.py), writes one typed ``gate`` record onto the stream
+naming the offending metric and the span/phase whose share grew most,
+and exits nonzero on regression. This is ROADMAP item 4's "make speed a
+regression gate" as a command:
+
+Usage:
+  # seed the ledger once from the checked-in artifacts
+  python scripts/dmp_gate.py --seed 'BENCH_*.json' 'MULTICHIP_*.json' \
+      --ledger BASELINE_LEDGER.jsonl
+
+  # gate a fresh bench/trainer stream (rc 1 on regression)
+  python scripts/dmp_gate.py /tmp/dmp_bench_log/bench_telemetry.jsonl
+
+  # gate and, when green, append this run to the ledger
+  python scripts/dmp_gate.py log/lm.jsonl --update
+
+Exit codes: 0 pass (or warn-only), 1 regression, 2 nothing to gate
+(no measurable records in the stream). ``bench.py`` runs this gate
+automatically after every headline measurement (warn-only by default,
+``DMP_BENCH_GATE=strict`` to fail).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_model_parallel_tpu.utils import baseline  # noqa: E402
+from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    read_records,
+)
+
+DEFAULT_LEDGER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BASELINE_LEDGER.jsonl")
+
+
+def _is_artifact(path: str) -> bool:
+    """A committed bench artifact is ONE json object; a telemetry stream
+    is JSONL whose records carry ``kind``. Sniff the first line (however
+    long): a complete record with ``kind`` is a stream; a lone
+    kind-less object (compact artifact) or a multi-line object that
+    only parses whole (pretty-printed artifact) is an artifact."""
+    with open(path) as f:
+        first = f.readline()
+        rest = f.read(1)
+    try:
+        obj = json.loads(first)
+        if isinstance(obj, dict) and "kind" in obj:
+            return False                       # a telemetry record
+        return not rest                        # single-line whole object
+    except json.JSONDecodeError:
+        pass
+    try:
+        with open(path) as f:
+            json.load(f)
+        return True                            # pretty-printed artifact
+    except json.JSONDecodeError:
+        return False                           # torn stream: JSONL path
+
+
+def seed(ledger_path: str, patterns: list[str]) -> int:
+    """Ingest committed artifacts into the ledger, skipping sources
+    already present (idempotent — re-seeding must not double history)."""
+    existing = {e.get("source") for e in baseline.load_ledger(ledger_path)}
+    added = 0
+    for pat in patterns:
+        for path in sorted(globlib.glob(pat)):
+            if os.path.basename(path) in existing:
+                continue
+            added += baseline.append_entries(
+                ledger_path, baseline.ingest_artifact(path))
+    return added
+
+
+def describe(result: dict) -> str:
+    lines = []
+    for v in result["verdicts"]:
+        band = (f"baseline {v['baseline']:g} ± {v['tolerance']:g} "
+                f"(n={v['n_history']})")
+        mark = "ok " if v["ok"] else "REGRESSED"
+        lines.append(f"  {mark} {v['metric']:<52} {v['value']:g} vs {band}")
+        attr = v.get("attribution")
+        if attr:
+            what = attr.get("span") or attr.get("phase")
+            kind = "span" if "span" in attr else "phase"
+            lines.append(
+                f"      -> {kind} {what!r} grew "
+                f"{attr['baseline_share']:.1%} -> {attr['share']:.1%} "
+                f"of the run — look there first")
+    for key in result["no_baseline"]:
+        lines.append(f"  --  {key}: no green baseline in the ledger "
+                     f"(first run for this key — nothing to regress "
+                     f"against)")
+    verdict = "PASS" if result["ok"] else "REGRESSION"
+    lines.append(f"gate: {verdict} "
+                 f"({len(result['regressions'])} regressed / "
+                 f"{len(result['verdicts'])} checked, "
+                 f"k={result['k']:g} rel_floor={result['rel_floor']:g})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Gate a fresh run's performance against the baseline "
+                    "ledger's noise band")
+    p.add_argument("stream", nargs="?",
+                   help="telemetry JSONL stream or committed BENCH_*.json "
+                        "artifact to gate")
+    p.add_argument("--ledger", default=DEFAULT_LEDGER,
+                   help=f"baseline ledger path (default {DEFAULT_LEDGER})")
+    p.add_argument("--seed", nargs="+", metavar="GLOB", default=None,
+                   help="ingest committed BENCH_*/MULTICHIP_* artifacts "
+                        "into the ledger (idempotent by source filename)")
+    p.add_argument("--k", type=float, default=baseline.DEFAULT_K,
+                   help="noise-band width in robust sigmas (k * 1.4826*MAD)")
+    p.add_argument("--rel-floor", type=float,
+                   default=baseline.DEFAULT_REL_FLOOR,
+                   help="minimum band half-width as a fraction of the "
+                        "baseline median (shields a MAD-0 history)")
+    p.add_argument("--history", type=int, default=baseline.DEFAULT_HISTORY,
+                   help="how many recent green entries form the band")
+    p.add_argument("--update", action="store_true",
+                   help="append this run to the ledger when the gate "
+                        "passes (grows the history one green sample)")
+    p.add_argument("--warn-only", action="store_true",
+                   help="report regressions but exit 0 (bench.py's "
+                        "default posture)")
+    p.add_argument("--no-record", action="store_true",
+                   help="do not append the typed gate record to the stream")
+    args = p.parse_args(argv)
+
+    if args.seed is not None:
+        added = seed(args.ledger, args.seed)
+        print(f"ledger {args.ledger}: +{added} entries "
+              f"({len(baseline.load_ledger(args.ledger))} total)")
+        if args.stream is None:
+            return 0
+    if args.stream is None:
+        p.error("nothing to do: pass a stream to gate and/or --seed")
+    if not os.path.exists(args.stream):
+        raise SystemExit(f"no such stream/artifact: {args.stream}")
+
+    is_artifact = _is_artifact(args.stream)
+    if is_artifact:
+        entries = baseline.ingest_artifact(args.stream)
+        points = [{
+            "metric": e["metric"], "unit": e.get("unit"),
+            "plan": e.get("plan"), "key": e["key"],
+            "metrics": e.get("metrics") or {},
+            "span_shares": None, "phases": e.get("phases"),
+        } for e in entries if e.get("green")]
+    else:
+        recs = read_records(args.stream)
+        # A stream appended across invocations (bench's default path, a
+        # resumed trainer's attempts) holds several runs; gate only the
+        # FRESH one — records from the last run_start header on — or
+        # stale runs would skew the p50/span shares and --update would
+        # append one duplicate ledger entry per historical run.
+        last = max((i for i, r in enumerate(recs)
+                    if r.get("kind") == "run_start"), default=0)
+        points = baseline.extract_points(recs[last:])
+    if not points or not any(pt["metrics"] for pt in points):
+        print(f"{args.stream}: no headline metrics to gate (need bench "
+              f"records or step records with timings)", file=sys.stderr)
+        return 2
+
+    ledger = baseline.load_ledger(args.ledger)
+    result = baseline.gate_points(points, ledger, k=args.k,
+                                  rel_floor=args.rel_floor,
+                                  history=args.history)
+    if not args.no_record and not is_artifact:
+        baseline.emit_gate_record(args.stream, result,
+                                  ledger_path=args.ledger)
+    print(describe(result))
+    if result["ok"] and args.update:
+        n = baseline.append_entries(
+            args.ledger,
+            baseline.entries_from_points(
+                points, green=True, source=os.path.basename(args.stream)))
+        print(f"ledger {args.ledger}: +{n} green entries")
+    if not result["ok"] and not args.warn_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,10 @@ directory (utils/xplane op breakdown) and prints:
 * the parallelism-plan timeline (``plan`` records from the autotuner,
   autotune/planner.py): chosen layout, cost breakdown, alternatives, and
   the global step each (re-)plan landed at;
+* the span-time rollup (``span`` records, utils/tracing.py) and the
+  latest regression-gate verdict (``gate`` records, utils/baseline.py)
+  — the zoomable versions are scripts/dmp_trace.py and
+  scripts/dmp_gate.py (docs/TRACING.md);
 * device memory watermarks and recompilation counts;
 * the failure/recovery/divergence timeline (injected faults, non-finite
   restores, stall escalations, torn-checkpoint fallbacks, cross-replica
@@ -346,6 +350,56 @@ def _plan_section(lines: list[str], by_kind: dict) -> None:
                          + (f" {_fmt_s(at)}/step" if at else ""))
 
 
+def _spans_section(lines: list[str], by_kind: dict) -> None:
+    """Span-time rollup (``span`` records, utils/tracing.py): total and
+    mean duration per span name — where the run's instrumented host time
+    went. The zoomable view is ``scripts/dmp_trace.py``; this is the
+    at-a-glance version."""
+    spans = by_kind.get("span") or []
+    if not spans:
+        return
+    totals: dict[str, list] = {}
+    for r in spans:
+        d = r.get("dur_s")
+        if isinstance(d, (int, float)):
+            totals.setdefault(str(r.get("name")), []).append(float(d))
+    lines.append(f"== spans ({len(spans)} records, "
+                 f"{len(totals)} names) ==")
+    ranked = sorted(totals.items(), key=lambda kv: -sum(kv[1]))
+    for name, ds in ranked[:12]:
+        lines.append(f"  {name:20s} {_fmt_s(sum(ds)):>10s} total "
+                     f"x{len(ds):<5d} mean {_fmt_s(sum(ds) / len(ds))}")
+    lines.append("  (export the zoomable timeline: "
+                 "python scripts/dmp_trace.py <stream> -o trace.json)")
+
+
+def _gate_section(lines: list[str], by_kind: dict) -> None:
+    """Regression-gate verdicts (``gate`` records, utils/baseline.py +
+    scripts/dmp_gate.py): pass/fail per headline metric against the
+    baseline ledger's noise band, with the span/phase attribution."""
+    gates = by_kind.get("gate") or []
+    if not gates:
+        return
+    r = gates[-1]
+    regs = r.get("regressions") or []
+    lines.append(f"== regression gate "
+                 f"({'PASS' if r.get('ok') else 'REGRESSION'}, "
+                 f"{len(r.get('verdicts') or [])} metrics checked vs "
+                 f"{r.get('ledger')}) ==")
+    for v in regs:
+        lines.append(f"  REGRESSED {v.get('metric')}: {v.get('value')} vs "
+                      f"baseline {v.get('baseline')} "
+                      f"± {v.get('tolerance')}")
+        attr = v.get("attribution") or {}
+        where = attr.get("span") or attr.get("phase")
+        if where:
+            lines.append(f"      -> {where!r} grew "
+                         f"{attr.get('baseline_share')} -> "
+                         f"{attr.get('share')} of the run")
+    for key in r.get("no_baseline") or []:
+        lines.append(f"  (no baseline for {key} — first run of this key)")
+
+
 def _comm_section(lines: list[str], by_kind: dict) -> None:
     snaps = by_kind.get("metrics") or []
     counters = snaps[-1].get("counters", {}) if snaps else {}
@@ -441,7 +495,8 @@ def _resilience_section(lines: list[str], by_kind: dict,
                                         "retries_left")
                 if r.get(k) is not None)
             detail = str(r.get("detail", ""))[:100]
-            lines.append(f"  [+{dt:7.1f}s] failure   {r.get('error'):<24}"
+            lines.append(f"  [+{dt:7.1f}s] failure   "
+                         f"{str(r.get('error')):<24}"
                          + (f" {extra}" if extra else "")
                          + (f"  ({detail})" if detail else ""))
         else:
@@ -524,6 +579,8 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     _phase_section(lines, by_kind)
     _serving_section(lines, by_kind)
     _plan_section(lines, by_kind)
+    _spans_section(lines, by_kind)
+    _gate_section(lines, by_kind)
     _comm_section(lines, by_kind)
     _memory_section(lines, by_kind)
     _resilience_section(lines, by_kind)

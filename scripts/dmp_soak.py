@@ -348,7 +348,7 @@ def run_campaign(args, workdir: str, seed: int) -> tuple[dict, bool]:
                 and events["grow"] is None:
             events["grow"] = {"round": r, "restored": list(o.grow())}
 
-    t0 = time.time()
+    t0 = time.monotonic()
     summary = orch.run(on_round=on_round, max_rounds=2000)
     orch.close(rounds=summary["rounds"])
 
@@ -366,7 +366,7 @@ def run_campaign(args, workdir: str, seed: int) -> tuple[dict, bool]:
         "mode": args.mode,
         "seed": seed,
         "rounds": summary["rounds"],
-        "wall_s": round(time.time() - t0, 1),
+        "wall_s": round(time.monotonic() - t0, 1),
         "tenants": {n: t["state"] for n, t in tenants.items()},
         "heterogeneous_workloads": sorted({t["workload"]
                                            for t in tenants.values()}),
@@ -465,7 +465,7 @@ def run_degradation_campaign(args, workdir: str, seed: int
     orch.submit(TenantSpec(name="steady", workload="cnn",
                            config=steady_cfg))
 
-    t0 = time.time()
+    t0 = time.monotonic()
     summary = orch.run(max_rounds=2000)
     orch.close(rounds=summary["rounds"])
 
@@ -500,7 +500,7 @@ def run_degradation_campaign(args, workdir: str, seed: int
         "scenario": "degradation",
         "seed": seed,
         "rounds": summary["rounds"],
-        "wall_s": round(time.time() - t0, 1),
+        "wall_s": round(time.monotonic() - t0, 1),
         "tenants": {n: t["state"] for n, t in summary["tenants"].items()},
         "quarantined_devices": quarantined,
         "reinstated_devices": reinstated,
@@ -544,10 +544,10 @@ def run_long(args, workdir: str) -> tuple[dict, bool]:
     smoke of this very loop)."""
     campaign = (run_degradation_campaign if args.scenario == "degradation"
                 else run_campaign)
-    t0 = time.time()
+    t0 = time.monotonic()
     campaigns, all_ok = [], True
     i = 0
-    while i == 0 or time.time() - t0 < args.duration_s:
+    while i == 0 or time.monotonic() - t0 < args.duration_s:
         sub = os.path.join(workdir, f"campaign_{i}")
         os.makedirs(sub, exist_ok=True)
         summary, ok = campaign(args, sub, args.seed + i)
@@ -560,7 +560,7 @@ def run_long(args, workdir: str) -> tuple[dict, bool]:
         i += 1
     return ({"soak": "long", "scenario": args.scenario,
              "campaigns": campaigns, "n_campaigns": i,
-             "wall_s": round(time.time() - t0, 1),
+             "wall_s": round(time.monotonic() - t0, 1),
              "all_ok": all_ok}, all_ok)
 
 

@@ -22,15 +22,27 @@ EMITTING_ROOTS = (
 EMITTING_FILES = (REPO / "bench.py",)
 
 RECORD_RE = re.compile(r'\.record\(\s*"([a-z_]+)"')
+METRIC_RE = re.compile(r'\.(?:counter|gauge|histogram)\(\s*"([a-z_0-9]+)"')
+WALLCLOCK_RE = re.compile(r"time\.time\(\)")
+
+
+def _emitting_files() -> list[Path]:
+    files = [p for root in EMITTING_ROOTS for p in root.rglob("*.py")]
+    return files + list(EMITTING_FILES)
 
 
 def _emitted_kinds() -> set[str]:
     kinds: set[str] = set()
-    files = [p for root in EMITTING_ROOTS for p in root.rglob("*.py")]
-    files += list(EMITTING_FILES)
-    for path in files:
+    for path in _emitting_files():
         kinds |= set(RECORD_RE.findall(path.read_text()))
     return kinds
+
+
+def _emitted_metric_names() -> set[str]:
+    names: set[str] = set()
+    for path in _emitting_files():
+        names |= set(METRIC_RE.findall(path.read_text()))
+    return names
 
 
 def _documented_kinds() -> set[str]:
@@ -58,3 +70,47 @@ def test_every_emitted_record_kind_is_documented():
         f"telemetry record kinds emitted but missing from the "
         f"docs/OBSERVABILITY.md record table: {missing} — add a schema "
         f"row for each (kind, payload keys, writer)")
+
+
+def test_every_metric_name_is_documented():
+    """Same contract, one level down: every literal registry metric name
+    (``counter(``/``gauge(``/``histogram(``) the package, scripts and
+    bench can emit must appear (backticked) somewhere in
+    docs/OBSERVABILITY.md — the per-tenant counter semantics and the
+    report both lean on these names, so an undocumented one is a wire
+    format nobody can consume."""
+    emitted = _emitted_metric_names()
+    # Sanity: the grep found the core families.
+    assert {"jax_compiles", "collective_traces", "serve_ttft_s"} <= emitted
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(re.findall(r"`([a-z_0-9]+)", doc))
+    missing = sorted(emitted - documented)
+    assert not missing, (
+        f"registry metric names emitted but never mentioned in "
+        f"docs/OBSERVABILITY.md: {missing} — add each to the metric "
+        f"tables (counters / gauges / histograms)")
+
+
+def test_durations_never_subtract_wall_clock():
+    """Monotonic-duration audit: ``time.time()`` is for ``ts`` stamps
+    (cross-stream correlation), never for durations — an NTP step
+    mid-run would skew step times and can false-trip the health
+    sentinel's EWMA baseline. Every surviving ``time.time()`` call site
+    must be a timestamp assignment (a line carrying a ``ts``/``created``
+    key); durations use ``time.monotonic()``/``perf_counter()``."""
+    offenders: list[str] = []
+    for path in _emitting_files():
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            if not WALLCLOCK_RE.search(line) or line.lstrip().startswith("#"):
+                continue
+            if "``" in line or "reference" in line:
+                continue          # prose in docstrings, not a call site
+            if ('"ts"' in line or "'ts'" in line or '"created"' in line
+                    or "t0w" in line or "time.time() - dur_s" in line
+                    or "_t0w = time.time()" in line):
+                continue
+            offenders.append(f"{path.relative_to(REPO)}:{i}: {line.strip()}")
+    assert not offenders, (
+        "wall-clock time.time() used outside a timestamp assignment — "
+        "use time.monotonic() for durations (satellite: NTP-immune "
+        "timing):\n" + "\n".join(offenders))

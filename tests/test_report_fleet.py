@@ -269,3 +269,60 @@ def test_bench_mid_run_real_bugs_still_raise(monkeypatch):
     monkeypatch.setattr(bench, "_run_workload", boom)
     with pytest.raises(ValueError, match="real bug"):
         bench.main()
+
+
+# ---------------------------------------------------------------------------
+# report robustness (satellite): degenerate and mixed-schema streams must
+# render every section gracefully — no KeyError, no format crash
+# ---------------------------------------------------------------------------
+
+def test_build_report_on_empty_stream():
+    out = build_report([])
+    assert "== run ==" in out and "no run_end record" in out
+
+
+def test_build_report_on_run_start_only():
+    out = build_report([{"ts": 1.0, "kind": "run_start", "run": "r",
+                         "device": {"platform": "cpu", "n_devices": 8},
+                         "meta": {"workload": "cnn"}}])
+    assert "== steps (0 records) ==" in out
+    assert "MFU unavailable" in out
+
+
+def test_build_report_mixed_schema_records_render():
+    """Records missing their conventional payload keys (foreign streams,
+    future schema drift) must degrade to '?'/None rendering, never
+    crash a section."""
+    records = [
+        {"ts": 1.0, "kind": "run_start"},                  # no run/device
+        {"ts": 2.0, "kind": "step"},                       # no timings
+        {"ts": 2.5, "kind": "step", "step_time_s": 0.1},
+        {"ts": 3.0, "kind": "failure"},                    # no error field
+        {"ts": 3.5, "kind": "recovery"},                   # no action
+        {"ts": 4.0, "kind": "consistency"},                # no status
+        {"ts": 4.5, "kind": "resume"},                     # no slot
+        {"ts": 5.0, "kind": "serve", "event": "summary"},  # no totals
+        {"ts": 5.5, "kind": "span", "name": "x"},          # no dur_s
+        {"ts": 6.0, "kind": "gate"},                       # no verdicts
+        {"ts": 6.5, "kind": "step_phase"},                 # no pipeline
+        {"ts": 7.0, "kind": "plan"},                       # no axes
+        {"ts": 7.5, "kind": "epoch", "epoch": 0},
+        {"ts": 8.0, "kind": "memory"},                     # no devices
+        {"ts": 8.5, "kind": "metrics"},                    # no counters
+    ]
+    out = build_report(records)
+    assert "failure" in out and "== regression gate" in out
+
+
+def test_build_fleet_report_mixed_schema_renders():
+    records = [
+        {"ts": 1.0, "kind": "tenant"},                     # no name/event
+        {"ts": 1.5, "kind": "tenant", "tenant": "a", "name": "a",
+         "event": "admitted"},
+        {"ts": 2.0, "kind": "fault", "tenant": "a", "fault": "nan_loss"},
+        {"ts": 2.5, "kind": "health"},                     # no devices
+        {"ts": 3.0, "kind": "failure", "tenant": "a"},     # no error
+        {"ts": 3.5, "kind": "event"},                      # no message
+    ]
+    out = build_fleet_report(records)
+    assert "== fleet" in out and "== fault ledger" in out

@@ -215,6 +215,92 @@ def test_read_records_skips_truncated_tail(tmp_path):
     assert rec["kind"] == "step"
 
 
+def test_torn_tail_counts_and_never_poisons_a_fleet_merge(tmp_path,
+                                                          capsys):
+    """Satellite: a run killed mid-write must cost a warning counter,
+    not a JSONDecodeError that poisons the whole fleet merge."""
+    import sys
+
+    good = tmp_path / "good.jsonl"
+    good.write_text('{"ts": 1, "kind": "run_start", "run": "a"}\n'
+                    '{"ts": 2, "kind": "step", "step_time_s": 0.1}\n')
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text('{"ts": 1, "kind": "run_start", "run": "b"}\n'
+                    '{"ts": 3, "kind": "step", "step_time_s"')
+    before = telemetry.registry().counter("telemetry_torn_lines").value
+    merged = telemetry.merge_streams([str(good), str(torn)])
+    assert len(merged) == 3                  # the torn line is dropped
+    after = telemetry.registry().counter("telemetry_torn_lines").value
+    assert after == before + 1
+    assert "torn" in capsys.readouterr().err
+    # ...and the report renders the merge without raising.
+    from scripts.dmp_report import build_fleet_report, build_report
+
+    build_fleet_report(merged)
+    build_report(telemetry.read_records(str(torn)))
+
+
+def test_stream_rotation_and_globbed_readback(tmp_path):
+    """Satellite: TelemetryRun(max_bytes=...) rotates the live file to
+    {stem}.N.jsonl parts; read_records/merge_streams glob the parts back
+    in order so a rotated long-run stream reads as one stream."""
+    path = str(tmp_path / "run.jsonl")
+    run = telemetry.TelemetryRun(path, run="long", track_compiles=False,
+                                 max_bytes=4096)
+    n = 60
+    for i in range(n):
+        # Non-ASCII payload: rotation must count written BYTES (the em
+        # dash is 3 UTF-8 bytes), or parts overshoot max_bytes.
+        run.step(step=i, step_time_s=0.01, note="x—" * 40)
+    run.finish()
+    parts = telemetry.stream_parts(path)
+    assert len(parts) > 1, "stream never rotated"
+    assert parts[-1] == path
+    assert all(f".{i + 1}.jsonl" in parts[i] for i in range(len(parts) - 1))
+    import os
+
+    assert all(os.path.getsize(p) <= 4096 for p in parts[:-1])
+    records = telemetry.read_records(path)
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    steps = [r["step"] for r in records if r["kind"] == "step"]
+    assert steps == list(range(n))           # ordered across parts
+    # merge_streams sees the whole logical stream through the base path
+    assert len(telemetry.merge_streams([path])) == len(records)
+    # a shell glob lists the base AND its parts: the parts are already
+    # folded into the base read, so merging the expanded list must not
+    # double-count them
+    assert len(telemetry.merge_streams(sorted(parts))) == len(records)
+    # a part path passed explicitly reads just that part
+    assert telemetry.read_records(parts[0])
+
+
+def test_rotation_rejects_degenerate_max_bytes(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError):
+        telemetry.TelemetryRun(str(tmp_path / "r.jsonl"), run="r",
+                               track_compiles=False, max_bytes=100)
+
+
+def test_run_end_wall_s_is_monotonic_not_wall_clock(tmp_path,
+                                                    monkeypatch):
+    """Satellite: an NTP step mid-run must not skew wall_s — the
+    duration pair uses time.monotonic(), only the per-record ts stamps
+    stay on the wall clock."""
+    import time as time_mod
+
+    run = telemetry.TelemetryRun(str(tmp_path / "r.jsonl"), run="r",
+                                 track_compiles=False)
+    real_time = time_mod.time
+    # Simulate the wall clock stepping back 1000s mid-run.
+    monkeypatch.setattr(time_mod, "time", lambda: real_time() - 1000.0)
+    run.finish()
+    (end,) = [r for r in telemetry.read_records(run.path)
+              if r["kind"] == "run_end"]
+    assert 0 <= end["wall_s"] < 10
+
+
 # ---------------------------------------------------------------------------
 # Collectives accounting (trace-time, tagged by mesh axis)
 # ---------------------------------------------------------------------------
