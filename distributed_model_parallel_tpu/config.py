@@ -290,6 +290,13 @@ class TrainConfig:
     # (train/resilience.py, utils/faults.py). Off by default.
     recovery: RecoveryConfig = dataclasses.field(
         default_factory=RecoveryConfig)
+    # Live status/metrics exporter (utils/statusz.py): serve /metrics
+    # (Prometheus text), /statusz (JSON fleet state) and /healthz on
+    # 127.0.0.1:<port> from a daemon thread (0 = ephemeral port). One
+    # exporter per process — under the orchestrator the tenants register
+    # providers on the fleet's exporter instead of opening their own.
+    # None falls back to DMP_STATUSZ_PORT; unset both = true no-op.
+    statusz_port: int | None = None
     # Device-resident fast path (gspmd strategy): upload the train set to the
     # accelerators once and run steps_per_dispatch train steps per jitted
     # program (lax.scan over on-device index gathers) — amortizes dispatch

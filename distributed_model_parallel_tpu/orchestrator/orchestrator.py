@@ -61,7 +61,10 @@ class Orchestrator:
                  quantum: int = 2,
                  max_stagnant_rounds: int = 50,
                  health=None,
-                 grow_back: bool = True):
+                 grow_back: bool = True,
+                 statusz_port: int | None = None,
+                 alerts=None,
+                 flight_recorder=None):
         if devices is None:
             import jax
 
@@ -81,11 +84,27 @@ class Orchestrator:
             from distributed_model_parallel_tpu.utils import health as hm
 
             hm.install(health)
+        # Crash flight recorder (utils/flightrec.FlightRecorder): when
+        # given, installed process-wide so every tenant's telemetry tees
+        # into its ring and a failing tenant/stall dumps a postmortem
+        # bundle. None = no ring, no bundles (the no-op default).
+        self.flight_recorder = flight_recorder
+        if flight_recorder is not None:
+            from distributed_model_parallel_tpu.utils import flightrec
+
+            flightrec.install(flight_recorder)
         self.workdir = workdir
         os.makedirs(workdir, exist_ok=True)
         self.telemetry = TelemetryRun(
             os.path.join(workdir, "fleet.jsonl"), run="fleet",
             meta={"n_devices": len(self.pool.devices)})
+        # SLO alert engine (utils/alerts.AlertEngine): every round it
+        # live-tails the tenants' streams, re-evaluates its rules, and
+        # writes deduplicated typed ``alert`` records (firing/resolved)
+        # onto the fleet stream. None = no alerting.
+        self.alerts = alerts
+        if alerts is not None and alerts.sink is None:
+            alerts.sink = self.telemetry
         self.tenants: dict[str, Tenant] = {}
         self.rounds = 0
         self._seq = 0
@@ -93,8 +112,66 @@ class Orchestrator:
         # Every (tenant, device-ids) grant ever made, for the
         # no-overlap/auditing tests and the fleet summary.
         self.assignment_log: list[dict] = []
+        # Live status exporter (utils/statusz.py): the fleet's tenant
+        # table / pool state under /statusz. Tenants join THIS exporter
+        # as providers (one exporter per process; tenants are labels,
+        # not ports). No-op when no port is configured anywhere.
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.maybe_serve(statusz_port)
+        statusz.register("fleet", self._status)
 
     # -- bookkeeping ----------------------------------------------------------
+    def _status(self) -> dict:
+        """The fleet's /statusz provider payload: the tenant table
+        (state / devices / attempt / step), pool state, firing alerts."""
+        return {
+            "workload": "fleet",
+            "rounds": self.rounds,
+            "tenants": {
+                t.name: {
+                    "state": t.state.value,
+                    "workload": t.spec.workload,
+                    "priority": t.priority,
+                    "devices": list(t.devices),
+                    "attempt": t.attempts,
+                    "global_step": t.global_step,
+                } for t in sorted(self.tenants.values(),
+                                  key=lambda t: t.seq)},
+            "pool": {
+                "n_devices": len(self.pool.devices),
+                "n_free": self.pool.n_free,
+                "revoked": list(self.pool.revoked_ids),
+                "quarantined": list(self.pool.quarantined_ids),
+            },
+            "alerts_firing": (self.alerts.firing
+                              if self.alerts is not None else []),
+            "failed_tenants": [t.name for t in self.tenants.values()
+                               if t.state is TenantState.FAILED],
+            # The control loop being alive IS fleet liveness: one failed
+            # tenant is that tenant's problem (its row + alerts say so);
+            # flipping the whole process's /healthz to 503 over it would
+            # make a liveness probe restart a healthy fleet.
+            "healthy": True,
+        }
+
+    def _apply_alerts(self) -> None:
+        """One alert-engine pass: tail every tenant stream that exists,
+        refresh the level signals (health scores), and tick — each
+        firing/resolved transition lands as a typed ``alert`` record on
+        the fleet stream (the engine's sink)."""
+        if self.alerts is None:
+            return
+        for path in self.telemetry_paths()[1:]:
+            self.alerts.watch(path)
+        if self.health is not None:
+            snap = self.health.snapshot()
+            self.alerts.set_signal("health_scores",
+                                   {int(k): v
+                                    for k, v in snap["scores"].items()})
+        self.alerts.poll()
+        self.alerts.tick()
+
     def _record(self, tenant: Tenant, event: str, **fields) -> None:
         self.telemetry.record("tenant", name=tenant.name, event=event,
                               priority=tenant.priority, round=self.rounds,
@@ -356,9 +433,17 @@ class Orchestrator:
         admitting/draining — renders on the fleet timeline next to the
         tenant lifecycle records."""
         before = {n: t.state for n, t in self.tenants.items()}
+        # The exporter may have been started AFTER construction (a
+        # tenant's statusz_port arriving first): re-registering is one
+        # idempotent dict write, and keeps the fleet provider on
+        # whatever exporter the process ended up with.
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.register("fleet", self._status)
         with tracing.sink_scope(self.telemetry), \
                 span("round", round=self.rounds) as sp:
             self._apply_health()
+            self._apply_alerts()
             admitted = self._admit()
             self._maybe_grow_back()
             moved = admitted > 0
@@ -418,7 +503,12 @@ class Orchestrator:
             # queueing events for) a dead campaign from later runs in
             # the same process.
             self._uninstall_health()
+            self._uninstall_flightrec()
             raise
+        # Final alert pass: the last tenants' tail records (written
+        # after their final round) must still be able to resolve a
+        # firing alert before the campaign summary reads it.
+        self._apply_alerts()
         return self.summary()
 
     # -- results --------------------------------------------------------------
@@ -466,6 +556,15 @@ class Orchestrator:
             "all_resumes_exact": all(
                 all(t.resume_exact) for t in self.tenants.values()),
             "assignments": self.assignment_log,
+            # The campaign's alert story: every firing/resolved
+            # transition the engine emitted, plus what is STILL firing
+            # at summary time (an operator's "anything red?" answer).
+            "alerts": (list(self.alerts.events)
+                       if self.alerts is not None else []),
+            "alerts_firing": (self.alerts.firing
+                              if self.alerts is not None else []),
+            "postmortems": (list(self.flight_recorder.dumps)
+                            if self.flight_recorder is not None else []),
         }
 
     def _uninstall_health(self) -> None:
@@ -475,6 +574,17 @@ class Orchestrator:
             if hm.installed() is self.health:
                 hm.uninstall()
 
+    def _uninstall_flightrec(self) -> None:
+        if self.flight_recorder is not None:
+            from distributed_model_parallel_tpu.utils import flightrec
+
+            if flightrec.installed() is self.flight_recorder:
+                flightrec.uninstall()
+
     def close(self, **fields) -> None:
         self._uninstall_health()
+        self._uninstall_flightrec()
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.unregister("fleet")
         self.telemetry.finish(**fields)

@@ -291,6 +291,19 @@ class Tenant:
         except BaseException as e:  # noqa: BLE001 - ledger, not crash
             self.error = e
             self.outcome = "failed"
+            # Postmortem at the moment of the unrecovered failure,
+            # captured ON the failing thread while its traceback (and
+            # every peer thread's live stack) is still available —
+            # the reap round would only see a dead thread. No-op
+            # without an installed flight recorder (utils/flightrec.py).
+            from distributed_model_parallel_tpu.utils import flightrec
+
+            t = self.trainer
+            flightrec.dump(
+                f"tenant-failed-{self.name}",
+                telemetry_run=(t.logger.telemetry if t is not None
+                               else None),
+                error=e)
         finally:
             faults = getattr(self.trainer, "faults", None)
             if faults is not None:
@@ -302,6 +315,13 @@ class Tenant:
             self.counter_deltas = {
                 k: v for k, v in registry().snapshot(
                     tenant=self.name).get("counters", {}).items() if v}
+            # Drop this attempt's /statusz provider: a reaped tenant must
+            # not keep pinning its trainer (params, opt state) or feed
+            # stale watchdog state into /healthz for the rest of the
+            # campaign. A re-admission registers afresh.
+            from distributed_model_parallel_tpu.utils import statusz
+
+            statusz.unregister(self.name)
             # The thread's death IS the completion signal; make sure the
             # boundary flag can't wedge an orchestrator mid-wait.
             self._baton.at_boundary.set()

@@ -75,6 +75,11 @@ class ServeConfig:
     top_k: int | None = None
     top_p: float | None = None
     eos_id: int | None = None
+    # Live status exporter (utils/statusz.py): queue depth, page
+    # occupancy and slot state under /statusz, SLO histograms under
+    # /metrics. Same one-exporter-per-process semantics as
+    # TrainConfig.statusz_port; None = DMP_STATUSZ_PORT, unset = no-op.
+    statusz_port: int | None = None
 
 
 class Engine:
@@ -142,6 +147,32 @@ class Engine:
         self._decode_tokens = 0       # useful tokens out of decode steps
         self._occupancy: list[float] = []
         self._wall_s = 0.0
+        # Live status exporter (utils/statusz.py): queue depth / page
+        # occupancy / slot state under /statusz. No-op when no port is
+        # configured anywhere in the process.
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.maybe_serve(serve.statusz_port)
+        # One provider per policy: a later engine of the same policy
+        # replaces the entry. Warmup/probe engines (slo_metrics=False)
+        # stay off the exporter like they stay out of the registry.
+        if slo_metrics:
+            statusz.register(f"serve-{serve.policy}", self._status)
+
+    def _status(self) -> dict:
+        """The engine's /statusz provider payload."""
+        return {
+            "workload": "serve",
+            "policy": self.serve.policy,
+            "iterations": self._iterations,
+            "queue_depth": len(self.sched.queue),
+            "active_requests": sum(1 for r in self._requests
+                                   if not r.done and r.slot is not None),
+            "n_slots": self.serve.n_slots,
+            "page_occupancy": self.cache.occupancy,
+            "requests_submitted": len(self._requests),
+            "healthy": True,
+        }
 
     # -- submission ---------------------------------------------------------
 
@@ -198,6 +229,14 @@ class Engine:
                 self.telemetry.failure(
                     "engine-killed", detail=f"{type(e).__name__}: {e}",
                     iteration=self._iterations)
+            # Crash flight recorder (utils/flightrec.py): capture the
+            # state at the moment of death — ring records, thread
+            # stacks, span stacks, page-pool state. No-op when no
+            # recorder is installed.
+            from distributed_model_parallel_tpu.utils import flightrec
+
+            flightrec.dump("engine-killed", telemetry_run=self.telemetry,
+                           error=e)
             if not isinstance(e, Exception):
                 # KeyboardInterrupt/SystemExit keep their semantics —
                 # the typed-failure bookkeeping above still ran.

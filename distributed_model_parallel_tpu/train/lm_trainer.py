@@ -121,6 +121,10 @@ class LMTrainConfig:
     # TrainConfig.recovery (train/resilience.py, utils/faults.py).
     recovery: RecoveryConfig = dataclasses.field(
         default_factory=RecoveryConfig)
+    # Live status/metrics exporter — same semantics as
+    # TrainConfig.statusz_port (utils/statusz.py; DMP_STATUSZ_PORT
+    # fallback, one exporter per process).
+    statusz_port: int | None = None
 
 
 class LMTrainer:
@@ -280,6 +284,12 @@ class LMTrainer:
         # Span sink for this thread (utils/tracing.py) — resume/checkpoint
         # spans below land on this run's stream.
         tracing.install(self.logger.telemetry)
+        # Live status exporter (utils/statusz.py) — see Trainer: start or
+        # join the process's exporter, publish this run under /statusz.
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.maybe_serve(config.statusz_port)
+        statusz.register_trainer(self, "lm")
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )

@@ -136,6 +136,12 @@ class PipelineTrainer:
         # Span sink for this thread (utils/tracing.py) — resume/checkpoint
         # spans below land on this run's stream.
         tracing.install(self.logger.telemetry)
+        # Live status exporter (utils/statusz.py) — see Trainer: start or
+        # join the process's exporter, publish this run under /statusz.
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.maybe_serve(config.statusz_port)
+        statusz.register_trainer(self, "pipeline")
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )

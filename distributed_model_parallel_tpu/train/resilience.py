@@ -40,7 +40,7 @@ import time
 from typing import Any, Callable, Sequence
 
 from distributed_model_parallel_tpu.config import RecoveryConfig
-from distributed_model_parallel_tpu.utils import health, tracing
+from distributed_model_parallel_tpu.utils import flightrec, health, tracing
 from distributed_model_parallel_tpu.utils.faults import FaultInjector, FaultSpec
 
 
@@ -321,6 +321,10 @@ class RecoverySupervisor:
         if self.retries_left <= 0:
             self.logger.log_line(
                 f"resilience: {label} retry budget exhausted — raising")
+            # The run is about to die unrecovered — capture the moment
+            # (no-op without an installed flight recorder).
+            flightrec.dump(f"unrecovered-{label}",
+                           telemetry_run=self._telemetry)
             return False
         self.retries_left -= 1
         try:
@@ -331,6 +335,8 @@ class RecoverySupervisor:
             self.logger.log_line(
                 f"resilience: no {self.slot!r} checkpoint to restore — "
                 f"raising")
+            flightrec.dump(f"unrecovered-{label}",
+                           telemetry_run=self._telemetry)
             return False
         except Exception as e:  # noqa: BLE001 - e.g. every version torn
             # (CheckpointIntegrityError). The caller re-raises the original
@@ -341,6 +347,8 @@ class RecoverySupervisor:
                 f"resilience: restoring {self.slot!r} failed "
                 f"({type(e).__name__}: {str(e)[:160]}) — raising the "
                 f"original {label} error")
+            flightrec.dump(f"unrecovered-{label}",
+                           telemetry_run=self._telemetry, error=e)
             return False
         if shrink_lr is not None and self.config.lr_shrink != 1.0:
             self.lr_scale *= self.config.lr_shrink
@@ -420,6 +428,10 @@ class RecoverySupervisor:
         self._telemetry.failure(
             "stall", detail=f"{what} blocked {blocked_s:.1f}s "
             f"(budget exceeded)")
+        # Postmortem at the moment of the stall: the wedged collective's
+        # thread stacks are exactly what a post-hoc stream can't show
+        # (no-op without an installed flight recorder).
+        flightrec.dump(f"stall-{what}", telemetry_run=self._telemetry)
         if self.config.stall_exit:
             self.logger.log_line(
                 "resilience: stall budget exceeded — requesting graceful "

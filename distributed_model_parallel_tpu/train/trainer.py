@@ -508,6 +508,15 @@ class Trainer:
         # checkpoint I/O deep in train/checkpoint.py — lands on this run's
         # stream (and inherits its tenant tag under the orchestrator).
         tracing.install(self.logger.telemetry)
+        # Live status exporter (utils/statusz.py): start the process's
+        # exporter when a port is configured (else join the running one —
+        # orchestrated tenants land on the fleet's) and publish this
+        # run's live state under /statusz. No-op when neither
+        # statusz_port nor DMP_STATUSZ_PORT is set.
+        from distributed_model_parallel_tpu.utils import statusz
+
+        statusz.maybe_serve(config.statusz_port)
+        statusz.register_trainer(self, "cnn")
         from distributed_model_parallel_tpu.train.resilience import (
             RecoverySupervisor,
         )

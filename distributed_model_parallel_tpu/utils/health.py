@@ -162,6 +162,23 @@ class DeviceHealthMonitor:
             return tuple(sorted(i for i, s in self._state.items()
                                 if s == QUARANTINED))
 
+    def snapshot(self) -> dict:
+        """JSON-ready view of the sentinel's state — the statusz
+        exporter's ``/statusz`` health block and the flight recorder's
+        ``health.json``: per-device scores/states, the quarantine set,
+        and the tick count."""
+        with self._lock:
+            ids = sorted(set(self._score) | set(self._state))
+            return {
+                "scores": {str(i): round(self._score.get(i, 1.0), 4)
+                           for i in ids},
+                "states": {str(i): self._state.get(i, HEALTHY)
+                           for i in ids},
+                "quarantined": sorted(i for i, s in self._state.items()
+                                      if s == QUARANTINED),
+                "ticks": self.ticks,
+            }
+
     def assert_usable(self, device_ids: Iterable[int]) -> None:
         bad = sorted(set(device_ids) & set(self.quarantined_ids))
         if bad:

@@ -92,7 +92,24 @@ gate       ok, regressions [{metric, value, baseline, tolerance}],
            cross-run perf-regression-gate verdict (utils/baseline.py,
            scripts/dmp_gate.py) comparing this run's headline metrics
            against the baseline ledger's noise band
+alert      rule, subject, state (firing | resolved), value, threshold,
+           plus per-rule detail — one DEDUPLICATED SLO-alert transition
+           (utils/alerts.py): step-time drift vs the baseline band,
+           serve burn rate, page saturation, health floor; written by
+           the orchestrator's control loop, fsync'd on write
+postmortem reason, bundle (directory path), n_records, error — the
+           crash flight recorder (utils/flightrec.py) wrote a
+           postmortem bundle (ring-buffer record tail, all-thread
+           stacks, span stacks, device memory, health scores);
+           fsync'd so the pointer survives the crash it describes
 ========== ==========================================================
+
+Two live surfaces sit on top of this stream: the statusz exporter
+(utils/statusz.py — /metrics Prometheus text with per-tenant labels,
+/statusz JSON, /healthz) and the live-tail reader
+(:class:`StreamFollower` / :func:`follow_records` — rotation-safe
+incremental reads; the cockpit scripts/dmp_top.py and the alert
+engine's ingest path).
 """
 
 from __future__ import annotations
@@ -103,7 +120,8 @@ import math
 import os
 import threading
 import time
-from typing import Any, Iterable, Mapping
+import weakref
+from typing import Any, Callable, Iterable, Mapping
 
 __all__ = [
     "AlreadyRegisteredError",
@@ -111,15 +129,20 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "StreamFollower",
     "TelemetryRun",
     "current_tenant",
     "device_info",
     "device_memory_snapshot",
+    "follow_records",
     "install_compile_tracking",
+    "live_runs",
     "merge_streams",
     "read_records",
     "record_collective",
+    "record_tap",
     "registry",
+    "set_record_tap",
     "stream_parts",
     "tenant_scope",
     "wire_bytes_estimate",
@@ -280,6 +303,17 @@ class MetricsRegistry:
     def histogram(self, name: str, bounds: Iterable[float] | None = None,
                   **tags) -> Histogram:
         return self._get(Histogram, name, tags, bounds=bounds)
+
+    def items(self) -> list[tuple[str, dict[str, str], Any]]:
+        """A consistent view of every registered metric:
+        ``(name, {tag: value}, metric_object)`` rows, name-sorted. The
+        statusz exporter's ``/metrics`` renderer walks this (it needs the
+        live objects — e.g. a Counter's per-tenant buckets — not the
+        JSON snapshot)."""
+        with self._lock:
+            rows = list(self._metrics.items())
+        return [(name, dict(tags), m)
+                for (name, tags), m in sorted(rows, key=lambda kv: kv[0])]
 
     def snapshot(self, tenant: str | None = None) -> dict:
         """JSON-ready dump: {"counters": {...}, "gauges": {...},
@@ -567,6 +601,42 @@ def merge_streams(paths: Iterable[str]) -> list[dict]:
 # The run event stream
 # ---------------------------------------------------------------------------
 
+# Process-wide record tap: when set, every record ANY TelemetryRun writes
+# is also handed (as its final dict) to this callable — the crash flight
+# recorder's free tee (utils/flightrec.py installs its ring buffer here).
+# One None-check per record when unset; tap errors never break the write.
+_record_tap: Callable[[dict], None] | None = None
+
+
+def set_record_tap(fn: Callable[[dict], None] | None) -> None:
+    """Install (or clear, with None) the process-wide record tap."""
+    global _record_tap
+    _record_tap = fn
+
+
+def record_tap() -> Callable[[dict], None] | None:
+    return _record_tap
+
+
+# Live (not-yet-finished) runs, weakly held: the drivers' unhandled-
+# exception hook (utils/flightrec.install_excepthook) closes these so a
+# crash still gets its final metrics/run_end records.
+_live_runs: "weakref.WeakSet[TelemetryRun]" = weakref.WeakSet()
+
+
+def live_runs() -> list["TelemetryRun"]:
+    """Every TelemetryRun constructed in this process that has not yet
+    ``finish()``-ed (weakly tracked; GC'd runs drop out)."""
+    return [r for r in list(_live_runs) if not r._finished]
+
+
+# Record kinds that must survive the very crash they describe: the write
+# is fsync'd before the stream lock releases, so a process dying right
+# after (the common failure->abort path) cannot leave them torn in the
+# page cache.
+_DURABLE_KINDS = frozenset({"failure", "postmortem", "alert"})
+
+
 def _coerce(v: Any) -> Any:
     """JSON-safe coercion: device/numpy scalars to float, containers
     element-wise; anything else through str() as a last resort."""
@@ -659,14 +729,23 @@ class TelemetryRun:
                     device=dict(device) if device is not None
                     else device_info(),
                     meta=_coerce(dict(meta or {})))
+        _live_runs.add(self)
 
     def record(self, kind: str, **fields) -> None:
         head = {"ts": time.time(), "kind": kind}
         if self.tenant is not None:
             head["tenant"] = self.tenant
-        line = json.dumps({**head,
-                           **{k: _coerce(v) for k, v in fields.items()}},
-                          default=str)
+        rec = {**head, **{k: _coerce(v) for k, v in fields.items()}}
+        tap = _record_tap
+        if tap is not None:
+            # The crash flight recorder's tee (utils/flightrec.py): the
+            # ring gets the record BEFORE the disk write, so even a
+            # write that dies mid-line reaches the postmortem bundle.
+            try:
+                tap(rec)
+            except Exception:
+                pass
+        line = json.dumps(rec, default=str)
         with self._lock:
             n = len(line.encode("utf-8")) + 1    # bytes written, not chars
             if (self.max_bytes is not None and self._bytes > 0
@@ -674,6 +753,16 @@ class TelemetryRun:
                 self._rotate()
             with open(self.path, "a") as f:
                 f.write(line + "\n")
+                if kind in _DURABLE_KINDS:
+                    # Crash hygiene: a failure/postmortem/alert record is
+                    # exactly the record a crashing process must not lose
+                    # — flush + fsync before the lock releases, so the
+                    # line is on disk even if the process dies next.
+                    f.flush()
+                    try:
+                        os.fsync(f.fileno())
+                    except OSError:
+                        pass
             self._bytes += n
 
     def _rotate(self) -> None:
@@ -824,3 +913,114 @@ def read_records(path: str) -> list[dict]:
                   f"line(s) (torn tail from a killed run?)",
                   file=sys.stderr)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Live tail: follow a (possibly rotating) stream without drops or dups
+# ---------------------------------------------------------------------------
+
+class StreamFollower:
+    """Incremental reader of a logical telemetry stream — the cockpit's
+    and alert engine's ingest path.
+
+    :meth:`poll` returns every record appended since the last poll, in
+    order, across :class:`TelemetryRun` rotations: when the live file is
+    renamed to ``{stem}.N.jsonl`` mid-tail, the follower finishes the
+    rotated part from its remembered byte offset (same inode, so nothing
+    is re-read) before moving to the new live file — no record is
+    dropped and none is delivered twice. A partially-written final line
+    stays buffered until its newline arrives (a mid-write poll must not
+    mis-parse a half record); an unparseable *complete* line is skipped,
+    matching :func:`read_records`.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        # Lowest rotation-part index not yet fully consumed; parts below
+        # it are done. 0 = consume every existing part from the start.
+        self._part_cursor = 0
+        self._ino: int | None = None     # inode of the file mid-read
+        self._off = 0                    # bytes of it consumed
+        self._buf = b""                  # partial trailing line
+
+    def _reset_file(self) -> None:
+        self._ino, self._off, self._buf = None, 0, b""
+
+    def _drain(self, path: str, out: list[dict], *, final: bool) -> bool:
+        """Read ``path`` from the remembered offset (reset when it is a
+        different file than last time), appending parsed records.
+        ``final``: the file can never grow again (a rotated part), so a
+        buffered partial line is parse-attempted and then discarded.
+        Returns False when the file vanished between listing and open."""
+        try:
+            with open(path, "rb") as f:
+                ino = os.fstat(f.fileno()).st_ino
+                if ino != self._ino:
+                    self._ino, self._off, self._buf = ino, 0, b""
+                f.seek(self._off)
+                data = f.read()
+        except OSError:
+            return False
+        self._off += len(data)
+        buf = self._buf + data
+        lines = buf.split(b"\n")
+        self._buf = lines.pop()          # incomplete tail stays buffered
+        if final and self._buf:
+            lines.append(self._buf)      # a rotated part never grows —
+            self._buf = b""              # parse-or-drop its last line
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                registry().counter("telemetry_torn_lines").inc()
+        return True
+
+    def poll(self) -> list[dict]:
+        """Every record appended (to any part) since the last poll."""
+        out: list[dict] = []
+        stem, ext = os.path.splitext(self.path)
+        for _ in range(10_000):          # re-list bound (rotation races)
+            pending = [i for i in _part_indices(self.path)
+                       if i >= self._part_cursor]
+            if pending:
+                # Oldest unconsumed part first. If it is the file we were
+                # mid-reading as the live stream (rotation renamed it out
+                # from under us), _drain continues at the same inode +
+                # offset; otherwise it starts from byte 0.
+                idx = pending[0]
+                self._drain(f"{stem}.{idx}{ext}", out, final=True)
+                self._part_cursor = idx + 1
+                self._reset_file()
+                continue
+            # The live file. A rotation between the part listing above
+            # and this read shows up as a changed inode — loop so the
+            # now-rotated part is drained first.
+            try:
+                if (self._ino is not None
+                        and os.stat(self.path).st_ino != self._ino):
+                    continue
+            except OSError:
+                break                    # no live file (yet)
+            self._drain(self.path, out, final=False)
+            break
+        return out
+
+
+def follow_records(path: str, *, poll_s: float = 0.2,
+                   stop: Callable[[], bool] | None = None):
+    """Generator live-tailing a telemetry stream across rotations: yields
+    each record once, in order, sleeping ``poll_s`` between empty polls.
+    Runs forever unless ``stop()`` returns True — after which one final
+    drain still yields everything written before the stop."""
+    follower = StreamFollower(path)
+    while True:
+        recs = follower.poll()
+        yield from recs
+        if stop is not None and stop():
+            yield from follower.poll()
+            return
+        if not recs:
+            time.sleep(poll_s)

@@ -51,6 +51,7 @@ __all__ = [
     "enabled",
     "install",
     "installed",
+    "live_spans",
     "record_span",
     "set_enabled",
     "sink_scope",
@@ -61,6 +62,32 @@ __all__ = [
 _state = threading.local()
 _ids = itertools.count(1)       # process-unique span ids (GIL-atomic)
 _enabled = os.environ.get("DMP_TRACING", "1") != "0"
+
+# Every thread's live span stack, by thread ident — the statusz
+# exporter's "what is each thread doing right now" view and the crash
+# flight recorder's span context. The stack LISTS are shared with the
+# thread-locals (mutated in place by span enter/exit), so reads here see
+# the live state; registration happens once per thread.
+_live_lock = threading.Lock()
+_live_stacks: dict[int, tuple[str, list]] = {}
+
+
+def live_spans() -> dict[str, list[str]]:
+    """The open span stack of every live thread, outermost first:
+    ``{thread_name: [span names]}``. Threads with no open span are
+    omitted; stacks of dead threads are pruned. Snapshot semantics — the
+    lists are copied, concurrent span exits cannot mutate the result."""
+    alive = {t.ident: t for t in threading.enumerate()}
+    out: dict[str, list[str]] = {}
+    with _live_lock:
+        for ident in list(_live_stacks):
+            if ident not in alive:
+                del _live_stacks[ident]
+                continue
+            name, stack = _live_stacks[ident]
+            if stack:
+                out[name] = [s[1] for s in list(stack)]
+    return out
 
 
 def enabled() -> bool:
@@ -121,6 +148,9 @@ def _stack() -> list:
     st = getattr(_state, "stack", None)
     if st is None:
         st = _state.stack = []
+        t = threading.current_thread()
+        with _live_lock:
+            _live_stacks[t.ident] = (t.name, st)
     return st
 
 

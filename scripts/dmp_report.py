@@ -608,6 +608,141 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
 
 
 # ---------------------------------------------------------------------------
+# Machine-readable report (--json): the same answers as data, not text
+# ---------------------------------------------------------------------------
+
+def _pcts(vals: list[float]) -> dict | None:
+    if not vals:
+        return None
+    return {"p50": percentile(vals, 50), "p90": percentile(vals, 90),
+            "p99": percentile(vals, 99), "max": max(vals),
+            "mean": sum(vals) / len(vals), "n": len(vals)}
+
+
+def build_report_data(records: list[dict]) -> dict:
+    """The report as one JSON-ready dict — sections as keys — so CI and
+    the cockpit consume reports without screen-scraping. The section
+    keys and the inner shapes of ``headline`` / ``resilience`` /
+    ``serving`` / ``gate`` are a pinned schema
+    (tests/test_report_json.py): additions are fine, renames and
+    removals are breaking."""
+    by_kind = _by_kind(records)
+    start = (by_kind.get("run_start") or [{}])[-1]
+    steps = by_kind.get("step") or []
+    times = [r["step_time_s"] for r in steps
+             if isinstance(r.get("step_time_s"), (int, float))]
+    throughput = None
+    for key, unit in (("tokens_per_s", "tokens/s"),
+                      ("samples_per_s", "samples/s")):
+        vals = [r[key] for r in steps
+                if isinstance(r.get(key), (int, float))]
+        if vals:
+            throughput = {"unit": unit, "mean": sum(vals) / len(vals),
+                          "max": max(vals)}
+            break
+    headline = {
+        "n_steps": len(steps),
+        "step_time_s": _pcts(times),
+        "throughput": throughput,
+    }
+    resilience_events = sorted(
+        (by_kind.get("failure") or []) + (by_kind.get("recovery") or [])
+        + (by_kind.get("consistency") or []) + (by_kind.get("resume") or [])
+        + (by_kind.get("fault") or []) + (by_kind.get("postmortem") or []),
+        key=lambda r: r.get("ts") or 0.0)
+    resilience = {
+        "failures": len(by_kind.get("failure") or []),
+        "recoveries": len(by_kind.get("recovery") or []),
+        "consistency": len(by_kind.get("consistency") or []),
+        "resumes": len(by_kind.get("resume") or []),
+        "postmortems": [r.get("bundle")
+                        for r in by_kind.get("postmortem") or []],
+        "events": resilience_events,
+    }
+    serve = by_kind.get("serve") or []
+    completed = [r for r in serve if r.get("event") == "completed"]
+    policies: dict[str, dict] = {}
+    for policy in sorted({str(r.get("policy")) for r in completed}):
+        rows = [r for r in completed if str(r.get("policy")) == policy]
+        policies[policy] = {
+            key: _pcts([r[key] for r in rows
+                        if isinstance(r.get(key), (int, float))])
+            for key in ("ttft_s", "queue_wait_s", "token_latency_s")}
+    serving = {
+        "completed": len(completed),
+        "failed": len([r for r in serve if r.get("event") == "failed"]),
+        "policies": policies,
+        "summaries": [r for r in serve if r.get("event") == "summary"],
+    }
+    gates = by_kind.get("gate") or []
+    gate = None
+    if gates:
+        g = gates[-1]
+        gate = {"ok": g.get("ok"),
+                "regressions": g.get("regressions") or [],
+                "verdicts": g.get("verdicts") or [],
+                "no_baseline": g.get("no_baseline") or [],
+                "ledger": g.get("ledger")}
+    spans: dict[str, dict] = {}
+    for r in by_kind.get("span") or []:
+        d = r.get("dur_s")
+        if isinstance(d, (int, float)):
+            cell = spans.setdefault(str(r.get("name")),
+                                    {"total_s": 0.0, "count": 0})
+            cell["total_s"] += float(d)
+            cell["count"] += 1
+    alerts = by_kind.get("alert") or []
+    snaps = by_kind.get("metrics") or []
+    ends = by_kind.get("run_end") or []
+    return {
+        "run": {"run": start.get("run"), "device": start.get("device"),
+                "jax": start.get("jax"), "meta": start.get("meta")},
+        "headline": headline,
+        "resilience": resilience,
+        "serving": serving,
+        "gate": gate,
+        "plan": by_kind.get("plan") or [],
+        "spans": spans,
+        "alerts": alerts,
+        "counters": (snaps[-1].get("counters") or {}) if snaps else {},
+        "epochs": {"count": len(by_kind.get("epoch") or []),
+                   "last": (by_kind.get("epoch") or [None])[-1]},
+        "wall_s": ends[-1].get("wall_s") if ends else None,
+    }
+
+
+def build_fleet_data(records: list[dict]) -> dict:
+    """The fleet report as data: tenant table, fault ledger, health and
+    alert timelines, unrecovered ledger."""
+    tenants = sorted({r["tenant"] for r in records if r.get("tenant")})
+    lifecycle = [r for r in records if r.get("kind") == "tenant"]
+    out_tenants: dict[str, dict] = {}
+    for tenant in tenants:
+        recs = [r for r in records if r.get("tenant") == tenant]
+        by_kind = _by_kind(recs)
+        states = [r for r in lifecycle if r.get("name") == tenant]
+        out_tenants[tenant] = {
+            "state": states[-1].get("event") if states else None,
+            "failures": len(by_kind.get("failure") or []),
+            "recoveries": len(by_kind.get("recovery") or []),
+            "resumes": len(by_kind.get("resume") or []),
+            "epochs": len(by_kind.get("epoch") or []),
+            "postmortems": [r.get("bundle")
+                            for r in by_kind.get("postmortem") or []],
+        }
+    ledger = pair_faults(records)
+    return {
+        "tenants": out_tenants,
+        "ledger": ledger,
+        "unpaired": [r for r in ledger if not r["paired"]],
+        "unrecovered": [{"name": r.get("name"), "error": r.get("error")}
+                        for r in lifecycle if r.get("event") == "failed"],
+        "health": [r for r in records if r.get("kind") == "health"],
+        "alerts": [r for r in records if r.get("kind") == "alert"],
+    }
+
+
+# ---------------------------------------------------------------------------
 # Fleet report: merged multi-tenant streams (orchestrator/ + dmp_soak.py)
 # ---------------------------------------------------------------------------
 
@@ -837,6 +972,11 @@ def main(argv=None) -> None:
                         "jax.profiler.start_trace) to join in")
     p.add_argument("--top", type=int, default=15,
                    help="top device ops to print from the trace")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as machine-readable JSON "
+                        "(sections as keys; stable schema for the "
+                        "headline/resilience/serving/gate sections) "
+                        "instead of the text renderer")
     args = p.parse_args(argv)
     for path in args.jsonl:
         if not os.path.exists(path):
@@ -852,11 +992,26 @@ def main(argv=None) -> None:
         records = merge_streams(args.jsonl)
         if not records:
             raise SystemExit("no parseable records in any stream")
+        if args.json:
+            import json
+
+            print(json.dumps(build_fleet_data(records), indent=2,
+                             default=str))
+            return
         print(build_fleet_report(records))
         return
     records = read_records(args.jsonl[0])
     if not records:
         raise SystemExit(f"{args.jsonl[0]} holds no parseable records")
+    if args.json:
+        import json
+
+        if args.trace:
+            raise SystemExit("--trace joins the text report; the JSON "
+                             "schema carries stream data only")
+        print(json.dumps(build_report_data(records), indent=2,
+                         default=str))
+        return
     print(build_report(records, trace_dir=args.trace, top=args.top))
 
 

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""dmp_top: a live cockpit for a running fleet.
+
+``dmp_report.py`` answers "what happened"; this answers "what is
+happening". It live-tails one or more telemetry streams (rotation-safe
+— ``utils/telemetry.StreamFollower`` follows a stream across its
+``{stem}.N.jsonl`` rollovers) and/or polls a running process's
+``/statusz`` exporter (``utils/statusz.py``), folds the records into a
+fleet state, and renders a refreshing terminal view:
+
+* one row per tenant/run: state, devices, global step, step rate,
+  throughput, MFU (when the stream recorded FLOPs/step and the device
+  has a peak-FLOPs table entry — honest ``-`` otherwise), loss, and
+  recent fault/failure counts;
+* the device-health line: quarantined devices and worst scores;
+* firing alerts (typed ``alert`` records, utils/alerts.py) and recent
+  postmortem bundles (``postmortem`` records, utils/flightrec.py);
+* the serving engines' queue depth / page occupancy when a ``/statusz``
+  endpoint is polled.
+
+Usage:
+  python scripts/dmp_top.py fleet/fleet.jsonl t0/log/t0.jsonl ...
+  python scripts/dmp_top.py --statusz http://127.0.0.1:9200 log/lm.jsonl
+  python scripts/dmp_top.py log/train.jsonl --once        # one frame (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    StreamFollower,
+)
+
+
+class FleetState:
+    """Telemetry records + statusz polls folded into a render-ready
+    fleet view. Pure state machine — deterministic under replay, so the
+    tests drive it with canned records."""
+
+    def __init__(self):
+        self.tenants: dict[str, dict] = {}
+        self.firing: dict[tuple[str, str], dict] = {}
+        self.quarantined: set[int] = set()
+        self.postmortems: list[str] = []
+        self.statusz: dict | None = None
+        self.last_ts: float = 0.0
+        # Untenanted streams (a plain trainer run) attribute their
+        # records to the last run_start's run name.
+        self._default_run = ""
+
+    def _tenant(self, name: str) -> dict:
+        return self.tenants.setdefault(name, {
+            "state": "?", "devices": [], "step": 0, "step_time_s": None,
+            "throughput": None, "unit": "", "loss": None, "faults": 0,
+            "failures": 0, "mfu": None, "workload": "",
+            "flops_per_step": None, "n_devices": None,
+        })
+
+    # -- ingest --------------------------------------------------------------
+    def observe(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            self.last_ts = max(self.last_ts, ts)
+        subject = str(rec.get("tenant") or rec.get("run")
+                      or self._default_run)
+        if kind == "run_start":
+            subject = str(rec.get("tenant") or rec.get("run", "run"))
+            self._default_run = subject
+            t = self._tenant(subject)
+            meta = rec.get("meta") or {}
+            t["workload"] = meta.get("workload", t["workload"])
+            t["flops_per_step"] = meta.get("model_flops_per_step")
+            t["n_devices"] = (rec.get("device") or {}).get("n_devices")
+            t["device_kind"] = (rec.get("device") or {}).get("device_kind")
+            if t["state"] == "?":
+                t["state"] = "running"
+        elif kind == "step" and subject:
+            t = self._tenant(subject)
+            if rec.get("step") is not None:
+                t["step"] = rec.get("step")
+            if isinstance(rec.get("step_time_s"), (int, float)):
+                t["step_time_s"] = rec["step_time_s"]
+                self._refresh_mfu(t)
+            for key, unit in (("tokens_per_s", "tok/s"),
+                              ("samples_per_s", "smp/s")):
+                if isinstance(rec.get(key), (int, float)):
+                    t["throughput"], t["unit"] = rec[key], unit
+            if isinstance(rec.get("loss"), (int, float)):
+                t["loss"] = rec["loss"]
+        elif kind == "tenant":
+            t = self._tenant(str(rec.get("name")))
+            t["state"] = str(rec.get("event", t["state"]))
+            if rec.get("devices") is not None:
+                t["devices"] = rec.get("devices")
+            if rec.get("global_step") is not None:
+                t["step"] = rec.get("global_step")
+        elif kind == "fault" and subject:
+            self._tenant(subject)["faults"] += 1
+        elif kind == "failure" and subject:
+            self._tenant(subject)["failures"] += 1
+        elif kind == "health":
+            for d in rec.get("devices") or []:
+                if rec.get("event") == "quarantine":
+                    self.quarantined.add(int(d))
+                elif rec.get("event") == "reinstate":
+                    self.quarantined.discard(int(d))
+        elif kind == "alert":
+            key = (str(rec.get("rule")), str(rec.get("subject")))
+            if rec.get("state") == "firing":
+                self.firing[key] = rec
+            else:
+                self.firing.pop(key, None)
+        elif kind == "postmortem":
+            self.postmortems.append(str(rec.get("bundle")))
+
+    def _refresh_mfu(self, t: dict) -> None:
+        """MFU from stream data alone: FLOPs/step / n_devices /
+        step_time / chip peak — None (rendered ``-``) whenever any
+        factor is missing (CPU has no peak entry; CNN streams record no
+        FLOPs). Same honesty rule as the report."""
+        try:
+            from distributed_model_parallel_tpu.utils.profiling import (
+                TPU_PEAK_FLOPS,
+                match_device_kind,
+            )
+
+            peak = match_device_kind(TPU_PEAK_FLOPS,
+                                     kind=t.get("device_kind") or "")
+            flops, n = t.get("flops_per_step"), t.get("n_devices")
+            if peak and flops and n and t["step_time_s"]:
+                t["mfu"] = flops / n / t["step_time_s"] / peak
+        except Exception:
+            pass
+
+    def poll_statusz(self, url: str) -> None:
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + "/statusz",
+                                        timeout=2) as resp:
+                self.statusz = json.load(resp)
+        except Exception as e:
+            self.statusz = {"error": f"{type(e).__name__}: {e}"}
+            return
+        health = self.statusz.get("health") or {}
+        for d in health.get("quarantined") or []:
+            self.quarantined.add(int(d))
+
+    # -- render --------------------------------------------------------------
+    def render(self) -> str:
+        lines = []
+        firing = sorted(self.firing)
+        head = (f"dmp_top  {len(self.tenants)} runs  "
+                f"quarantined={sorted(self.quarantined) or '[]'}  "
+                f"alerts={'NONE' if not firing else len(firing)}")
+        lines.append(head)
+        lines.append("-" * max(72, len(head)))
+        lines.append(f"{'run':<14}{'state':<20}{'step':>7}{'rate':>10}"
+                     f"{'thruput':>14}{'MFU':>7}{'loss':>9}"
+                     f"{'faults':>7}{'fail':>6}  devices")
+        for name, t in sorted(self.tenants.items()):
+            rate = (f"{1.0 / t['step_time_s']:.1f}/s"
+                    if t.get("step_time_s") else "-")
+            thr = (f"{t['throughput']:,.0f} {t['unit']}"
+                   if t.get("throughput") is not None else "-")
+            mfu = f"{t['mfu']:.3f}" if t.get("mfu") is not None else "-"
+            loss = (f"{t['loss']:.4g}" if t.get("loss") is not None
+                    else "-")
+            lines.append(
+                f"{name[:13]:<14}{t['state'][:19]:<20}{t['step']:>7}"
+                f"{rate:>10}{thr:>14}{mfu:>7}{loss:>9}"
+                f"{t['faults']:>7}{t['failures']:>6}  {t['devices']}")
+        for key in firing:
+            rec = self.firing[key]
+            lines.append(f"ALERT firing  {key[0]}"
+                         + (f"[{key[1]}]" if key[1] else "")
+                         + f"  value={rec.get('value')} "
+                           f"threshold={rec.get('threshold')}")
+        for p in self.postmortems[-3:]:
+            lines.append(f"POSTMORTEM  {p}")
+        if self.statusz is not None:
+            if "error" in self.statusz:
+                lines.append(f"statusz: {self.statusz['error']}")
+            else:
+                for name, prov in sorted(
+                        (self.statusz.get("providers") or {}).items()):
+                    if prov.get("workload") == "serve":
+                        lines.append(
+                            f"serve[{name}]  queue={prov.get('queue_depth')}"
+                            f"  active={prov.get('active_requests')}"
+                            f"/{prov.get('n_slots')} slots"
+                            f"  pages={prov.get('page_occupancy'):.2f}"
+                            if isinstance(prov.get("page_occupancy"),
+                                          (int, float))
+                            else f"serve[{name}]  "
+                                 f"queue={prov.get('queue_depth')}")
+                spans = self.statusz.get("spans") or {}
+                for thread, stack in sorted(spans.items()):
+                    lines.append(f"span  {thread}: {' > '.join(stack)}")
+        return "\n".join(lines)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="Live fleet cockpit over telemetry streams and/or a "
+                    "/statusz exporter")
+    p.add_argument("jsonl", nargs="*",
+                   help="telemetry stream(s) to live-tail (the fleet "
+                        "stream plus per-tenant streams; rotation-safe)")
+    p.add_argument("--statusz", default=None, metavar="URL",
+                   help="poll this exporter's /statusz each frame "
+                        "(e.g. http://127.0.0.1:9200)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval seconds")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (CI / scripting)")
+    p.add_argument("--frames", type=int, default=None,
+                   help="exit after N frames")
+    args = p.parse_args(argv)
+    if not args.jsonl and not args.statusz:
+        raise SystemExit("give at least one stream or --statusz URL")
+    state = FleetState()
+    followers = [StreamFollower(path) for path in args.jsonl]
+    frame = 0
+    while True:
+        for f in followers:
+            for rec in f.poll():
+                state.observe(rec)
+        if args.statusz:
+            state.poll_statusz(args.statusz)
+        out = state.render()
+        if args.once or args.frames is not None:
+            print(out, flush=True)
+        else:
+            # Full-screen refresh: clear + home, like top(1).
+            print("\x1b[2J\x1b[H" + out, flush=True)
+        frame += 1
+        if args.once or (args.frames is not None and frame >= args.frames):
+            return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

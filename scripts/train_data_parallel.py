@@ -167,6 +167,14 @@ def parse_args():
 
 def main():
     args = parse_args()
+    # Crash flight recorder (utils/flightrec.py): DMP_FLIGHT_RECORDER=
+    # <dir> tees every telemetry record into a bounded ring and arms an
+    # unhandled-exception hook that fsyncs the failure record, closes
+    # the live streams, and dumps a postmortem bundle (ring tail +
+    # all-thread stacks + span stacks + device memory + health scores).
+    from distributed_model_parallel_tpu.utils import flightrec
+
+    flightrec.install_from_env()
     best_effort_distributed_init()
     # First device contact, hardened (bench.py's bounded-retry pattern): a
     # permanently unreachable backend becomes one parseable JSON record +

@@ -85,6 +85,11 @@ def parse_args():
 
 def main():
     args = parse_args()
+    # Crash flight recorder opt-in (utils/flightrec.py): ring tee +
+    # unhandled-exception postmortem hook under DMP_FLIGHT_RECORDER.
+    from distributed_model_parallel_tpu.utils import flightrec
+
+    flightrec.install_from_env()
     # First device contact, hardened (bench.py's bounded-retry pattern):
     # an unreachable backend becomes one parseable JSON record + exit 17.
     from distributed_model_parallel_tpu.utils.device_contact import (

@@ -63,8 +63,12 @@ def _documented_kinds() -> set[str]:
 def test_every_emitted_record_kind_is_documented():
     emitted = _emitted_kinds()
     # Sanity: the grep actually found the core kinds — an empty emitted
-    # set would make this lint vacuously green.
-    assert {"run_start", "step", "failure", "recovery", "tenant"} <= emitted
+    # set would make this lint vacuously green. The observability-plane
+    # kinds (alert: utils/alerts.py firing/resolved transitions;
+    # postmortem: utils/flightrec.py bundle pointers) are pinned here so
+    # a refactor that stops emitting them fails loudly too.
+    assert {"run_start", "step", "failure", "recovery", "tenant",
+            "alert", "postmortem"} <= emitted
     missing = sorted(emitted - _documented_kinds())
     assert not missing, (
         f"telemetry record kinds emitted but missing from the "
@@ -89,6 +93,38 @@ def test_every_metric_name_is_documented():
         f"registry metric names emitted but never mentioned in "
         f"docs/OBSERVABILITY.md: {missing} — add each to the metric "
         f"tables (counters / gauges / histograms)")
+
+
+def test_statusz_endpoints_and_bundle_format_are_documented():
+    """The live observability plane's wire surfaces are contracts too:
+    every HTTP endpoint the statusz exporter serves and every file a
+    postmortem bundle contains must be named in docs/OBSERVABILITY.md —
+    Prometheus scrape configs and bundle consumers build against them.
+    The expected sets are read from the CODE (the handler's literal
+    paths, the manifest's file list), so adding an endpoint or bundle
+    file without documenting it fails here."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    statusz_src = (REPO / "distributed_model_parallel_tpu" / "utils"
+                   / "statusz.py").read_text()
+    # ANY literal "/word" path the handler compares against is a served
+    # endpoint — a newly added one lands here without a whitelist edit.
+    endpoints = {e for e in re.findall(r'"(/[a-z]+)"', statusz_src)}
+    assert {"/metrics", "/statusz", "/healthz"} <= endpoints
+    missing = sorted(e for e in endpoints if f"`{e}`" not in doc)
+    assert not missing, (
+        f"statusz endpoints served but missing from "
+        f"docs/OBSERVABILITY.md: {missing}")
+    flight_src = (REPO / "distributed_model_parallel_tpu" / "utils"
+                  / "flightrec.py").read_text()
+    # ANY _write("name.ext", ...) call defines a bundle member.
+    bundle_files = set(re.findall(r'_write\("([a-z_]+\.[a-z]+)"',
+                                  flight_src))
+    assert {"manifest.json", "records.jsonl", "stacks.txt"} <= bundle_files
+    missing = sorted(f for f in bundle_files if f"``{f}``" not in doc
+                     and f"`{f}`" not in doc)
+    assert not missing, (
+        f"postmortem bundle files written but missing from "
+        f"docs/OBSERVABILITY.md: {missing}")
 
 
 def test_durations_never_subtract_wall_clock():

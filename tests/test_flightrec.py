@@ -1,0 +1,218 @@
+"""utils/flightrec.py: the crash flight recorder — the free record tee
+into the bounded ring, postmortem bundle contents, the trigger wiring
+(supervisor unrecovered exit, killed serving engine), the drivers'
+unhandled-exception hook, and the no-op-when-uninstalled contract."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from distributed_model_parallel_tpu.utils import flightrec, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    flightrec.uninstall()
+    yield
+    flightrec.uninstall()
+    flightrec.uninstall_excepthook()
+
+
+def _bundle(path):
+    return {name: open(os.path.join(path, name)).read()
+            for name in os.listdir(path)}
+
+
+# ---------------------------------------------------------------------------
+# the ring tee
+# ---------------------------------------------------------------------------
+
+def test_telemetry_records_tee_into_bounded_ring(tmp_path):
+    rec = flightrec.install(flightrec.FlightRecorder(
+        dir=str(tmp_path / "pm"), capacity=4))
+    run = telemetry.TelemetryRun(str(tmp_path / "r.jsonl"), run="t",
+                                 track_compiles=False,
+                                 device={"platform": "cpu"})
+    for i in range(10):
+        run.record("event", message=f"m{i}")
+    ring = rec.records()
+    assert len(ring) == 4
+    assert [r["message"] for r in ring] == ["m6", "m7", "m8", "m9"]
+
+
+def test_no_recorder_means_no_tee_and_no_dump(tmp_path):
+    assert flightrec.installed() is None
+    assert telemetry.record_tap() is None        # true no-op on the hot path
+    assert flightrec.dump("anything") is None    # triggers all no-op
+
+
+# ---------------------------------------------------------------------------
+# bundle contents
+# ---------------------------------------------------------------------------
+
+def test_dump_postmortem_bundle_contents(tmp_path):
+    rec = flightrec.install(flightrec.FlightRecorder(
+        dir=str(tmp_path / "pm"), capacity=8))
+    run = telemetry.TelemetryRun(str(tmp_path / "r.jsonl"), run="t",
+                                 track_compiles=False,
+                                 device={"platform": "cpu"})
+    run.failure("pre-crash", detail="context")
+    try:
+        raise ValueError("the failing thing")
+    except ValueError as e:
+        path = flightrec.dump("test-crash", telemetry_run=run, error=e)
+    assert path is not None and os.path.isdir(path)
+    files = _bundle(path)
+    assert set(files) == {"manifest.json", "records.jsonl", "stacks.txt",
+                          "spans.json", "memory.json", "health.json"}
+    manifest = json.loads(files["manifest.json"])
+    assert manifest["reason"] == "test-crash"
+    assert "ValueError: the failing thing" in manifest["error"]
+    # The ring tail includes the pre-crash failure record.
+    ring = [json.loads(ln) for ln in files["records.jsonl"].splitlines()]
+    assert any(r["kind"] == "failure" and r["error"] == "pre-crash"
+               for r in ring)
+    # The failing exception's own traceback + every live thread.
+    assert "ValueError: the failing thing" in files["stacks.txt"]
+    assert "MainThread" in files["stacks.txt"]
+    # The typed postmortem record points at the bundle (and the tee saw
+    # it too).
+    recs = telemetry.read_records(str(tmp_path / "r.jsonl"))
+    pm = [r for r in recs if r["kind"] == "postmortem"]
+    assert len(pm) == 1 and pm[0]["bundle"] == path
+    assert pm[0]["reason"] == "test-crash"
+    assert path in rec.dumps
+
+
+def test_dump_uses_installed_recorder_dir(tmp_path):
+    flightrec.install(flightrec.FlightRecorder(dir=str(tmp_path / "pm")))
+    path = flightrec.dump("r1")
+    assert path is not None and path.startswith(str(tmp_path / "pm"))
+    # Distinct bundles for repeated dumps (unique suffix or timestamp).
+    path2 = flightrec.dump("r1")
+    assert path2 is not None and path2 != path
+
+
+# ---------------------------------------------------------------------------
+# triggers
+# ---------------------------------------------------------------------------
+
+def test_supervisor_unrecovered_exit_dumps_postmortem(tmp_path):
+    """Exhausted retry budget == the run is about to die unrecovered —
+    the supervisor's False-return path must leave a bundle."""
+    from distributed_model_parallel_tpu.config import RecoveryConfig
+    from distributed_model_parallel_tpu.train.checkpoint import Checkpointer
+    from distributed_model_parallel_tpu.train.logging_util import RunLogger
+    from distributed_model_parallel_tpu.train.preemption import (
+        PreemptionGuard,
+    )
+    from distributed_model_parallel_tpu.train.resilience import (
+        RecoverySupervisor,
+    )
+
+    flightrec.install(flightrec.FlightRecorder(dir=str(tmp_path / "pm")))
+    logger = RunLogger(str(tmp_path / "log"), "sup", echo=False)
+    sup = RecoverySupervisor(
+        RecoveryConfig(max_retries=1), logger=logger,
+        ckpt=Checkpointer(str(tmp_path / "ckpt")),
+        preemption=PreemptionGuard())
+    sup.retries_left = 0                      # budget already spent
+    ok = sup.recover_nonfinite(FloatingPointError("nan"), epoch=0,
+                               restore=lambda: None)
+    assert ok is False
+    rec = flightrec.installed()
+    assert len(rec.dumps) == 1
+    manifest = json.loads(open(os.path.join(
+        rec.dumps[0], "manifest.json")).read())
+    assert manifest["reason"].startswith("unrecovered-non-finite")
+    recs = telemetry.read_records(logger.jsonl_path)
+    assert any(r["kind"] == "postmortem" for r in recs)
+
+
+@pytest.mark.serve
+def test_engine_killed_dumps_postmortem(tmp_path):
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import Engine, ServeConfig
+    from distributed_model_parallel_tpu.serve.engine import EngineKilled
+
+    flightrec.install(flightrec.FlightRecorder(dir=str(tmp_path / "pm")))
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq_len=64,
+                                pos_embedding="rope")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    run = telemetry.TelemetryRun(str(tmp_path / "serve.jsonl"), run="s",
+                                 track_compiles=False,
+                                 device={"platform": "cpu"})
+
+    def _kill(iteration):
+        if iteration >= 2:
+            raise RuntimeError("chaos kill")
+
+    eng = Engine(params, cfg,
+                 ServeConfig(n_slots=2, page_size=8, n_pages=32,
+                             max_seq_len=64, prefill_chunk=8),
+                 telemetry=run, step_hook=_kill)
+    eng.submit([1, 2, 3], 8)
+    eng.submit([4, 5], 8)
+    with pytest.raises(EngineKilled):
+        eng.run()
+    rec = flightrec.installed()
+    assert len(rec.dumps) == 1
+    manifest = json.loads(open(os.path.join(
+        rec.dumps[0], "manifest.json")).read())
+    assert manifest["reason"] == "engine-killed"
+    assert "chaos kill" in manifest["error"]
+    recs = telemetry.read_records(str(tmp_path / "serve.jsonl"))
+    assert any(r["kind"] == "postmortem" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# the drivers' unhandled-exception hook
+# ---------------------------------------------------------------------------
+
+def test_excepthook_writes_failure_closes_streams_and_dumps(tmp_path):
+    import sys
+
+    flightrec.install(flightrec.FlightRecorder(dir=str(tmp_path / "pm")))
+    run = telemetry.TelemetryRun(str(tmp_path / "r.jsonl"), run="t",
+                                 track_compiles=False,
+                                 device={"platform": "cpu"})
+    chained = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: chained.append(a)
+    try:
+        flightrec.install_excepthook()
+        try:
+            raise RuntimeError("driver died")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        flightrec.uninstall_excepthook()
+        sys.excepthook = prev
+    assert len(chained) == 1                     # previous hook chained
+    recs = telemetry.read_records(str(tmp_path / "r.jsonl"))
+    kinds = [r["kind"] for r in recs]
+    assert "failure" in kinds                    # fsync'd failure record
+    assert "postmortem" in kinds                 # bundle pointer
+    assert kinds[-1] == "run_end"                # stream closed
+    fail = next(r for r in recs if r["kind"] == "failure")
+    assert fail["error"] == "unhandled-exception"
+    assert "driver died" in fail["detail"]
+
+
+def test_install_from_env_is_noop_when_unset(tmp_path, monkeypatch):
+    monkeypatch.delenv("DMP_FLIGHT_RECORDER", raising=False)
+    assert flightrec.install_from_env() is None
+    assert flightrec.installed() is None
+
+
+def test_install_from_env_installs_recorder_and_hook(tmp_path, monkeypatch):
+    monkeypatch.setenv("DMP_FLIGHT_RECORDER", str(tmp_path / "bundles"))
+    rec = flightrec.install_from_env()
+    assert rec is not None
+    assert rec.dir == str(tmp_path / "bundles")
+    assert flightrec.installed() is rec

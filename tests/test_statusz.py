@@ -1,0 +1,212 @@
+"""utils/statusz.py: the live status exporter — Prometheus /metrics
+(per-tenant label series), the /statusz JSON fleet view (providers +
+health + span built-ins), the /healthz 200/503 contract, the
+one-exporter-per-process rule, and the true-no-op-when-unset contract.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_model_parallel_tpu.utils import health, statusz, tracing
+from distributed_model_parallel_tpu.utils.telemetry import (
+    TelemetryRun,
+    registry,
+    tenant_scope,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_exporter():
+    statusz.shutdown()
+    yield
+    statusz.shutdown()
+    health.uninstall()
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def test_maybe_serve_is_noop_without_port(monkeypatch):
+    monkeypatch.delenv("DMP_STATUSZ_PORT", raising=False)
+    assert statusz.maybe_serve(None) is None
+    assert statusz.active() is None
+    # register without a server drops the registration — no growth.
+    assert statusz.register("x", dict) is False
+    assert statusz.registered() == ()
+
+
+def test_one_exporter_per_process(monkeypatch):
+    monkeypatch.delenv("DMP_STATUSZ_PORT", raising=False)
+    s1 = statusz.maybe_serve(0)
+    s2 = statusz.maybe_serve(0)          # second port request joins s1
+    s3 = statusz.maybe_serve(None)       # no port at all also joins
+    assert s1 is s2 is s3
+    assert s1.port > 0
+
+
+def test_env_port_starts_exporter(monkeypatch):
+    monkeypatch.setenv("DMP_STATUSZ_PORT", "0")
+    s = statusz.maybe_serve(None)
+    assert s is not None and s.port > 0
+
+
+# ---------------------------------------------------------------------------
+# /metrics
+# ---------------------------------------------------------------------------
+
+def test_metrics_prometheus_exposition_with_tenant_labels():
+    s = statusz.maybe_serve(0)
+    c = registry().counter("statusz_test_ctr", kind="x")
+    c.inc(2)
+    with tenant_scope("ten0"):
+        c.inc(3)
+    registry().gauge("statusz_test_gauge").set(0.5)
+    registry().histogram("statusz_test_hist").observe(0.25)
+    code, body = _get(s.url + "/metrics")
+    assert code == 200
+    assert '# TYPE statusz_test_ctr counter' in body
+    assert 'statusz_test_ctr{kind="x"} 5' in body
+    assert 'statusz_test_ctr{kind="x",tenant="ten0"} 3' in body
+    assert 'statusz_test_gauge 0.5' in body
+    assert 'statusz_test_hist{quantile="0.5"}' in body
+    assert 'statusz_test_hist_count 1' in body
+    assert 'statusz_test_hist_sum 0.25' in body
+
+
+# ---------------------------------------------------------------------------
+# /statusz
+# ---------------------------------------------------------------------------
+
+def test_statusz_renders_providers_health_and_spans(tmp_path):
+    s = statusz.maybe_serve(0)
+    statusz.register("demo", lambda: {"workload": "demo", "step": 7})
+    monitor = health.install(health.DeviceHealthMonitor())
+    monitor.observe_stall([3], 9.0)
+    run = TelemetryRun(str(tmp_path / "t.jsonl"), run="t",
+                       track_compiles=False, device={"platform": "cpu"})
+    opened = threading.Event()
+    release = threading.Event()
+
+    def _worker():
+        tracing.install(run)             # sinks are thread-local
+        with tracing.span("outer"), tracing.span("inner"):
+            opened.set()
+            release.wait(10)
+
+    t = threading.Thread(target=_worker, name="spanner", daemon=True)
+    t.start()
+    assert opened.wait(10)
+    try:
+        code, body = _get(s.url + "/statusz")
+        payload = json.loads(body)
+        assert code == 200
+        assert payload["providers"]["demo"] == {"workload": "demo",
+                                                "step": 7}
+        assert payload["health"]["scores"]["3"] < 1.0
+        assert payload["spans"]["spanner"] == ["outer", "inner"]
+    finally:
+        release.set()
+        t.join()
+        tracing.uninstall()
+
+
+def test_statusz_survives_dying_provider():
+    s = statusz.maybe_serve(0)
+
+    def _boom():
+        raise RuntimeError("provider died")
+
+    statusz.register("bad", _boom)
+    statusz.register("good", lambda: {"ok": 1})
+    code, body = _get(s.url + "/statusz")
+    payload = json.loads(body)
+    assert code == 200
+    assert payload["providers"]["good"] == {"ok": 1}
+    assert "RuntimeError" in payload["providers"]["bad"]["error"]
+
+
+def test_register_replaces_by_name():
+    statusz.maybe_serve(0)
+    statusz.register("t", lambda: {"v": 1})
+    statusz.register("t", lambda: {"v": 2})     # re-admitted tenant
+    assert statusz.status_payload()["providers"]["t"] == {"v": 2}
+
+
+# ---------------------------------------------------------------------------
+# /healthz
+# ---------------------------------------------------------------------------
+
+def test_healthz_200_when_healthy_503_on_quarantine_or_provider():
+    s = statusz.maybe_serve(0)
+    code, body = _get(s.url + "/healthz")
+    assert code == 200 and json.loads(body)["ok"] is True
+
+    statusz.register("sick", lambda: {"healthy": False})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(s.url + "/healthz")
+    assert e.value.code == 503
+    assert any("sick" in r for r in json.load(e.value)["reasons"])
+    statusz.unregister("sick")
+
+    monitor = health.install(health.DeviceHealthMonitor())
+    monitor.observe_stall([0], 9.0)
+    monitor.observe_stall([0], 9.0)             # score 0 -> quarantined
+    assert monitor.quarantined_ids == (0,)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(s.url + "/healthz")
+    assert e.value.code == 503
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring
+# ---------------------------------------------------------------------------
+
+def test_trainer_registers_provider_with_run_state(tmp_path):
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    s = statusz.maybe_serve(0)
+    config = tiny_train_config(tmp_path, epochs=1)
+    t = Trainer(config)
+    assert config.log_name in statusz.registered()
+    code, body = _get(s.url + "/statusz")
+    prov = json.loads(body)["providers"][config.log_name]
+    assert prov["workload"] == "cnn"
+    assert prov["global_step"] == 0
+    assert prov["plan"]["strategy"] == "gspmd"
+    assert prov["plan"]["axes"]["dp"] == 8
+    assert prov["healthy"] is True
+    t.fit()
+    code, body = _get(s.url + "/statusz")
+    prov = json.loads(body)["providers"][config.log_name]
+    assert prov["global_step"] == 3              # 96/32 x 1 epoch
+
+
+def test_trainer_under_tenant_scope_registers_tenant_name(tmp_path):
+    from tests.conftest import tiny_train_config
+    from distributed_model_parallel_tpu.train.trainer import Trainer
+
+    statusz.maybe_serve(0)
+    with tenant_scope("tenantA"):
+        Trainer(tiny_train_config(tmp_path, epochs=1))
+    assert "tenantA" in statusz.registered()
+
+
+def test_health_monitor_snapshot_shape():
+    m = health.DeviceHealthMonitor()
+    m.observe_stall([1], 5.0)
+    snap = m.snapshot()
+    assert snap["states"]["1"] in ("healthy", "quarantined")
+    assert 0.0 <= snap["scores"]["1"] <= 1.0
+    assert snap["quarantined"] == [] or snap["quarantined"] == [1]
+    assert snap["ticks"] == 0
