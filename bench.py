@@ -25,6 +25,12 @@ DMP_BENCH_WORKLOAD=serve replays a seeded open-loop Poisson trace through
 the continuous-batching serving engine (serve/) against the static-batch
 baseline and reports tokens/s/chip + p50/p99 TTFT/per-token latency +
 page-pool occupancy (DMP_BENCH_SERVE_* knobs; docs/SERVING.md).
+DMP_BENCH_SERVE_TRACE=chat switches to a seeded MULTI-TURN chat trace
+(shared system prompt + per-conversation turns, each turn re-sending the
+full history) replayed through the engine with prefix caching +
+speculative decoding ON vs both OFF (the PR 9 engine) — the headline
+gains cache_hit_rate / prefill_tokens_saved / draft_accept_rate and the
+bar is >3x tokens/s/chip (DMP_BENCH_SERVE_CHAT_* knobs).
 
 Failure semantics: first device contact retries with backoff
 (DMP_BENCH_RETRIES, DMP_BENCH_RETRY_DELAY_S); a permanently unreachable
@@ -584,6 +590,204 @@ def build_serve_trace():
     return trace, cfg
 
 
+def build_serve_chat_trace():
+    """Seeded multi-turn chat trace (``DMP_BENCH_SERVE_TRACE=chat``):
+    ``CONVS`` conversations share one system prompt and run ``TURNS``
+    turns each; every turn re-sends the full history (system + all prior
+    user/assistant exchanges) plus fresh user tokens — the redundancy
+    profile real chat traffic has and prefix caching monetizes.
+    Generation lengths are fixed per (conversation, turn) draws so the
+    same trace replays bit-for-bit through every engine configuration.
+    Returns ``(chat, cfg)``; knobs:
+    DMP_BENCH_SERVE_CHAT_{CONVS,TURNS,SYSTEM,USER,GEN} plus the shared
+    DMP_BENCH_SERVE_{SEED,VOCAB,DMODEL,LAYERS,DFF}."""
+    from distributed_model_parallel_tpu.models import transformer as tfm
+
+    rng = np.random.default_rng(int(os.environ.get(
+        "DMP_BENCH_SERVE_SEED", "0")))
+    n_convs = int(os.environ.get("DMP_BENCH_SERVE_CHAT_CONVS", "8"))
+    n_turns = int(os.environ.get("DMP_BENCH_SERVE_CHAT_TURNS", "5"))
+    # A tool-heavy agent profile: the shared system prompt dominates the
+    # first turn, the replayed history dominates the rest, and replies
+    # are short and structured — the redundancy real multi-turn traffic
+    # shows (vLLM/SGLang report >70% prefix reuse for agentic
+    # workloads, where contexts are huge and tool-call outputs small).
+    sys_len = int(os.environ.get("DMP_BENCH_SERVE_CHAT_SYSTEM", "512"))
+    user_len = int(os.environ.get("DMP_BENCH_SERVE_CHAT_USER", "16"))
+    gen_cap = int(os.environ.get("DMP_BENCH_SERVE_CHAT_GEN", "32"))
+    vocab = int(os.environ.get("DMP_BENCH_SERVE_VOCAB", "8192"))
+    max_seq = sys_len + n_turns * (user_len + gen_cap)
+    # Chat mode defaults to float32: the cross-config determinism gate
+    # (cache+spec tokens == baseline tokens, asserted every run) compares
+    # tokens across three compiled program shapes, and bf16's coarse
+    # rounding can flip greedy near-ties between shapes on CPU — f32 is
+    # bitwise stable across all of them (same reason attend_rows pins
+    # f32 score accumulation). DMP_BENCH_SERVE_DTYPE=bfloat16 opts back.
+    dtype = jnp.dtype(os.environ.get("DMP_BENCH_SERVE_DTYPE", "float32"))
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab,
+        d_model=int(os.environ.get("DMP_BENCH_SERVE_DMODEL", "512")),
+        n_heads=8,
+        n_layers=int(os.environ.get("DMP_BENCH_SERVE_LAYERS", "4")),
+        d_ff=int(os.environ.get("DMP_BENCH_SERVE_DFF", "2048")),
+        max_seq_len=max_seq, pos_embedding="rope", dtype=dtype)
+    system = [int(x) for x in rng.integers(0, vocab, sys_len)]
+    # Conversation STARTS stagger (open-loop reality: sessions do not
+    # all begin in the same instant) — so the first conversation's
+    # prefill publishes the shared system prompt to the radix tree
+    # before the rest arrive, instead of 8 thundering-herd cold
+    # prefills of the same prefix. Tokens are unaffected (pure function
+    # of prompt + seed); only admission timing moves.
+    stagger = float(os.environ.get("DMP_BENCH_SERVE_CHAT_STAGGER_S",
+                                   "0.3"))
+    chat = {"system": system, "n_turns": n_turns, "stagger_s": stagger,
+            "convs": []}
+    for c in range(n_convs):
+        chat["convs"].append({
+            "users": [[int(x) for x in rng.integers(0, vocab, user_len)]
+                      for _ in range(n_turns)],
+            # EOS-style exponential cap, like the Poisson trace's draws.
+            "gens": [int(min(gen_cap, 8 + rng.exponential(gen_cap / 3)))
+                     for _ in range(n_turns)],
+        })
+    return chat, cfg
+
+
+def _replay_chat(chat, engine) -> list[list[list[int]]]:
+    """Drive one engine through the whole chat campaign, wave by wave
+    (turn t of every conversation submitted together, then run to
+    drain — a closed loop: turn t+1's prompt embeds turn t's reply).
+    Returns per-turn per-conversation generated tokens."""
+    convs = chat["convs"]
+    histories = [list(chat["system"]) + list(conv["users"][0])
+                 for conv in convs]
+    stagger = float(chat.get("stagger_s", 0.0))
+    turns = []
+    for t in range(chat["n_turns"]):
+        wave = [engine.submit(histories[c], conv["gens"][t],
+                              seed=1000 * c + t, rid=f"c{c}t{t}",
+                              arrival_s=(c * stagger if t == 0 else 0.0))
+                for c, conv in enumerate(convs)]
+        engine.run(record_summary=False)   # ONE campaign summary at the end
+        for c, req in enumerate(wave):
+            if req.error is not None:
+                raise RuntimeError(f"chat request {req.rid} failed: "
+                                   f"{req.error}")
+            if t + 1 < chat["n_turns"]:
+                histories[c] = (histories[c] + req.generated
+                                + list(convs[c]["users"][t + 1]))
+        turns.append([r.generated for r in wave])
+    return turns
+
+
+def bench_serve_chat() -> None:
+    """Multi-turn chat serving bench (``DMP_BENCH_SERVE_TRACE=chat``).
+
+    Replays one seeded chat campaign through the engine twice —
+    prefix caching + speculative decoding ON, then both OFF (the PR 9
+    engine) — and reports tokens/s/chip for both, the speedup, cache hit
+    rate, prefill tokens saved and draft accept rate. The two runs'
+    token streams are asserted identical (the determinism contract that
+    makes the comparison fair), and the acceptance bar is >3x.
+    """
+    from distributed_model_parallel_tpu.config import MeshConfig
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import Engine, ServeConfig
+
+    chat, cfg = build_serve_chat_trace()
+    n_chips = len(jax.devices())
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots = int(os.environ.get("DMP_BENCH_SERVE_SLOTS", "8"))
+    page = int(os.environ.get("DMP_BENCH_SERVE_PAGE", "16"))
+    spec_k = int(os.environ.get("DMP_BENCH_SERVE_SPEC_K", "6"))
+    pages_per_seq = -(-cfg.max_seq_len // page)
+    n_convs = len(chat["convs"])
+    telemetry = _telemetry_run("serve", dict(
+        trace="chat", n_convs=n_convs, n_turns=chat["n_turns"],
+        n_slots=n_slots, page_size=page, spec_k=spec_k,
+        d_model=cfg.d_model, n_layers=cfg.n_layers))
+
+    def make_config(on: bool) -> ServeConfig:
+        return ServeConfig(
+            n_slots=n_slots, page_size=page,
+            # Room for the resident batch PLUS every conversation's
+            # cached history (the tree evicts LRU if this is short).
+            n_pages=(n_slots + n_convs + 1) * pages_per_seq,
+            max_seq_len=cfg.max_seq_len,
+            prefill_chunk=int(os.environ.get(
+                "DMP_BENCH_SERVE_CHUNK", "32")),
+            prefix_cache=on, spec_k=spec_k if on else 0)
+
+    # Warm every compiled program (prefill + decode + the whole verify
+    # width ladder) with inert dispatches; compile stays out of both
+    # timed walls.
+    for on in (True, False):
+        Engine(params, cfg, make_config(on), slo_metrics=False).warmup()
+    _log("serve-chat: programs warmed (compile excluded)")
+
+    def run(on: bool):
+        engine = Engine(params, cfg, make_config(on), telemetry=telemetry)
+        turns = _replay_chat(chat, engine)
+        summary = engine.summary()
+        _log(f"serve-chat[{'cache+spec' if on else 'baseline'}]: "
+             f"{summary['tokens_generated']} tokens in "
+             f"{summary['wall_s']:.1f}s "
+             f"({summary['tokens_per_s'] or 0:.1f} tok/s, "
+             f"hit {summary['cache_hit_rate'] or 0:.2f}, "
+             f"accept {summary['draft_accept_rate'] or 0:.2f})")
+        return turns, summary
+
+    on_turns, on_sum = run(True)
+    off_turns, off_sum = run(False)
+    if on_turns != off_turns:
+        raise RuntimeError(
+            "cache+spec run decoded different tokens than the baseline "
+            "engine — the determinism contract is broken; refusing to "
+            "report a throughput comparison between different outputs")
+    tok_s = (on_sum["tokens_per_s"] or 0.0) / n_chips
+    base_tok_s = (off_sum["tokens_per_s"] or 0.0) / n_chips
+    out = {
+        "metric": f"lm_serve_chat_bs{n_slots}_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,   # the reference repo has no serving path
+        "mfu": None,
+        "baseline_tokens_per_s_per_chip": round(base_tok_s, 1),
+        "speedup_vs_baseline_engine": (round(tok_s / base_tok_s, 3)
+                                       if base_tok_s else None),
+        "tokens_identical_to_baseline": True,
+        "cache_hit_rate": (round(on_sum["cache_hit_rate"], 4)
+                           if on_sum["cache_hit_rate"] is not None
+                           else None),
+        "prefill_tokens_saved": on_sum["prefill_tokens_saved"],
+        "draft_accept_rate": (round(on_sum["draft_accept_rate"], 4)
+                              if on_sum["draft_accept_rate"] is not None
+                              else None),
+        "draft_tokens_proposed": on_sum["draft_tokens_proposed"],
+        "spec_k": spec_k,
+        "decode_steps": on_sum["decode_steps"],
+        "baseline_decode_steps": off_sum["decode_steps"],
+        "ttft_p50_s": round(on_sum["ttft_s"].get("p50", 0), 4),
+        "ttft_p99_s": round(on_sum["ttft_s"].get("p99", 0), 4),
+        "baseline_ttft_p99_s": round(off_sum["ttft_s"].get("p99", 0), 4),
+        "token_latency_p50_s": round(
+            on_sum["token_latency_s"].get("p50", 0), 5),
+        "token_latency_p99_s": round(
+            on_sum["token_latency_s"].get("p99", 0), 5),
+        "page_occupancy_max": round(
+            on_sum["page_occupancy"].get("max", 0), 3),
+        "requests": n_convs * chat["n_turns"],
+        "requests_completed": on_sum["requests_completed"],
+        "plan": plan_payload(MeshConfig(), "serve"),
+    }
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
+    telemetry.finish()
+    print(json.dumps(out))
+    _enforce_gate(gate)
+
+
 def bench_serve() -> None:
     """Continuous-batching serving bench (``DMP_BENCH_WORKLOAD=serve``).
 
@@ -897,7 +1101,10 @@ def _run_workload() -> None:
         bench_decode()
         return
     if os.environ.get("DMP_BENCH_WORKLOAD") == "serve":
-        bench_serve()
+        if os.environ.get("DMP_BENCH_SERVE_TRACE") == "chat":
+            bench_serve_chat()
+        else:
+            bench_serve()
         return
 
     n_chips = len(jax.devices())

@@ -16,7 +16,9 @@ This module is the attention read over that pool. Three tiers, one math:
   masking), so paged and dense decoding cannot diverge numerically;
 * :func:`paged_attention_xla` — gather the table's pages into a
   contiguous ``[B, T, Hkv, Dh]`` view and run :func:`attend_rows`; works
-  on every backend (the off-TPU fallback and the prefill path);
+  on every backend (the off-TPU fallback, the prefill path, and the
+  speculative-decoding verify step — its ``width``-token windows ride
+  the same per-row-position support prefill chunks use);
 * :func:`paged_attention_kernel` — the Pallas TPU kernel: the page table
   rides in scalar-prefetch SMEM and feeds the K/V block index maps, so
   pages stream HBM→VMEM directly (``pl.when`` skips the DMA + copy for

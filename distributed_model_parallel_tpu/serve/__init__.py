@@ -10,11 +10,20 @@ package turns them into a serving engine:
   ``models/transformer`` params (one jitted program each, any prompt
   length — the compile-cache story);
 * :mod:`serve.scheduler` — request queue + iteration-level
-  (continuous/Orca-style) batching: admission by free pages, mid-batch
-  join/evict, chunked prefill interleaved with decode;
+  (continuous/Orca-style) batching: admission by free pages (billed
+  post-sharing), mid-batch join/evict, chunked prefill interleaved with
+  decode;
+* :mod:`serve.prefix_cache` — the radix tree over token prefixes:
+  refcounted copy-on-write page sharing, so a request whose prompt is
+  cached admits with near-zero prefill (vLLM/SGLang-style);
+* :mod:`serve.spec` — the n-gram self-drafting proposer behind
+  speculative decoding (``ServeConfig.spec_k``): k drafted tokens per
+  iteration, verified in one batched forward, committed only when the
+  model's own choice agrees — spec-on/off token streams are identical;
 * :mod:`serve.engine` — the loop wiring them together, with per-request
-  SLO accounting (TTFT, per-token latency, queue wait) in the telemetry
-  registry and typed ``serve`` records.
+  SLO accounting (TTFT, per-token latency, queue wait, cache hit rate,
+  draft accept rate) in the telemetry registry and typed ``serve``
+  records.
 
 See docs/SERVING.md for the anatomy and the BENCH_serve recipe.
 """
@@ -28,6 +37,12 @@ from distributed_model_parallel_tpu.serve.paged_kv import (  # noqa: F401
     PagedKVCache,
     PagePool,
     PagePoolError,
+)
+from distributed_model_parallel_tpu.serve.prefix_cache import (  # noqa: F401
+    PrefixCache,
+)
+from distributed_model_parallel_tpu.serve.spec import (  # noqa: F401
+    NGramProposer,
 )
 from distributed_model_parallel_tpu.serve.scheduler import (  # noqa: F401
     Request,

@@ -34,8 +34,13 @@ from distributed_model_parallel_tpu.models.transformer import (
 from distributed_model_parallel_tpu.serve.model import (
     make_decode_step,
     make_prefill_step,
+    make_verify_step,
 )
-from distributed_model_parallel_tpu.serve.paged_kv import PagedKVCache
+from distributed_model_parallel_tpu.serve.paged_kv import (
+    PagedKVCache,
+    share_granularity_for,
+)
+from distributed_model_parallel_tpu.serve.spec import NGramProposer
 from distributed_model_parallel_tpu.serve.scheduler import (
     Request,
     RequestState,
@@ -71,6 +76,17 @@ class ServeConfig:
     prefill_chunks_per_iter: int = 1
     policy: str = "continuous"       # "continuous" | "static" (baseline)
     attn_impl: str = "auto"          # paged-attention impl (ops/)
+    # Prefix-cache reuse (serve/prefix_cache.py): finished prefixes stay
+    # resident in a refcounted radix tree; a request whose prompt matches
+    # admits holding the cached pages, prefills only the suffix, and its
+    # admission reservation bills only the uncached pages.
+    prefix_cache: bool = False
+    # Speculative decoding: an n-gram self-drafting proposer (serve/
+    # spec.py) proposes up to spec_k tokens per iteration and one
+    # batched verify forward (serve/model.make_verify_step) commits the
+    # model-verified prefix. 0 = off (single-token decode, PR 9 path).
+    spec_k: int = 0
+    spec_ngram: int = 3              # longest lookup order tried
     temperature: float = 0.0
     top_k: int | None = None
     top_p: float | None = None
@@ -111,6 +127,11 @@ class Engine:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{serve.prefill_chunk}")
         validate_sampling(cfg, serve.temperature, serve.top_k, serve.top_p)
+        if serve.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {serve.spec_k}")
+        if serve.spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got "
+                             f"{serve.spec_ngram}")
         self.params = params
         self.cfg = cfg
         self.serve = serve
@@ -121,9 +142,16 @@ class Engine:
         # engines must not pollute the samples a telemetry stream's
         # metrics record snapshots for the real runs.
         self._slo_metrics = slo_metrics
-        self.cache = PagedKVCache(cfg, n_pages=serve.n_pages,
-                                  page_size=serve.page_size,
-                                  max_seq_len=serve.max_seq_len)
+        self.cache = PagedKVCache(
+            cfg, n_pages=serve.n_pages, page_size=serve.page_size,
+            max_seq_len=serve.max_seq_len,
+            prefix_cache=serve.prefix_cache,
+            # Shared prefixes end on a page AND prefill-chunk boundary,
+            # so a cache-hit request's remaining chunks are the same
+            # compiled program at the same pos0 stream as the cold run's
+            # — the bitwise-parity argument in docs/SERVING.md.
+            share_granularity=share_granularity_for(serve.page_size,
+                                                    serve.prefill_chunk))
         self.sched = Scheduler(self.cache, serve.n_slots,
                                policy=serve.policy,
                                prefill_chunks_per_iter=(
@@ -135,6 +163,34 @@ class Engine:
         self._prefill = make_prefill_step(cfg, chunk=serve.prefill_chunk,
                                           **kw)
         self._decode = make_decode_step(cfg, **kw)
+        # Speculative decoding: decode rounds run a verify program from a
+        # compiled WIDTH LADDER (powers of two up to spec_k + 1) — each
+        # round dispatches the smallest width covering its longest live
+        # draft, so a round where only one row drafts two tokens never
+        # pays the full spec_k forward (the fixed-width program's cost is
+        # set by its width, not by how many drafts actually ride it).
+        self._verify_widths: list[int] = []
+        self._verify: dict[int, object] = {}
+        if serve.spec_k:
+            w = 2
+            while w < serve.spec_k + 1:
+                self._verify_widths.append(w)
+                w *= 2
+            self._verify_widths.append(serve.spec_k + 1)
+            self._verify = {w: make_verify_step(cfg, width=w, **kw)
+                            for w in self._verify_widths}
+        self._proposers: dict[str, NGramProposer] = {}
+        # SHADOW gating: acceptance is bursty — the model wanders, then
+        # locks into spans the n-gram index predicts perfectly — so a
+        # request drafts for real only after its proposer has proven
+        # itself, scoring single-token predictions against committed
+        # tokens on the cheap path (free, host-side). Two consecutive
+        # shadow hits go live; a zero-accept verify round goes back to
+        # shadow. Deterministic: a pure function of the committed
+        # stream, so the pinned spec-on/off parity is untouched (gating
+        # moves WHEN drafts ride, never which tokens commit).
+        self._spec_streak: dict[str, int] = {}
+        self._spec_live: dict[str, bool] = {}
         self._requests: list[Request] = []
         # Per-slot page tables, maintained incrementally: reservation ==
         # allocation, so a request's table is final at admission — one
@@ -146,7 +202,12 @@ class Engine:
         self._decode_steps = 0
         self._decode_tokens = 0       # useful tokens out of decode steps
         self._occupancy: list[float] = []
-        self._wall_s = 0.0
+        self._wall_s = 0.0            # accumulates across run() calls
+        # prefix-cache + speculative-decoding accounting
+        self._prompt_tokens = 0       # prompt tokens of admitted requests
+        self._cached_tokens = 0       # of those, served from the tree
+        self._draft_proposed = 0
+        self._draft_accepted = 0
         # Live status exporter (utils/statusz.py): queue depth / page
         # occupancy / slot state under /statusz. No-op when no port is
         # configured anywhere in the process.
@@ -171,8 +232,64 @@ class Engine:
             "n_slots": self.serve.n_slots,
             "page_occupancy": self.cache.occupancy,
             "requests_submitted": len(self._requests),
+            # prefix sharing + speculative decoding, live
+            "prefix_cache": self.serve.prefix_cache,
+            "spec_k": self.serve.spec_k,
+            "cache_hit_rate": self.cache_hit_rate,
+            "shared_pages": self.cache.shared_pages,
+            "cached_prefix_pages": (len(self.cache.prefix)
+                                    if self.cache.prefix is not None
+                                    else 0),
+            "draft_accept_rate": self.draft_accept_rate,
             "healthy": True,
         }
+
+    @property
+    def cache_hit_rate(self) -> float | None:
+        """Prompt tokens served from the prefix tree / prompt tokens
+        admitted (None before any admission or with the cache off)."""
+        if not self.serve.prefix_cache or not self._prompt_tokens:
+            return None
+        return self._cached_tokens / self._prompt_tokens
+
+    @property
+    def draft_accept_rate(self) -> float | None:
+        if not self.serve.spec_k or not self._draft_proposed:
+            return None
+        return self._draft_accepted / self._draft_proposed
+
+    def warmup(self) -> None:
+        """Dispatch every compiled program once with INERT inputs (no
+        active rows, no valid prefill tokens — every cache write masked
+        away, outputs discarded), so compilation happens here and never
+        inside a timed serving run. Idle-safe: pool/tables/stats are
+        untouched; cache buffers round-trip through the donating calls.
+        The step builders are memoized per geometry, so one warmed
+        engine warms every engine sharing its geometry — including the
+        whole speculative width ladder, which otherwise compiles lazily
+        at the first round that drafts each width."""
+        b = self.serve.n_slots
+        n = self.cache.pages_per_seq
+        key = jax.random.key(0)
+        table = jnp.zeros((n,), jnp.int32)
+        # prefill: zero valid tokens -> every write dropped
+        self.cache.ck, self.cache.cv, _ = self._prefill(
+            self.params, self.cache.ck, self.cache.cv,
+            jnp.zeros((1, self.serve.prefill_chunk), jnp.int32),
+            jnp.int32(0), jnp.int32(0), table, key)
+        tables = jnp.zeros((b, n), jnp.int32)
+        idle = jnp.zeros((b,), bool)
+        keys = (jax.vmap(jax.random.key)(jnp.zeros((b,), jnp.uint32))
+                if self._sampled else None)
+        self.cache.ck, self.cache.cv, _ = self._decode(
+            self.params, self.cache.ck, self.cache.cv,
+            jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+            tables, idle, keys)
+        for w in self._verify_widths:
+            self.cache.ck, self.cache.cv, _ = self._verify[w](
+                self.params, self.cache.ck, self.cache.cv,
+                jnp.zeros((b, w), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.int32), tables, idle, keys)
 
     # -- submission ---------------------------------------------------------
 
@@ -195,10 +312,14 @@ class Engine:
 
     # -- the loop -----------------------------------------------------------
 
-    def run(self, *, max_iterations: int | None = None) -> dict:
+    def run(self, *, max_iterations: int | None = None,
+            record_summary: bool = True) -> dict:
         """Drive the loop until every submitted request is terminal (or
         ``max_iterations``). Returns the summary dict (also emitted as
-        the ``serve`` summary telemetry record)."""
+        the ``serve`` summary telemetry record unless
+        ``record_summary=False`` — multi-wave drivers like BENCH_serve's
+        chat mode run() per wave and record ONE campaign summary at the
+        end instead of one per wave)."""
         t0 = time.monotonic()
         try:
             # Spans from the loop (prefill chunks, decode rounds,
@@ -224,7 +345,7 @@ class Engine:
                             time.sleep(max(0.0, min(nxt - now, 0.05)))
         except BaseException as e:
             self._fail_inflight(f"{type(e).__name__}: {e}")
-            self._wall_s = time.monotonic() - t0
+            self._wall_s += time.monotonic() - t0
             if self.telemetry is not None:
                 self.telemetry.failure(
                     "engine-killed", detail=f"{type(e).__name__}: {e}",
@@ -244,13 +365,28 @@ class Engine:
             raise EngineKilled(
                 f"engine died at iteration {self._iterations}; "
                 f"in-flight requests marked failed") from e
-        self._wall_s = time.monotonic() - t0
-        return self.summary()
+        # Accumulate: a multi-turn driver (BENCH_serve chat mode) calls
+        # run() per wave and reads one whole-campaign summary at the end.
+        self._wall_s += time.monotonic() - t0
+        return self.summary(record=record_summary)
 
     def _iterate(self, now: float, t0: float) -> bool:
         progress = False
         for req in self.sched.admit(now):
             self._tables_np[req.slot] = self.cache.table_array(req.rid)
+            # Cache-hit admission: the shared pages already hold the
+            # prefix KV — prefill starts at the first uncached token.
+            req.prefill_cursor = req.cached_prompt_tokens
+            self._prompt_tokens += req.prompt_len
+            self._cached_tokens += req.cached_prompt_tokens
+            if self.serve.spec_k:
+                prop = NGramProposer(self.serve.spec_k,
+                                     max_order=self.serve.spec_ngram)
+                prop.extend(req.prompt)
+                self._proposers[req.rid] = prop
+            if self._slo_metrics and req.cached_prompt_tokens:
+                registry().counter("serve_prefill_tokens_saved").inc(
+                    req.cached_prompt_tokens)
             self._record_queue_wait(req)
         for req in self.sched.prefilling():
             self._prefill_chunk(req, t0)
@@ -262,7 +398,16 @@ class Engine:
         occ = self.cache.occupancy
         self._occupancy.append(occ)
         if self._slo_metrics:
-            registry().gauge("serve_page_occupancy").set(occ)
+            reg = registry()
+            reg.gauge("serve_page_occupancy").set(occ)
+            if self.serve.prefix_cache:
+                reg.gauge("serve_shared_pages").set(self.cache.shared_pages)
+                if self.cache_hit_rate is not None:
+                    reg.gauge("serve_cache_hit_rate").set(
+                        self.cache_hit_rate)
+            if self.serve.spec_k and self.draft_accept_rate is not None:
+                reg.gauge("serve_draft_accept_rate").set(
+                    self.draft_accept_rate)
         return progress
 
     # -- prefill ------------------------------------------------------------
@@ -292,14 +437,29 @@ class Engine:
             req.t_first_token = time.monotonic() - t0
             req.state = RequestState.DECODE
             self._record_ttft(req)
+            # Every prompt position's KV is now written — offer the full
+            # prompt pages to the prefix tree so the next request with
+            # this prefix (the multi-turn case) admits warm.
+            self.cache.insert_prefix(req.rid, req.prompt)
+            # The proposer's stream must carry EVERY committed token —
+            # skipping the first generated one would shift its whole
+            # index around the prompt/generation boundary.
+            prop = self._proposers.get(req.rid)
+            if prop is not None:
+                self._shadow_score(req, first)
+                prop.extend([first])
             if self._finished(req, first):
                 self._complete(req, t0)
 
     # -- decode -------------------------------------------------------------
 
     def _decode_round(self, decoding: list[Request], t0: float) -> None:
-        with span("decode_round", batch=len(decoding)):
-            self._decode_round_inner(decoding, t0)
+        with span("decode_round", batch=len(decoding),
+                  spec=bool(self._verify)):
+            if self._verify:
+                self._spec_round_inner(decoding, t0)
+            else:
+                self._decode_round_inner(decoding, t0)
 
     def _decode_round_inner(self, decoding: list[Request], t0: float) -> None:
         b = self.serve.n_slots
@@ -327,6 +487,135 @@ class Engine:
             req.generated.append(tok)
             if self._finished(req, tok):
                 self._complete(req, t0)
+            else:
+                # Spec engines route draft-less rounds through here —
+                # score the shadow prediction, then feed the proposer
+                # the committed token.
+                prop = self._proposers.get(req.rid)
+                if prop is not None:
+                    self._shadow_score(req, tok)
+                    prop.extend([tok])
+
+    def _spec_round_inner(self, decoding: list[Request], t0: float) -> None:
+        """One speculative round: every active slot verifies its n-gram
+        draft in ONE fixed-width forward and commits the model-verified
+        prefix — between 1 and ``width`` tokens per request per round.
+
+        ``out[s, i]`` is the model's token for the position after window
+        index ``i``; it is committed only while every draft before it
+        matched the model's own choice, so the committed stream is
+        bitwise the sequential decode stream (a draft can never smuggle
+        in a token the model would not have produced — docs/SERVING.md,
+        "Speculative decoding"). KV hygiene: a rejected draft leaves
+        garbage KV only at positions at or past the NEXT round's window
+        start, and every round rewrites its whole window before reading
+        it, so garbage is always overwritten before it becomes readable;
+        the last committed token's slot is the one position that may
+        still hold a rejected write, which is why completion trims it
+        before offering pages to the prefix tree.
+        """
+        b = self.serve.n_slots
+        cap = self.serve.spec_k + 1
+        proposals: dict[str, list[int]] = {}
+        for req in decoding:
+            remaining = req.max_new_tokens - len(req.generated)
+            if remaining > 1 and self._spec_live.get(req.rid):
+                proposals[req.rid] = self._proposers[req.rid].propose()[
+                    :min(cap, remaining) - 1]
+            else:
+                proposals[req.rid] = []      # shadow mode: prove it first
+        longest = max((len(d) for d in proposals.values()), default=0)
+        if longest == 0:
+            # No row drafted (cold proposers, backoff, ends of budgets):
+            # the single-token program commits the identical tokens (the
+            # spec-on/off parity the tests pin) at 1/width the FLOPs.
+            self._decode_round_inner(decoding, t0)
+            return
+        # Smallest compiled verify width covering the longest live draft.
+        width = next(w for w in self._verify_widths if w >= longest + 1)
+        tokens = np.zeros((b, width), np.int32)
+        positions = np.zeros((b,), np.int32)
+        # Idle rows keep n_valid=1 (writes are dropped via the active
+        # mask; a zero-length row would make its garbage softmax all
+        # -inf, and NaNs — however masked — have no business existing).
+        n_valid = np.ones((b,), np.int32)
+        active = np.zeros((b,), bool)
+        seeds = np.zeros((b,), np.uint32)
+        drafts: dict[str, list[int]] = {}
+        for req in decoding:
+            s = req.slot
+            remaining = req.max_new_tokens - len(req.generated)
+            w = min(width, remaining)
+            draft = proposals[req.rid][:w - 1]
+            drafts[req.rid] = draft
+            tokens[s, 0] = req.generated[-1]
+            tokens[s, 1:1 + len(draft)] = draft
+            positions[s] = req.prompt_len + len(req.generated) - 1
+            n_valid[s] = w
+            active[s] = True
+            seeds[s] = req.seed
+        keys = (jax.vmap(jax.random.key)(jnp.asarray(seeds))
+                if self._sampled else None)
+        self.cache.ck, self.cache.cv, out = self._verify[width](
+            self.params, self.cache.ck, self.cache.cv,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(n_valid), jnp.asarray(self._tables_np),
+            jnp.asarray(active), keys)
+        out = np.asarray(jax.device_get(out))
+        self._decode_steps += 1
+        round_proposed = round_accepted = 0
+        for req in decoding:
+            s = req.slot
+            draft = drafts[req.rid]
+            emitted: list[int] = []
+            for i in range(int(n_valid[s])):
+                if i > 0 and tokens[s, i] != out[s, i - 1]:
+                    break                      # draft i-1 rejected
+                tok = int(out[s, i])
+                emitted.append(tok)
+                if (self.serve.eos_id is not None
+                        and tok == self.serve.eos_id):
+                    break
+            req.generated.extend(emitted)
+            self._decode_tokens += len(emitted)
+            # Accept accounting over REAL proposals only (window padding
+            # that happens to match is decode luck, not drafting).
+            accepted = max(0, min(len(emitted) - 1, len(draft)))
+            round_proposed += len(draft)
+            round_accepted += accepted
+            if draft:
+                if accepted == 0:
+                    # Streak broken: back to shadow mode until the
+                    # proposer re-proves itself on committed tokens.
+                    self._spec_live[req.rid] = False
+                    self._spec_streak[req.rid] = 0
+            else:
+                self._shadow_score(req, emitted[0])
+            if self._finished(req, emitted[-1]):
+                self._complete(req, t0)
+            else:
+                self._proposers[req.rid].extend(emitted)
+        self._draft_proposed += round_proposed
+        self._draft_accepted += round_accepted
+        if self._slo_metrics and round_proposed:
+            reg = registry()
+            reg.counter("serve_draft_tokens_proposed").inc(round_proposed)
+            reg.counter("serve_draft_tokens_accepted").inc(round_accepted)
+
+    def _shadow_score(self, req: Request, committed: int) -> None:
+        """Score the proposer's single-token prediction against the
+        token the model actually committed (called BEFORE the proposer
+        sees it). Two consecutive hits promote the request to live
+        drafting — the free filter that keeps verify width off the
+        wander phase and on the predictable spans."""
+        pred = self._proposers[req.rid].predict_next()
+        if pred is not None and pred == committed:
+            streak = self._spec_streak.get(req.rid, 0) + 1
+            self._spec_streak[req.rid] = streak
+            if streak >= 2:
+                self._spec_live[req.rid] = True
+        else:
+            self._spec_streak[req.rid] = 0
 
     def _finished(self, req: Request, tok: int) -> bool:
         return (len(req.generated) >= req.max_new_tokens
@@ -338,6 +627,19 @@ class Engine:
     def _complete(self, req: Request, t0: float) -> None:
         req.t_done = time.monotonic() - t0
         req.state = RequestState.COMPLETED
+        # Offer the whole committed sequence (prompt + generation) to the
+        # prefix tree BEFORE eviction drops our page references — this is
+        # what makes a multi-turn follow-up (prior turns re-sent as the
+        # new prompt) admit warm. The final token is always trimmed: its
+        # KV slot is either unwritten (plain decode feeds a token back
+        # before writing it) or may hold a rejected draft's write
+        # (speculative rounds) — only verified-written positions are
+        # shareable.
+        self.cache.insert_prefix(
+            req.rid, (req.prompt + req.generated)[:-1])
+        self._proposers.pop(req.rid, None)
+        self._spec_streak.pop(req.rid, None)
+        self._spec_live.pop(req.rid, None)
         self.sched.evict(req)
         token_s = None
         if len(req.generated) > 1 and req.t_first_token is not None:
@@ -368,6 +670,9 @@ class Engine:
             elif any(q is req for q in self.sched.queue):
                 self.sched.queue = deque(
                     q for q in self.sched.queue if q is not req)
+            self._proposers.pop(req.rid, None)
+            self._spec_streak.pop(req.rid, None)
+            self._spec_live.pop(req.rid, None)
             req.state = RequestState.FAILED
             req.error = f"engine-killed: {detail}"
             if self._slo_metrics:
@@ -407,9 +712,9 @@ class Engine:
     def results(self) -> list[Request]:
         return list(self._requests)
 
-    def summary(self) -> dict:
+    def summary(self, *, record: bool = True) -> dict:
         """Aggregate SLO + throughput view (and the ``serve`` summary
-        record when a telemetry stream is attached)."""
+        record when a telemetry stream is attached and ``record``)."""
         completed = [r for r in self._requests
                      if r.state is RequestState.COMPLETED]
         failed = [r for r in self._requests
@@ -430,13 +735,31 @@ class Engine:
                              else None),
             "iterations": self._iterations,
             "decode_steps": self._decode_steps,
-            # Slot efficiency: useful tokens per decode step over the
+            # Slot efficiency: useful tokens per decode ROUND over the
             # batch width — the deterministic (timing-free) continuous-
-            # vs-static comparison the tests gate on.
+            # vs-static comparison the tests gate on. Under speculative
+            # decoding a round can commit several tokens per slot, so
+            # this can legitimately exceed 1.0 — there it reads as the
+            # tokens-per-round speedup, not a utilization fraction.
             "slot_utilization": (
                 self._decode_tokens
                 / (self._decode_steps * self.serve.n_slots)
                 if self._decode_steps else None),
+            # Prefix-cache reuse + speculative decoding (docs/SERVING.md;
+            # BENCH_serve chat mode gates on these).
+            "prefix_cache": self.serve.prefix_cache,
+            "spec_k": self.serve.spec_k,
+            "cache_hit_rate": self.cache_hit_rate,
+            "prefill_tokens_saved": self._cached_tokens,
+            "shared_pages": self.cache.shared_pages,
+            "cached_prefix_pages": (len(self.cache.prefix)
+                                    if self.cache.prefix is not None
+                                    else 0),
+            "prefix_evictions": (self.cache.prefix.evictions
+                                 if self.cache.prefix is not None else 0),
+            "draft_accept_rate": self.draft_accept_rate,
+            "draft_tokens_proposed": self._draft_proposed,
+            "draft_tokens_accepted": self._draft_accepted,
             "ttft_s": summarize(
                 [t for t in (self._ttft(r) for r in completed)
                  if t is not None]),
@@ -446,6 +769,6 @@ class Engine:
             "token_latency_s": summarize(token_lat),
             "page_occupancy": summarize(self._occupancy),
         }
-        if self.telemetry is not None:
+        if record and self.telemetry is not None:
             self.telemetry.record("serve", event="summary", **out)
         return out

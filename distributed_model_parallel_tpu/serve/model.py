@@ -145,6 +145,72 @@ def make_prefill_step(cfg: TransformerConfig, *, page_size: int,
 
 
 @functools.lru_cache(maxsize=64)
+def make_verify_step(cfg: TransformerConfig, *, page_size: int,
+                     n_pages: int, width: int, impl: str,
+                     temperature: float = 0.0, top_k: int | None = None,
+                     top_p: float | None = None):
+    """Speculative-decoding verification: ``width`` tokens per slot in
+    ONE batched forward (the last committed token plus ``width - 1``
+    draft tokens), emitting the model's own choice at every position.
+
+    Returns ``step(params, ck, cv, tokens [B, W], positions [B],
+    n_valid [B], tables [B, N], active [B] bool, keys [B]) ->
+    (ck, cv, out_tokens [B, W])`` where ``out_tokens[b, i]`` is the
+    token the model picks for absolute position ``positions[b] + i + 1``
+    given the window prefix through ``i`` — exactly what sequential
+    decode would emit there, because each query row's math is
+    position-independent of batch shape and sampling folds the
+    per-request key with the query position (the same fold the
+    single-token decode step uses). The host-side accept rule
+    (serve/engine.py) keeps ``out[i]`` only while the drafts before it
+    matched, so spec-on and spec-off token streams are identical by
+    construction.
+
+    ``n_valid`` clamps each row's window (a request near its token
+    budget processes fewer positions); writes past it — and every write
+    of an idle row — are dropped via out-of-range page ids, the same
+    masking idiom as prefill padding.
+    """
+    sampler = make_sampler(cfg, temperature, top_k, top_p)
+    sampled = temperature > 0
+
+    def window_sample(logits, keys, positions):
+        # logits [B, W, V]; fold each row's key with each query position
+        # (positions[b] + i) — bitwise the decode/prefill fold for the
+        # same (seed, position).
+        if not sampled:
+            b, w, v = logits.shape
+            return sampler(logits.reshape(b * w, v), None).reshape(b, w)
+
+        def row(lg, key, p0):
+            subs = jax.vmap(jax.random.fold_in,
+                            in_axes=(None, 0))(key, p0 + jnp.arange(width))
+            return jax.vmap(lambda l, s: sampler(l[None], s)[0])(lg, subs)
+
+        return jax.vmap(row)(logits, keys, positions)
+
+    def step(params, ck, cv, tokens, positions, n_valid, tables, active,
+             keys):
+        pos = positions[:, None] + jnp.arange(width)[None]    # [B, W]
+        valid = jnp.logical_and(
+            jnp.arange(width)[None] < n_valid[:, None],
+            active[:, None])                                  # [B, W]
+        pages = jnp.take_along_axis(
+            tables, jnp.clip(pos // page_size, 0, tables.shape[1] - 1),
+            axis=1)
+        pages = jnp.where(valid, pages, n_pages)              # drop invalid
+        offsets = pos % page_size
+        lengths = positions + n_valid                         # [B]
+        x = _embed_rows(params, tokens, pos, cfg)
+        x, ck, cv = _layers_scan(params, ck, cv, x, pos, pages, offsets,
+                                 tables, lengths, cfg, impl)
+        logits = unembed(params, x)                           # [B, W, V]
+        return ck, cv, window_sample(logits, keys, positions)
+
+    return jax.jit(step, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=64)
 def make_decode_step(cfg: TransformerConfig, *, page_size: int,
                      n_pages: int, impl: str, temperature: float = 0.0,
                      top_k: int | None = None, top_p: float | None = None):

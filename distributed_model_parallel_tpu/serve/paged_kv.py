@@ -8,16 +8,26 @@ recorded in a host-side page table. Pages return to the free list the
 moment a sequence finishes, so memory capacity (and therefore admission)
 is decoupled from both batch width and the longest co-resident sequence.
 
+Pages are **refcounted** (copy-on-write prefix sharing,
+serve/prefix_cache.py): a page written once for a token prefix can back
+every sequence whose prompt starts with those tokens — each holder takes
+a reference, and the page returns to the free list only when the last
+reference drops. "Copy-on-write" here is page-granular and by
+construction: a sharer's own writes always land at positions past the
+shared prefix, i.e. in freshly allocated pages, so a shared page is never
+written twice and no actual copy ever happens.
+
 Allocation is deterministic (FIFO free list): the same submit/finish
 order always produces the same physical placement, which keeps engine
 runs — and their telemetry — reproducible. Pages are **not** cleared on
-free: the attention read path masks by sequence length with exact zeros
+free: the attention read path masks past-length positions to exact 0.0
 (ops/paged_attention.attend_rows), so stale contents are unreachable by
 construction rather than by memset.
 """
 
 from __future__ import annotations
 
+import math
 from collections import deque
 
 import jax.numpy as jnp
@@ -30,12 +40,15 @@ class PagePoolError(RuntimeError):
 
 
 class PagePool:
-    """Host-side allocator over ``n_pages`` physical page ids.
+    """Host-side refcounting allocator over ``n_pages`` physical ids.
 
     FIFO free list: deterministic placement for a deterministic op
-    sequence. ``alloc`` raises :class:`PagePoolError` rather than
-    over-committing — the scheduler checks ``free_pages`` before
-    admitting, so a raise here is a scheduler bug, not backpressure.
+    sequence. ``alloc`` hands out pages at refcount 1; ``retain`` adds a
+    reference (prefix sharing); ``free`` drops one reference per page and
+    returns the page to the free list only at refcount 0. ``alloc``
+    raises :class:`PagePoolError` rather than over-committing — the
+    scheduler checks ``free_pages`` before admitting, so a raise here is
+    a scheduler bug, not backpressure.
     """
 
     def __init__(self, n_pages: int):
@@ -43,7 +56,7 @@ class PagePool:
             raise ValueError(f"pool needs >= 1 page, got {n_pages}")
         self.n_pages = n_pages
         self._free: deque[int] = deque(range(n_pages))
-        self._used: set[int] = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -51,7 +64,15 @@ class PagePool:
 
     @property
     def used_pages(self) -> int:
-        return len(self._used)
+        return len(self._refs)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages held by more than one reference (prefix sharing live)."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
 
     def alloc(self, n: int) -> list[int]:
         if n < 0:
@@ -62,17 +83,31 @@ class PagePool:
                 f"free (of {self.n_pages}); admission must queue, not "
                 f"over-commit")
         pages = [self._free.popleft() for _ in range(n)]
-        self._used.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
-    def free(self, pages: list[int]) -> None:
+    def retain(self, pages: list[int]) -> None:
+        """Add one reference to each allocated page (a sharer joining)."""
         for p in pages:
-            if p not in self._used:
+            if p not in self._refs:
+                raise PagePoolError(
+                    f"retaining page {p} that is not allocated")
+        for p in pages:
+            self._refs[p] += 1
+
+    def free(self, pages: list[int]) -> None:
+        """Drop one reference per page; a page returns to the free list
+        only when its last holder lets go (refcount 0)."""
+        for p in pages:
+            if p not in self._refs:
                 raise PagePoolError(
                     f"freeing page {p} that is not allocated (double "
                     f"free, or a page the pool never handed out)")
-            self._used.remove(p)
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
 
 class PagedKVCache:
@@ -85,10 +120,22 @@ class PagedKVCache:
     :meth:`table_array` pads it to the static per-sequence maximum with
     id 0 — padded entries are masked by length in the attention read, so
     any in-range id is safe.
+
+    ``prefix_cache=True`` keeps a radix tree over token prefixes
+    (serve/prefix_cache.py): finished prefixes stay resident (refcounted
+    by the tree), a new sequence whose prompt matches admits holding the
+    cached pages, and the tree is evicted LRU-leaf-first when admission
+    needs the capacity back. ``share_granularity`` (tokens; a multiple of
+    ``page_size``) quantizes how much prefix a sharer may reuse — the
+    engine passes ``lcm(page_size, prefill_chunk)`` so a cache-hit
+    request's remaining prefill chunks are bit-identical program
+    invocations to the cold run's (the determinism argument in
+    docs/SERVING.md).
     """
 
     def __init__(self, cfg, *, n_pages: int, page_size: int,
-                 max_seq_len: int):
+                 max_seq_len: int, prefix_cache: bool = False,
+                 share_granularity: int | None = None):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if max_seq_len < 1:
@@ -99,6 +146,21 @@ class PagedKVCache:
         self.pages_per_seq = -(-max_seq_len // page_size)
         self.pool = PagePool(n_pages)
         self._tables: dict[object, list[int]] = {}
+        if share_granularity is None:
+            share_granularity = page_size
+        if share_granularity % page_size != 0:
+            raise ValueError(
+                f"share_granularity {share_granularity} must be a "
+                f"multiple of page_size {page_size}")
+        self.share_granularity = share_granularity
+        if prefix_cache:
+            from distributed_model_parallel_tpu.serve.prefix_cache import (
+                PrefixCache,
+            )
+
+            self.prefix = PrefixCache(self.pool, page_size)
+        else:
+            self.prefix = None
         shape = (cfg.n_layers, n_pages, page_size, cfg.kv_heads,
                  cfg.head_dim)
         self.ck = jnp.zeros(shape, cfg.dtype)
@@ -126,7 +188,9 @@ class PagedKVCache:
             table.extend(self.pool.alloc(need))
 
     def release(self, sid) -> None:
-        """Return every page of ``sid`` to the pool (eviction/completion)."""
+        """Drop ``sid``'s reference on every page of its table
+        (eviction/completion). Shared pages survive under the prefix
+        tree's (or another sequence's) reference."""
         self.pool.free(self._tables.pop(sid))
 
     def table_array(self, sid) -> np.ndarray:
@@ -139,3 +203,109 @@ class PagedKVCache:
     @property
     def occupancy(self) -> float:
         return self.pool.used_pages / self.pool.n_pages
+
+    # -- prefix sharing ------------------------------------------------------
+
+    def _usable_prefix(self, tokens: list[int], matched_pages: int) -> int:
+        """Tokens of a raw page-tree match a sharer may actually reuse:
+        quantized down to ``share_granularity`` and capped at
+        ``len(tokens) - 1`` — the final prompt token is always recomputed
+        so the last prefill chunk produces the first-token logits."""
+        g = self.share_granularity
+        m = min(matched_pages * self.page_size, len(tokens) - 1)
+        return max(0, (m // g) * g)
+
+    def _admission(self, tokens: list[int],
+                   capacity: int) -> tuple[int, list[int], int, int]:
+        """One radix match + one evictable walk:
+        ``(cached_tokens, shared_pages, fresh_pages, available_pages)``.
+        The request fits iff ``fresh_pages <= available_pages`` —
+        available counts the free list plus tree pages evictable without
+        touching the would-be-shared path."""
+        cached = 0
+        shared: list[int] = []
+        if self.prefix is not None:
+            pages = self.prefix.match(tokens, touch=False)
+            cached = self._usable_prefix(tokens, len(pages))
+            shared = pages[:cached // self.page_size]
+        fresh = self.pages_needed(capacity) - len(shared)
+        avail = self.pool.free_pages
+        if self.prefix is not None:
+            avail += self.prefix.evictable_pages(exclude=set(shared))
+        return cached, shared, fresh, avail
+
+    def peek_admission(self, tokens: list[int],
+                       capacity: int) -> tuple[int, int, int]:
+        """Side-effect-free admission bill:
+        ``(cached_tokens, fresh_pages, available_pages)``."""
+        cached, _, fresh, avail = self._admission(tokens, capacity)
+        return cached, fresh, avail
+
+    def try_admit(self, sid, tokens: list[int],
+                  capacity: int) -> int | None:
+        """Admission in ONE pass (the scheduler's per-iteration hot
+        path): peek the post-sharing bill, and — when it fits — open
+        ``sid`` holding the cached prefix, evict tree-only pages if the
+        fresh suffix needs the room, and allocate the rest of the
+        reservation. Returns the cached token count, or ``None`` when
+        the request must keep queuing (no side effects then)."""
+        cached, shared, fresh, avail = self._admission(tokens, capacity)
+        if fresh > avail:
+            return None
+        self.open(sid)
+        if shared:
+            # Recency bump + hit accounting: a cheap matched-path walk,
+            # not a second full match.
+            self.prefix.touch_path(tokens, len(shared))
+            self.pool.retain(shared)
+            self._tables[sid].extend(shared)
+        short = (self.pages_needed(capacity) - len(self._tables[sid])
+                 - self.pool.free_pages)
+        if short > 0:
+            self.prefix.evict(short)
+        self.ensure(sid, capacity)
+        return cached
+
+    def admit_with_prefix(self, sid, tokens: list[int],
+                          capacity: int) -> int:
+        """:meth:`try_admit` for callers that already checked the fit —
+        insufficient room here raises (an accounting bug, not
+        backpressure)."""
+        got = self.try_admit(sid, tokens, capacity)
+        if got is None:
+            cached, fresh, avail = self.peek_admission(tokens, capacity)
+            raise PagePoolError(
+                f"sequence {sid!r} needs {fresh} fresh pages but only "
+                f"{avail} are free or evictable; admission must queue")
+        return got
+
+    def insert_prefix(self, sid, tokens: list[int]) -> int:
+        """Offer ``sid``'s pages for the **fully written** prefix
+        ``tokens`` to the radix tree (no-op without a prefix cache).
+        Only full pages are insertable; the tree retains every page it
+        adopts, so they outlive the sequence. Returns pages newly
+        adopted. Callers must pass only tokens whose KV is verified
+        written — under speculative decoding the last committed token's
+        slot may hold a rejected draft's KV, so the engine always trims
+        the tail (serve/engine.py)."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.insert(tokens, self._tables[sid])
+
+    @property
+    def evictable_pages(self) -> int:
+        if self.prefix is None:
+            return 0
+        return self.prefix.evictable_pages()
+
+    @property
+    def shared_pages(self) -> int:
+        return self.pool.shared_pages
+
+
+def share_granularity_for(page_size: int, prefill_chunk: int) -> int:
+    """The engine's prefix-share quantum: a shared prefix must end on a
+    page boundary (whole pages are the sharing unit) AND on a prefill
+    chunk boundary (so the cold and cached runs dispatch bit-identical
+    suffix chunks — same compiled program, same ``pos0`` stream)."""
+    return math.lcm(page_size, prefill_chunk)

@@ -8,7 +8,12 @@ steps so they never stall the resident batch.
 
 Admission policy: FIFO with head-of-line blocking, gated on the page
 pool — a request is admitted only when a slot is free **and** the pool
-holds pages for its whole worst case (``prompt + max_new_tokens``).
+holds pages for its whole worst case (``prompt + max_new_tokens``),
+billed **post-sharing**: pages serving a cached prefix (the paged-KV
+radix tree, serve/prefix_cache.py) are retained rather than allocated,
+so a cache-hit request reserves only its uncached suffix and admits
+where a cold twin queues, and tree-only pages count as reclaimable
+(evicted LRU-leaf-first when the allocation needs the room).
 Reservation *is* allocation: every page a request could ever touch is
 taken at admission, so decode can never OOM mid-flight and nothing ever
 needs preemption-by-page-pressure; the trade is earlier queuing, which
@@ -64,6 +69,7 @@ class Request:                     # objects in slots/queues, not values
     generated: list[int] = dataclasses.field(default_factory=list)
     error: str | None = None
     prefill_cursor: int = 0          # prompt tokens already prefilled
+    cached_prompt_tokens: int = 0    # prefix served from the radix tree
     slot: int | None = None
     t_admitted: float | None = None
     t_first_token: float | None = None
@@ -136,10 +142,6 @@ class Scheduler:
 
     # -- admission ----------------------------------------------------------
 
-    def _fits(self, req: Request) -> bool:
-        return (self.cache.pages_needed(req.total_capacity)
-                <= self.cache.pool.free_pages)
-
     def admit(self, now: float) -> list[Request]:
         """Move arrived queue-head requests into free slots (continuous),
         or refill the whole batch once it has fully drained (static).
@@ -165,11 +167,20 @@ class Scheduler:
             if not self.queue or self.queue[0].arrival_s > now:
                 break
             req = self.queue[0]
-            if not self._fits(req):
+            # One-pass fit check + admission (try_admit peeks the
+            # POST-SHARING bill — a cached prefix's pages are retained,
+            # not allocated, and tree-only pages count as reclaimable —
+            # and only when it fits performs the reservation; no second
+            # radix match / evictable walk on the hot path). A cold
+            # request on a warm pool queues exactly when its full
+            # reservation exceeds free + evictable
+            # (tests/test_prefix_cache.py pins the regression).
+            got = self.cache.try_admit(req.rid, req.prompt,
+                                       req.total_capacity)
+            if got is None:
                 break                      # head-of-line: wait for pages
             self.queue.popleft()
-            self.cache.open(req.rid)
-            self.cache.ensure(req.rid, req.total_capacity)
+            req.cached_prompt_tokens = got
             req.slot = slot
             req.state = RequestState.PREFILL
             req.t_admitted = now

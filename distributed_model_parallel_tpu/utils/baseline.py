@@ -68,6 +68,11 @@ GATE_METRICS: dict[str, bool] = {
     "ttft_p99_s": False,
     "token_latency_p99_s": False,
     "step_time_p50_s": False,
+    # Serving-efficiency fields (BENCH_serve chat mode): a prefix-cache
+    # or proposer regression can hide inside an unchanged tokens/s on a
+    # faster machine — gate the ratios directly.
+    "cache_hit_rate": True,
+    "draft_accept_rate": True,
 }
 
 DEFAULT_K = 3.0
@@ -153,7 +158,9 @@ def ingest_artifact(path: str) -> list[dict]:
         }]
     metrics: dict[str, float] = {"throughput": float(value)}
     for src, dst in (("mfu", "mfu"), ("ttft_p99_s", "ttft_p99_s"),
-                     ("token_latency_p99_s", "token_latency_p99_s")):
+                     ("token_latency_p99_s", "token_latency_p99_s"),
+                     ("cache_hit_rate", "cache_hit_rate"),
+                     ("draft_accept_rate", "draft_accept_rate")):
         v = parsed.get(src)
         if isinstance(v, (int, float)):
             metrics[dst] = float(v)
@@ -240,7 +247,8 @@ def extract_points(records: list[dict]) -> list[dict]:
         if b.get("value") is None:
             continue
         metrics: dict[str, float] = {"throughput": float(b["value"])}
-        for k in ("mfu", "ttft_p99_s", "token_latency_p99_s"):
+        for k in ("mfu", "ttft_p99_s", "token_latency_p99_s",
+                  "cache_hit_rate", "draft_accept_rate"):
             if isinstance(b.get(k), (int, float)):
                 metrics[k] = float(b[k])
         if step_p50 is not None:

@@ -286,6 +286,8 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
         occ = s.get("page_occupancy") or {}
         tps = s.get("tokens_per_s")
         util = s.get("slot_utilization")
+        hit = s.get("cache_hit_rate")
+        accept = s.get("draft_accept_rate")
         lines.append(
             f"engine[{s.get('policy')}]: "
             f"{s.get('tokens_generated')} tokens"
@@ -296,6 +298,28 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
             + (f", page occupancy mean {occ.get('mean'):.2f} "
                f"max {occ.get('max'):.2f}"
                if isinstance(occ.get("mean"), (int, float)) else ""))
+        # Prefix-cache + speculative-decoding line only when either
+        # lever was on (docs/SERVING.md) — a plain engine stays terse.
+        if s.get("prefix_cache") or s.get("spec_k"):
+            parts = []
+            if s.get("prefix_cache"):
+                parts.append(
+                    f"cache hit {hit:.2f}"
+                    if isinstance(hit, (int, float)) else "cache hit -")
+                parts.append(f"{s.get('prefill_tokens_saved', 0)} prefill "
+                             f"tokens saved")
+                parts.append(f"{s.get('cached_prefix_pages', 0)} cached "
+                             f"pages ({s.get('prefix_evictions', 0)} "
+                             f"evicted)")
+            if s.get("spec_k"):
+                parts.append(
+                    f"draft accept {accept:.2f} "
+                    f"({s.get('draft_tokens_accepted', 0)}"
+                    f"/{s.get('draft_tokens_proposed', 0)} at "
+                    f"k={s.get('spec_k')})"
+                    if isinstance(accept, (int, float))
+                    else f"draft accept - (k={s.get('spec_k')})")
+            lines.append("  " + ", ".join(parts))
     for r in failed:
         lines.append(f"  FAILED {r.get('request')}: {r.get('error')} "
                      f"({str(r.get('detail', ''))[:80]})")

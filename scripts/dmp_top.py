@@ -192,7 +192,7 @@ class FleetState:
                 for name, prov in sorted(
                         (self.statusz.get("providers") or {}).items()):
                     if prov.get("workload") == "serve":
-                        lines.append(
+                        line = (
                             f"serve[{name}]  queue={prov.get('queue_depth')}"
                             f"  active={prov.get('active_requests')}"
                             f"/{prov.get('n_slots')} slots"
@@ -201,6 +201,17 @@ class FleetState:
                                           (int, float))
                             else f"serve[{name}]  "
                                  f"queue={prov.get('queue_depth')}")
+                        # live prefix-cache + spec-decode health
+                        hit = prov.get("cache_hit_rate")
+                        if prov.get("prefix_cache"):
+                            line += (f"  hit={hit:.2f}" if isinstance(
+                                hit, (int, float)) else "  hit=-")
+                            line += f"  shared={prov.get('shared_pages')}"
+                        acc = prov.get("draft_accept_rate")
+                        if prov.get("spec_k"):
+                            line += (f"  accept={acc:.2f}" if isinstance(
+                                acc, (int, float)) else "  accept=-")
+                        lines.append(line)
                 spans = self.statusz.get("spans") or {}
                 for thread, stack in sorted(spans.items()):
                     lines.append(f"span  {thread}: {' > '.join(stack)}")

@@ -243,10 +243,17 @@ def test_torn_tail_counts_and_never_poisons_a_fleet_merge(tmp_path,
 def test_stream_rotation_and_globbed_readback(tmp_path):
     """Satellite: TelemetryRun(max_bytes=...) rotates the live file to
     {stem}.N.jsonl parts; read_records/merge_streams glob the parts back
-    in order so a rotated long-run stream reads as one stream."""
+    in order so a rotated long-run stream reads as one stream.
+
+    Hermetic registry: finish() snapshots every metric name the process
+    has ever created into ONE ``metrics`` line, and a single line larger
+    than max_bytes cannot be split — against the process-global registry
+    this test's part-size assertion would depend on how many metrics the
+    rest of the suite registered before it ran."""
     path = str(tmp_path / "run.jsonl")
     run = telemetry.TelemetryRun(path, run="long", track_compiles=False,
-                                 max_bytes=4096)
+                                 max_bytes=4096,
+                                 registry_=telemetry.MetricsRegistry())
     n = 60
     for i in range(n):
         # Non-ASCII payload: rotation must count written BYTES (the em
