@@ -892,6 +892,173 @@ def bench_serve() -> None:
     _enforce_gate(gate)
 
 
+def bench_serve_fleet() -> None:
+    """Multi-replica fleet serving bench + replica-kill drill
+    (``DMP_BENCH_SERVE_FLEET=N``, N >= 2; docs/SERVING.md "Fleet
+    serving").
+
+    Replays one seeded open-loop Poisson trace (build_serve_trace)
+    through an N-replica :class:`ServeFleet` twice: once clean — the
+    headline **fleet tokens/s/chip** — and once with replica ``r1``
+    killed mid-stream at round ``DMP_BENCH_SERVE_KILL_ROUND`` (its
+    in-flight requests migrate live to peers) and grown back after
+    ``DMP_BENCH_SERVE_REVIVE_ROUNDS``. The drill's gates, all asserted:
+    zero lost requests, every request's tokens bitwise identical to the
+    clean run (migrated ones included — the determinism contract), and
+    post-kill admission p99 TTFT within
+    ``DMP_BENCH_SERVE_FLEET_TTFT_FACTOR`` (default 4x) of pre-kill.
+    """
+    from distributed_model_parallel_tpu.config import MeshConfig
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import (
+        Engine,
+        ServeConfig,
+        ServeFleet,
+    )
+    from distributed_model_parallel_tpu.serve.scheduler import summarize
+
+    trace, cfg = build_serve_trace()
+    n_replicas = int(os.environ["DMP_BENCH_SERVE_FLEET"])
+    n_chips = len(jax.devices())
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots = int(os.environ.get("DMP_BENCH_SERVE_SLOTS", "8"))
+    page = int(os.environ.get("DMP_BENCH_SERVE_PAGE", "16"))
+    kill_round = int(os.environ.get("DMP_BENCH_SERVE_KILL_ROUND", "40"))
+    revive_rounds = int(os.environ.get("DMP_BENCH_SERVE_REVIVE_ROUNDS",
+                                       "20"))
+    ttft_factor = float(os.environ.get("DMP_BENCH_SERVE_FLEET_TTFT_FACTOR",
+                                       "4.0"))
+    # Absolute band floor: on an unsaturated fleet the pre-kill p99 is
+    # just one prefill (~ms on CPU), and a purely multiplicative band
+    # would flag the drill for sub-second re-admission waits that are
+    # round-time granularity, not a regression.
+    ttft_floor = float(os.environ.get("DMP_BENCH_SERVE_FLEET_TTFT_FLOOR",
+                                      "0.5"))
+    pages_per_seq = -(-cfg.max_seq_len // page)
+    serve = ServeConfig(
+        n_slots=n_slots, page_size=page,
+        # Per-replica pool: a full batch of worst-case requests plus one
+        # waiting admission, like the single-engine bench.
+        n_pages=(n_slots + 1) * pages_per_seq,
+        max_seq_len=cfg.max_seq_len,
+        prefill_chunk=int(os.environ.get("DMP_BENCH_SERVE_CHUNK", "32")))
+    telemetry = _telemetry_run("serve", dict(
+        trace="fleet", n_replicas=n_replicas, n_requests=len(trace),
+        n_slots=n_slots, page_size=page, kill_round=kill_round,
+        d_model=cfg.d_model, n_layers=cfg.n_layers))
+    # One warmed engine compiles the programs every replica shares
+    # (builders are memoized per geometry) — compile stays out of both
+    # timed walls.
+    Engine(params, cfg, serve, slo_metrics=False).warmup()
+    _log(f"serve-fleet: programs warmed for {n_replicas} replicas")
+
+    def run(kill: bool):
+        fleet = ServeFleet(params, cfg, serve, n_replicas,
+                           telemetry=telemetry,
+                           revive_after=revive_rounds if kill else None)
+        if kill:
+            def hook(rnd):
+                if rnd == kill_round:
+                    n = fleet.kill_replica("r1")
+                    _log(f"serve-fleet: killed r1 at round {rnd}, "
+                         f"{n} requests migrating")
+            fleet.step_hook = hook
+        for r in trace:
+            fleet.submit(r["prompt"], r["max_new_tokens"],
+                         arrival_s=r["arrival_s"], seed=r["seed"])
+        summary = fleet.run()
+        _log(f"serve-fleet[{'kill-drill' if kill else 'clean'}]: "
+             f"{summary['tokens_generated']} tokens in "
+             f"{summary['wall_s']:.1f}s "
+             f"({summary['tokens_per_s'] or 0:.1f} tok/s, "
+             f"{summary['migrations']} migrations)")
+        return fleet, summary
+
+    clean_fleet, clean = run(False)
+    drill_fleet, drill = run(True)
+    if "r1" not in drill_fleet.kill_times:
+        raise RuntimeError(
+            f"kill drill never fired: the trace drained in "
+            f"{drill['rounds']} rounds, before kill round {kill_round} "
+            f"(DMP_BENCH_SERVE_KILL_ROUND) — lower the kill round or "
+            f"lengthen the trace; the drill numbers would have measured "
+            f"a run with zero migrations")
+    if drill["requests_failed"] or clean["requests_failed"]:
+        raise RuntimeError(
+            f"fleet drill lost requests: clean {clean['requests_failed']} "
+            f"failed, drill {drill['requests_failed']} failed")
+    clean_toks = {r.rid: r.generated for r in clean_fleet.results()}
+    for r in drill_fleet.results():
+        if r.generated != clean_toks[r.rid]:
+            raise RuntimeError(
+                f"request {r.rid} decoded different tokens after the "
+                f"replica kill ({r.migrations} migrations) — the "
+                f"migration path broke the determinism contract")
+    if any(rep.state != "live" for rep in drill_fleet.replicas):
+        raise RuntimeError("killed replica did not grow back")
+    # Pre/post-kill admission TTFT: requests ADMITTED before vs after
+    # the kill instant (fleet clock).
+    kill_t = drill_fleet.kill_times["r1"]
+    done = [r for r in drill_fleet.results()
+            if r.t_first_token is not None and r.t_admitted is not None]
+    pre = summarize([max(0.0, r.t_first_token - r.arrival_s)
+                     for r in done if r.t_admitted < kill_t])
+    post = summarize([max(0.0, r.t_first_token - r.arrival_s)
+                      for r in done if r.t_admitted >= kill_t])
+    # Reference = the worse of pre-kill p99 and the clean run's overall
+    # p99 (an unloaded pre-kill window understates steady-state TTFT).
+    ref = max([x for x in (pre.get("p99"), clean["ttft_s"].get("p99"))
+               if x is not None], default=None)
+    post_ok = (post.get("p99") is None or ref is None
+               or post["p99"] <= max(ref * ttft_factor, ttft_floor))
+    tok_s = (clean["tokens_per_s"] or 0.0) / n_chips
+    drill_tok_s = (drill["tokens_per_s"] or 0.0) / n_chips
+    out = {
+        "metric": (f"lm_serve_fleet{n_replicas}_bs{n_slots}"
+                   f"_tokens_per_sec_per_chip"),
+        "value": round(tok_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,   # the reference repo has no serving path
+        "mfu": None,
+        "n_replicas": n_replicas,
+        "drill_tokens_per_s_per_chip": round(drill_tok_s, 1),
+        "tokens_identical_after_kill": True,
+        "requests": len(trace),
+        "requests_completed": drill["requests_completed"],
+        "requests_migrated": drill["requests_migrated"],
+        "migrations": drill["migrations"],
+        "replica_grew_back": True,
+        "router_assignments": drill["router"]["assignments"],
+        "ttft_p50_s": round(clean["ttft_s"].get("p50", 0), 4),
+        "ttft_p99_s": round(clean["ttft_s"].get("p99", 0), 4),
+        "pre_kill_ttft_p99_s": (round(pre["p99"], 4)
+                                if pre.get("p99") is not None else None),
+        "post_kill_ttft_p99_s": (round(post["p99"], 4)
+                                 if post.get("p99") is not None else None),
+        "post_kill_ttft_factor": ttft_factor,
+        "post_kill_ttft_ok": bool(post_ok),
+        "token_latency_p99_s": round(
+            clean["token_latency_s"].get("p99", 0), 5),
+        "page_occupancy_max": None,
+        # The replicas run replicated on disjoint pool slices (no mesh
+        # axes — ROADMAP item 2's TP engine will change this).
+        "plan": plan_payload(MeshConfig(), "serve"),
+    }
+    clean_fleet.close()
+    drill_fleet.close()
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
+    telemetry.finish()
+    print(json.dumps(out))
+    if not post_ok:
+        raise SystemExit(
+            f"post-kill admission p99 TTFT {post['p99']:.3f}s exceeds "
+            f"max({ttft_factor}x reference {ref:.3f}s, floor "
+            f"{ttft_floor}s)")
+    _enforce_gate(gate)
+
+
 def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int,
                     image_size: int = 32):
     """The headline CNN workload: a device-resident Trainer plus a
@@ -1101,7 +1268,9 @@ def _run_workload() -> None:
         bench_decode()
         return
     if os.environ.get("DMP_BENCH_WORKLOAD") == "serve":
-        if os.environ.get("DMP_BENCH_SERVE_TRACE") == "chat":
+        if int(os.environ.get("DMP_BENCH_SERVE_FLEET", "0")) >= 2:
+            bench_serve_fleet()
+        elif os.environ.get("DMP_BENCH_SERVE_TRACE") == "chat":
             bench_serve_chat()
         else:
             bench_serve()

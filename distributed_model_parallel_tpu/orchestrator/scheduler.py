@@ -114,6 +114,30 @@ class DevicePool:
         self._assigned[tenant] = tuple(grant)
         return tuple(self._by_id[i] for i in grant)
 
+    def assign_ids(self, tenant: str, ids: Sequence[int]) -> tuple:
+        """Grant a SPECIFIC free id set to ``tenant`` — the serving
+        fleet's grow-back path (serve/fleet.py): a reinstated replica
+        re-claims its exact pre-quarantine slice so the replica->device
+        mapping stays stable across quarantine cycles. Every id must be
+        free (reinstated ids are; a raise means the caller's ledger and
+        this one disagree)."""
+        want = sorted(int(i) for i in ids)
+        if tenant in self._assigned:
+            raise RuntimeError(f"tenant {tenant!r} already holds devices "
+                               f"{self._assigned[tenant]}")
+        unknown = [i for i in want if i not in self._by_id]
+        if unknown:
+            raise KeyError(f"unknown device ids {unknown}")
+        missing = [i for i in want if i not in self._free]
+        if missing:
+            raise RuntimeError(
+                f"cannot grant {missing} to {tenant!r}: not free "
+                f"(revoked {self.revoked_ids}, quarantined "
+                f"{self.quarantined_ids})")
+        self._free = [i for i in self._free if i not in want]
+        self._assigned[tenant] = tuple(want)
+        return tuple(self._by_id[i] for i in want)
+
     def release(self, tenant: str) -> tuple[int, ...]:
         """Return a tenant's slice to the pool (preemption drained or job
         finished). Devices revoked or quarantined while held go to their

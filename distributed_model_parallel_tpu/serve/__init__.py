@@ -23,15 +23,32 @@ package turns them into a serving engine:
 * :mod:`serve.engine` — the loop wiring them together, with per-request
   SLO accounting (TTFT, per-token latency, queue wait, cache hit rate,
   draft accept rate) in the telemetry registry and typed ``serve``
-  records.
+  records;
+* :mod:`serve.router` — SLO-aware replica selection:
+  power-of-two-choices over live queue depth + page occupancy with a
+  prefix-affinity bonus (deterministic, seeded);
+* :mod:`serve.fleet` — the self-healing multi-replica tier: N engine
+  replicas on disjoint device-pool slices behind the router, wired into
+  the device-health sentinel — a degrading replica is quarantined and
+  its in-flight requests migrate live to peers (KV pages exported by
+  value, re-imported at the exact committed position), then the replica
+  grows back after probation.
 
-See docs/SERVING.md for the anatomy and the BENCH_serve recipe.
+See docs/SERVING.md for the anatomy, the BENCH_serve recipe and the
+fleet kill-drill recipe.
 """
 
 from distributed_model_parallel_tpu.serve.engine import (  # noqa: F401
     Engine,
     EngineKilled,
     ServeConfig,
+)
+from distributed_model_parallel_tpu.serve.fleet import (  # noqa: F401
+    Replica,
+    ServeFleet,
+)
+from distributed_model_parallel_tpu.serve.router import (  # noqa: F401
+    Router,
 )
 from distributed_model_parallel_tpu.serve.paged_kv import (  # noqa: F401
     PagedKVCache,

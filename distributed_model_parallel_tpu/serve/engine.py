@@ -38,6 +38,7 @@ from distributed_model_parallel_tpu.serve.model import (
 )
 from distributed_model_parallel_tpu.serve.paged_kv import (
     PagedKVCache,
+    PagePoolError,
     share_granularity_for,
 )
 from distributed_model_parallel_tpu.serve.spec import NGramProposer
@@ -108,7 +109,7 @@ class Engine:
 
     def __init__(self, params: dict, cfg: TransformerConfig,
                  serve: ServeConfig, *, telemetry=None, step_hook=None,
-                 slo_metrics: bool = True):
+                 slo_metrics: bool = True, replica: str | None = None):
         if cfg.moe_experts:
             raise ValueError(
                 "MoE decode routing is batch-coupled (expert-capacity "
@@ -137,6 +138,11 @@ class Engine:
         self.serve = serve
         self.telemetry = telemetry
         self.step_hook = step_hook
+        # Fleet membership (serve/fleet.py): the replica name tags this
+        # engine's serve records and statusz provider so a multi-replica
+        # stream stays attributable. None = standalone engine (PR 9
+        # behavior, provider named serve-{policy}).
+        self.replica = replica
         # slo_metrics=False keeps this engine out of the process-wide
         # registry (serve_* counters/histograms/gauge) — warmup/probe
         # engines must not pollute the samples a telemetry stream's
@@ -214,17 +220,21 @@ class Engine:
         from distributed_model_parallel_tpu.utils import statusz
 
         statusz.maybe_serve(serve.statusz_port)
-        # One provider per policy: a later engine of the same policy
-        # replaces the entry. Warmup/probe engines (slo_metrics=False)
-        # stay off the exporter like they stay out of the registry.
+        # One provider per policy (or per fleet replica): a later engine
+        # of the same name replaces the entry. Warmup/probe engines
+        # (slo_metrics=False) stay off the exporter like they stay out
+        # of the registry.
+        self._provider = (f"serve-{replica}" if replica is not None
+                          else f"serve-{serve.policy}")
         if slo_metrics:
-            statusz.register(f"serve-{serve.policy}", self._status)
+            statusz.register(self._provider, self._status)
 
     def _status(self) -> dict:
         """The engine's /statusz provider payload."""
         return {
             "workload": "serve",
             "policy": self.serve.policy,
+            "replica": self.replica,
             "iterations": self._iterations,
             "queue_depth": len(self.sched.queue),
             "active_requests": sum(1 for r in self._requests
@@ -296,19 +306,105 @@ class Engine:
     def submit(self, prompt, max_new_tokens: int, *, rid: str | None = None,
                arrival_s: float = 0.0, seed: int = 0) -> Request:
         prompt = [int(t) for t in prompt]
-        bad = [t for t in prompt if not (0 <= t < self.cfg.vocab_size)]
-        if bad:
-            raise ValueError(f"prompt tokens {bad} outside vocab "
-                             f"[0, {self.cfg.vocab_size})")
         if rid is None:
             rid = f"req-{self._auto_rid}"
             self._auto_rid += 1
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       arrival_s=float(arrival_s), seed=int(seed))
+        return self.enqueue(req)
+
+    def enqueue(self, req: Request) -> Request:
+        """Accept an already-built :class:`Request` — the fleet router's
+        entry point (serve/fleet.py), and the re-admission path for a
+        request drained off a quarantined peer (its committed tokens,
+        cursor and ``resume`` payload ride on the object)."""
+        bad = [t for t in req.prompt
+               if not (0 <= t < self.cfg.vocab_size)]
+        if bad:
+            raise ValueError(f"prompt tokens {bad} outside vocab "
+                             f"[0, {self.cfg.vocab_size})")
         self.sched.submit(req)
         self._requests.append(req)
         return req
+
+    # -- live migration (serve/fleet.py) ------------------------------------
+
+    def drain(self) -> list[Request]:
+        """Take every live request off this engine for migration to a
+        peer replica, in submission order. Each resident request's
+        committed state is serialized onto the object itself: the
+        ``resume`` payload carries its written KV pages **by value**
+        (``PagedKVCache.export_request``), so nothing references this
+        engine's pool or radix tree afterwards. Queued requests ride
+        along untouched (a queued request that was itself migrated in
+        keeps the payload it still carries). Slots and pages return to
+        this engine immediately; terminal requests stay for the record.
+        """
+        out: list[Request] = []
+        for req in self._requests:
+            if req.done:
+                continue
+            if req.slot is not None:
+                if req.state is RequestState.PREFILL:
+                    # Positions [0, cursor) are prefilled and written.
+                    n_written = req.prefill_cursor
+                else:
+                    # Plain decode feeds a committed token back BEFORE
+                    # writing its KV, so the last committed token's slot
+                    # is unwritten (and under speculation may hold a
+                    # rejected draft's write) — the same boundary
+                    # ``_complete`` trims before the prefix tree.
+                    n_written = req.prompt_len + len(req.generated) - 1
+                k, v = self.cache.export_request(req.rid, n_written)
+                req.resume = {
+                    "k": k, "v": v, "n_written": n_written,
+                    "state": ("decode" if req.state is RequestState.DECODE
+                              else "prefill"),
+                }
+                req.state = RequestState.QUEUED
+            self.sched.withdraw(req)
+            self._proposers.pop(req.rid, None)
+            self._spec_streak.pop(req.rid, None)
+            self._spec_live.pop(req.rid, None)
+            req.migrations += 1
+            out.append(req)
+        self._requests = [r for r in self._requests if r.done]
+        return out
+
+    def _restore_imported(self, req: Request) -> None:
+        """Finish admitting a migrated-in request: its pages are already
+        imported — resume at the exact committed position (mid-prefill
+        cursors are chunk-aligned, so the remaining chunks replay the
+        cold run's exact program stream; mid-decode requests re-enter
+        the decode batch as if they had never left)."""
+        payload = req.resume
+        req.resume = None
+        req.state = (RequestState.DECODE if payload["state"] == "decode"
+                     else RequestState.PREFILL)
+        if self.serve.spec_k:
+            # The proposer is a pure function of the committed stream —
+            # rebuild it from prompt + committed tokens. Gating restarts
+            # in shadow mode (re-prove on this replica); that moves WHEN
+            # drafts ride, never which tokens commit.
+            prop = NGramProposer(self.serve.spec_k,
+                                 max_order=self.serve.spec_ngram)
+            prop.extend(req.prompt)
+            prop.extend(req.generated)
+            self._proposers[req.rid] = prop
+
+    def clear_cache(self) -> int:
+        """Drop the prefix tree and verify every page is back on the
+        free list — the quarantine invariant ("all pages of the dead
+        replica are returned"). Call after :meth:`drain`; a page still
+        held here would mean an exported request left a reference
+        behind. Returns the tree pages freed."""
+        freed = self.cache.drop_prefix()
+        if self.cache.pool.used_pages:
+            raise PagePoolError(
+                f"engine {self._provider}: {self.cache.pool.used_pages} "
+                f"pages still held after drain + prefix drop")
+        return freed
 
     # -- the loop -----------------------------------------------------------
 
@@ -333,10 +429,7 @@ class Engine:
                             and self._iterations >= max_iterations):
                         break
                     now = time.monotonic() - t0
-                    if self.step_hook is not None:
-                        self.step_hook(self._iterations)
-                    self._iterations += 1
-                    made_progress = self._iterate(now, t0)
+                    made_progress = self.step_once(now, t0)
                     if not made_progress:
                         nxt = self.sched.next_arrival()
                         if nxt is not None:
@@ -370,10 +463,28 @@ class Engine:
         self._wall_s += time.monotonic() - t0
         return self.summary(record=record_summary)
 
+    def step_once(self, now: float, t0: float) -> bool:
+        """One engine iteration (admit → prefill chunk(s) → decode round
+        → evict) at open-loop clock ``now`` (seconds since the monotonic
+        origin ``t0``). ``run()`` loops over this; the fleet
+        (serve/fleet.py) drives its replicas' iterations round-robin
+        through it directly so every replica shares one clock."""
+        if self.step_hook is not None:
+            self.step_hook(self._iterations)
+        self._iterations += 1
+        return self._iterate(now, t0)
+
     def _iterate(self, now: float, t0: float) -> bool:
         progress = False
         for req in self.sched.admit(now):
             self._tables_np[req.slot] = self.cache.table_array(req.rid)
+            if req.resume is not None:
+                # A migrated-in request: its pages were imported by the
+                # scheduler; resume at the exact committed position —
+                # no prompt/cache accounting (its prefill was billed on
+                # the source replica) and no second queue-wait sample.
+                self._restore_imported(req)
+                continue
             # Cache-hit admission: the shared pages already hold the
             # prefix KV — prefill starts at the first uncached token.
             req.prefill_cursor = req.cached_prompt_tokens
@@ -397,7 +508,14 @@ class Engine:
             progress = True
         occ = self.cache.occupancy
         self._occupancy.append(occ)
-        if self._slo_metrics:
+        # Fleet replicas (self.replica set) skip the process-global
+        # gauge writes: N engines flapping one unlabeled gauge would
+        # report whichever iterated last. The fleet aggregates ALL of
+        # these gauges across live replicas itself (ServeFleet
+        # _set_engine_gauges: occupancy max, shared-pages sum, pooled
+        # hit/accept rates); per-replica values live on the /statusz
+        # providers.
+        if self._slo_metrics and self.replica is None:
             reg = registry()
             reg.gauge("serve_page_occupancy").set(occ)
             if self.serve.prefix_cache:
@@ -659,7 +777,9 @@ class Engine:
                 new_tokens=len(req.generated),
                 queue_wait_s=self._queue_wait(req),
                 ttft_s=self._ttft(req), token_latency_s=token_s,
-                wall_s=req.t_done - req.arrival_s)
+                wall_s=req.t_done - req.arrival_s,
+                **({"replica": self.replica, "migrations": req.migrations}
+                   if self.replica is not None else {}))
 
     def _fail_inflight(self, detail: str) -> None:
         for req in self._requests:
@@ -683,7 +803,9 @@ class Engine:
                     policy=self.serve.policy,
                     error="engine-killed", detail=detail,
                     prompt_tokens=req.prompt_len,
-                    new_tokens=len(req.generated))
+                    new_tokens=len(req.generated),
+                    **({"replica": self.replica}
+                       if self.replica is not None else {}))
 
     # -- SLO bookkeeping ----------------------------------------------------
 

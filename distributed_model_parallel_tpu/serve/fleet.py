@@ -1,0 +1,587 @@
+"""Self-healing multi-replica serving fleet.
+
+``ServeFleet`` runs N independent :class:`~serve.engine.Engine` replicas
+— one model copy and one paged KV pool each, on a disjoint
+:class:`~orchestrator.scheduler.DevicePool` slice — behind a
+:class:`~serve.router.Router` that admits requests with SLO-aware
+balancing (power-of-two-choices over live queue depth + page occupancy,
+with a prefix-affinity bonus toward the replica whose radix tree already
+holds the prompt). One fleet round = one router dispatch pass + one
+engine iteration per live replica, all on a shared monotonic clock, so
+the whole fleet replays deterministically for a fixed trace.
+
+Self-healing: the fleet is a tenant of the PR 7 device-health sentinel
+(``utils/health.DeviceHealthMonitor``). Each replica's per-round wall
+time feeds the monitor as a ``serve`` signal on its device slice; when
+the monitor quarantines a replica's devices (or an operator/chaos drill
+calls :meth:`kill_replica`), the replica is **drained, not killed**:
+
+1. every live request's committed tokens + written KV pages are
+   serialized out of the paged cache (``PagedKVCache.export_request`` —
+   values, never page ids, so nothing references the dying replica);
+2. the replica's prefix tree is dropped and every page verified back on
+   the free list (``Engine.clear_cache``);
+3. its devices leave the pool (``DevicePool.quarantine`` + release);
+4. each drained request is re-admitted on the least-loaded peer at the
+   exact committed position (``PagedKVCache.import_request`` + the
+   engine's resume path) — a typed ``migration`` record per move.
+
+Because a request's tokens are a pure function of (prompt, seed) — the
+engine's pinned determinism contract — a migrated request's remaining
+tokens are **bitwise identical** to an unmigrated run, and the chaos
+drill (tests/test_fleet.py, BENCH_serve fleet mode) asserts exactly
+that. Once the sentinel reinstates the devices (or ``revive_after``
+rounds pass in drill mode), the replica **grows back**: it re-claims its
+exact device slice (``DevicePool.assign_ids``) and the router resumes
+sending it traffic.
+
+See docs/SERVING.md "Fleet serving" for the operator recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+
+from distributed_model_parallel_tpu.serve.engine import (
+    Engine,
+    EngineKilled,
+    ServeConfig,
+)
+from distributed_model_parallel_tpu.serve.router import Router
+from distributed_model_parallel_tpu.serve.scheduler import (
+    Request,
+    RequestState,
+    summarize,
+    validate_request,
+)
+from distributed_model_parallel_tpu.utils import health as health_mod
+from distributed_model_parallel_tpu.utils import tracing
+from distributed_model_parallel_tpu.utils.telemetry import registry
+
+__all__ = ["Replica", "ServeFleet"]
+
+LIVE = "live"
+QUARANTINED = "quarantined"
+
+
+@dataclasses.dataclass
+class Replica:
+    """One serving replica: an engine plus its device slice."""
+
+    name: str
+    engine: Engine
+    device_ids: tuple[int, ...]
+    state: str = LIVE
+    quarantined_round: int | None = None
+    kills: int = 0                   # quarantine cycles survived
+
+
+class ServeFleet:
+    """N engine replicas behind an SLO-aware router (module docstring).
+
+    ``pool`` defaults to a fresh :class:`DevicePool` over
+    ``jax.devices()``; pass the orchestrator's pool to co-schedule the
+    serving tier with training tenants (replicas hold their slices under
+    ``serve-{name}``). ``health`` wires the device-health sentinel in;
+    without it, :meth:`kill_replica` + ``revive_after`` drive the same
+    quarantine/grow-back machinery (the chaos-drill mode).
+    ``step_hook(round)`` runs once per fleet round — the drill's kill
+    trigger, like the engine's per-iteration hook.
+    """
+
+    def __init__(self, params: dict, cfg, serve: ServeConfig,
+                 n_replicas: int, *, pool=None, devices=None,
+                 health=None, telemetry=None, router_seed: int = 0,
+                 affinity_slack: float = 2.0, revive_after: int | None = None,
+                 step_hook=None, slo_metrics: bool = True):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if serve.policy != "continuous":
+            raise ValueError(
+                "the fleet runs continuous-batching replicas; the static "
+                "baseline exists for single-engine BENCH_serve comparisons")
+        if pool is None:
+            from distributed_model_parallel_tpu.orchestrator.scheduler import (
+                DevicePool,
+            )
+
+            pool = DevicePool(devices if devices is not None
+                              else jax.devices())
+        self.pool = pool
+        per = pool.n_free // n_replicas
+        if per < 1:
+            raise ValueError(
+                f"{n_replicas} replicas need >= 1 free device each; the "
+                f"pool has {pool.n_free} free")
+        self.serve = serve
+        self.telemetry = telemetry
+        self.health = health
+        self.revive_after = revive_after
+        self.step_hook = step_hook
+        self._slo_metrics = slo_metrics
+        self.replicas: list[Replica] = []
+        for i in range(n_replicas):
+            name = f"r{i}"
+            devs = pool.assign(f"serve-{name}", per)
+            eng = Engine(params, cfg, serve, telemetry=telemetry,
+                         slo_metrics=slo_metrics, replica=name)
+            self.replicas.append(Replica(
+                name=name, engine=eng,
+                device_ids=tuple(d.id for d in devs)))
+        self.router = Router(router_seed, affinity_slack=affinity_slack)
+        self._pending: deque[Request] = deque()
+        self._requests: list[Request] = []
+        self._ids: set[str] = set()
+        self._auto_rid = 0
+        self._rounds = 0
+        self._now = 0.0
+        self._wall_s = 0.0
+        self._migrations = 0
+        self._kills = 0
+        self.kill_times: dict[str, float] = {}
+        self.revive_times: dict[str, float] = {}
+        if slo_metrics:
+            from distributed_model_parallel_tpu.utils import statusz
+
+            statusz.maybe_serve(serve.statusz_port)
+            statusz.register("serve-fleet", self._status)
+            self._set_live_gauge()
+
+    # -- views ---------------------------------------------------------------
+
+    def _live(self) -> list[Replica]:
+        return [r for r in self.replicas if r.state == LIVE]
+
+    def _holder(self, rep: Replica) -> str:
+        return f"serve-{rep.name}"
+
+    def _set_live_gauge(self) -> None:
+        if self._slo_metrics:
+            registry().gauge("serve_live_replicas").set(len(self._live()))
+
+    def _set_engine_gauges(self) -> None:
+        """The fleet owns the process-global engine gauges: replica
+        engines skip their own writes — N replicas flapping one
+        unlabeled gauge would report whichever iterated last
+        (per-replica numbers live on the /statusz providers).
+        ``serve_page_occupancy`` is the MAX across live replicas (what
+        the page-pool saturation alert wants to see),
+        ``serve_shared_pages`` the fleet-wide sum, and the
+        hit/accept-rate gauges pool the replicas' raw token counts (a
+        per-replica mean would weight an idle replica like a busy
+        one)."""
+        live = self._live()
+        if not (self._slo_metrics and live):
+            return
+        reg = registry()
+        reg.gauge("serve_page_occupancy").set(
+            max(r.engine.cache.occupancy for r in live))
+        if self.serve.prefix_cache:
+            reg.gauge("serve_shared_pages").set(
+                sum(r.engine.cache.shared_pages for r in live))
+            prompts = sum(r.engine._prompt_tokens for r in live)
+            if prompts:
+                reg.gauge("serve_cache_hit_rate").set(
+                    sum(r.engine._cached_tokens for r in live) / prompts)
+        if self.serve.spec_k:
+            proposed = sum(r.engine._draft_proposed for r in live)
+            if proposed:
+                reg.gauge("serve_draft_accept_rate").set(
+                    sum(r.engine._draft_accepted for r in live)
+                    / proposed)
+
+    def _status(self) -> dict:
+        """The fleet's /statusz provider: replica table + router state."""
+        return {
+            "workload": "serve-fleet",
+            "n_replicas": len(self.replicas),
+            "live": [r.name for r in self._live()],
+            "pending": len(self._pending),
+            "rounds": self._rounds,
+            "migrations": self._migrations,
+            "replica_kills": self._kills,
+            "router": {"assignments": dict(self.router.assignments),
+                       "affinity_hits": self.router.affinity_hits},
+            "replicas": {
+                r.name: {
+                    "state": r.state,
+                    "devices": list(r.device_ids),
+                    "queue_depth": len(r.engine.sched.queue),
+                    "active_requests": len(r.engine.sched.active()),
+                    "page_occupancy": r.engine.cache.occupancy,
+                    "assignments": self.router.assignments.get(r.name, 0),
+                } for r in self.replicas},
+            "healthy": bool(self._live()),
+        }
+
+    def results(self) -> list[Request]:
+        return list(self._requests)
+
+    def close(self) -> None:
+        """Unregister the fleet's /statusz presence (the fleet provider
+        plus every replica engine's). A discarded drill fleet must not
+        keep feeding stale replica state — including ``healthy: false``
+        from an all-quarantined end state — into /statusz and /healthz,
+        or pin N engines' params in the exporter's provider table (the
+        same teardown PR 12 added for reaped orchestrator tenants).
+        Results stay readable; the fleet just leaves the exporter."""
+        if self._slo_metrics:
+            from distributed_model_parallel_tpu.utils import statusz
+
+            statusz.unregister("serve-fleet")
+            for rep in self.replicas:
+                statusz.unregister(rep.engine._provider)
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, rid: str | None = None,
+               arrival_s: float = 0.0, seed: int = 0) -> Request:
+        """Queue a request at fleet level; the router assigns it to a
+        replica when it arrives (open loop), so placement sees the load
+        at arrival time, not submission time."""
+        prompt = [int(t) for t in prompt]
+        if rid is None:
+            rid = f"req-{self._auto_rid}"
+            self._auto_rid += 1
+        if rid in self._ids:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      arrival_s=float(arrival_s), seed=int(seed))
+        # Geometry is fleet-uniform: any replica's cache speaks for all.
+        ref = self.replicas[0].engine
+        validate_request(req, ref.cache)
+        bad = [t for t in prompt if not (0 <= t < ref.cfg.vocab_size)]
+        if bad:
+            raise ValueError(f"prompt tokens {bad} outside vocab "
+                             f"[0, {ref.cfg.vocab_size})")
+        self._ids.add(rid)
+        self._pending.append(req)
+        self._requests.append(req)
+        return req
+
+    def warmup(self) -> None:
+        """Compile every program once (engine builders are memoized per
+        geometry, so warming one replica warms them all)."""
+        self.replicas[0].engine.warmup()
+
+    # -- the control loop ----------------------------------------------------
+
+    def run(self, *, max_rounds: int | None = None,
+            record_summary: bool = True) -> dict:
+        """Drive the fleet until every submitted request is terminal (or
+        ``max_rounds``). Same contract as ``Engine.run``: a death marks
+        every live request failed (typed) before :class:`EngineKilled`
+        propagates."""
+        t0 = time.monotonic()
+        try:
+            with tracing.sink_scope(self.telemetry):
+                while not self._idle():
+                    if max_rounds is not None and self._rounds >= max_rounds:
+                        break
+                    now = time.monotonic() - t0
+                    self._now = now
+                    if self.step_hook is not None:
+                        self.step_hook(self._rounds)
+                    self._rounds += 1
+                    progress = self._dispatch(now)
+                    for rep in self.replicas:
+                        if rep.state != LIVE:
+                            continue
+                        w0 = time.monotonic()
+                        stepped = rep.engine.step_once(now, t0)
+                        if stepped:
+                            # Only WORKING rounds feed the sentinel: an
+                            # idle round's microsecond wall time would
+                            # seed the warmup-min baseline so low that
+                            # the first busy round reads as an outlier
+                            # and a healthy replica gets quarantined.
+                            self._observe(rep, time.monotonic() - w0)
+                        progress = progress or stepped
+                    self._set_engine_gauges()
+                    self._apply_health()
+                    self._maybe_revive()
+                    if (self._pending and not self._live()
+                            and self.revive_after is None
+                            and self.health is None):
+                        # No live peer and no revive path (no sentinel,
+                        # no drill timer): queued requests can never
+                        # dispatch — fail them typed instead of spinning
+                        # forever, like _migrate's no-live-peer branch.
+                        self._fail_pending(
+                            "all replicas quarantined with no revive "
+                            "path")
+                        continue
+                    if not progress:
+                        nxt = min((r.arrival_s for r in self._pending),
+                                  default=None)
+                        if nxt is not None:
+                            time.sleep(max(0.0, min(nxt - now, 0.05)))
+        except BaseException as e:
+            self._fail_fleet(f"{type(e).__name__}: {e}")
+            self._wall_s += time.monotonic() - t0
+            if self.telemetry is not None:
+                self.telemetry.failure(
+                    "fleet-killed", detail=f"{type(e).__name__}: {e}",
+                    round=self._rounds)
+            from distributed_model_parallel_tpu.utils import flightrec
+
+            flightrec.dump("fleet-killed", telemetry_run=self.telemetry,
+                           error=e)
+            if not isinstance(e, Exception):
+                raise
+            raise EngineKilled(
+                f"fleet died at round {self._rounds}; in-flight requests "
+                f"marked failed") from e
+        self._wall_s += time.monotonic() - t0
+        return self.summary(record=record_summary)
+
+    def _idle(self) -> bool:
+        return not self._pending and all(r.engine.sched.idle()
+                                         for r in self.replicas)
+
+    def _dispatch(self, now: float) -> bool:
+        """Route every arrived fleet-queue request to a live replica."""
+        progress = False
+        while self._pending and self._pending[0].arrival_s <= now:
+            live = self._live()
+            if not live:
+                break                 # all quarantined: wait for grow-back
+            req = self._pending[0]
+            rep, reason, loads = self.router.pick(req.prompt, live)
+            self._pending.popleft()
+            rep.engine.enqueue(req)
+            if self._slo_metrics:
+                registry().counter("serve_router_assignments").inc()
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "router", request=req.rid, replica=rep.name,
+                    reason=reason, round=self._rounds,
+                    loads={k: round(v, 3) for k, v in sorted(loads.items())})
+            progress = True
+        return progress
+
+    def _observe(self, rep: Replica, seconds: float) -> None:
+        """Feed the replica's round wall time to the health sentinel as
+        a ``serve`` signal on its device slice (the fleet's own monitor,
+        else whatever the orchestrator installed process-wide)."""
+        if self.health is not None:
+            self.health.observe("serve", rep.device_ids, seconds)
+        else:
+            health_mod.observe_serve(rep.device_ids, seconds)
+
+    # -- self-healing --------------------------------------------------------
+
+    def _apply_health(self) -> None:
+        """Consume the sentinel's transitions: quarantine events drain
+        the hit replicas to their peers; reinstate events grow them
+        back (typed ``health`` records on the fleet's stream, like the
+        orchestrator's control loop)."""
+        if self.health is None:
+            return
+        events = self.health.tick()
+        quarantined: list[int] = []
+        reinstated: list[int] = []
+        for ev in events:
+            if self.telemetry is not None:
+                self.telemetry.record("health", round=self._rounds, **ev)
+            if ev["event"] == "quarantine":
+                quarantined += ev["devices"]
+            elif ev["event"] == "reinstate":
+                reinstated += ev["devices"]
+        if quarantined:
+            bad = set(quarantined)
+            for rep in self.replicas:
+                if rep.state == LIVE and bad & set(rep.device_ids):
+                    self._quarantine_replica(rep, reason="device-degraded")
+        if reinstated:
+            back = set(reinstated)
+            still_bad = set(self.health.quarantined_ids)
+            for rep in self.replicas:
+                if (rep.state == QUARANTINED
+                        and back & set(rep.device_ids)
+                        and not still_bad & set(rep.device_ids)):
+                    self._revive(rep)
+
+    def _maybe_revive(self) -> None:
+        """Drill-mode grow-back: a killed replica revives after
+        ``revive_after`` quarantined rounds. On a health-wired fleet
+        this covers operator/drill kills the MONITOR never saw (no
+        reinstate event will ever arrive for them) — but a replica
+        whose devices the sentinel itself still quarantines stays down
+        until probation heals them (the sentinel's verdict wins)."""
+        if self.revive_after is None:
+            return
+        for rep in self.replicas:
+            if (rep.state != QUARANTINED
+                    or self._rounds - rep.quarantined_round
+                    < self.revive_after):
+                continue
+            if (self.health is not None
+                    and set(rep.device_ids)
+                    & set(self.health.quarantined_ids)):
+                continue
+            self._revive(rep)
+
+    def kill_replica(self, name: str, *, reason: str = "killed") -> int:
+        """Chaos-drill entry point: quarantine + drain replica ``name``
+        mid-stream (idempotent per cycle — killing an already
+        quarantined replica raises). Returns requests migrated."""
+        for rep in self.replicas:
+            if rep.name == name:
+                if rep.state != LIVE:
+                    raise ValueError(f"replica {name!r} is {rep.state}")
+                return self._quarantine_replica(rep, reason=reason)
+        raise KeyError(f"unknown replica {name!r}")
+
+    def _quarantine_replica(self, rep: Replica, *, reason: str) -> int:
+        drained = rep.engine.drain()
+        rep.engine.clear_cache()     # raises if any page is still held
+        rep.state = QUARANTINED
+        rep.quarantined_round = self._rounds
+        rep.kills += 1
+        self._kills += 1
+        self.kill_times[rep.name] = self._now
+        self.pool.quarantine(rep.device_ids)
+        self.pool.release(self._holder(rep))
+        self._set_live_gauge()
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "event", message=f"fleet quarantine: replica {rep.name} "
+                                 f"({reason}) devices {rep.device_ids} out "
+                                 f"of service, {len(drained)} requests "
+                                 f"draining")
+        migrated = 0
+        for req in drained:
+            migrated += self._migrate(req, rep)
+        return migrated
+
+    def _migrate(self, req: Request, source: Replica) -> int:
+        live = self._live()
+        if not live:
+            # Nowhere to drain to: the request fails typed, exactly like
+            # an engine kill — never silently dropped.
+            req.state = RequestState.FAILED
+            req.error = (f"fleet-killed: replica {source.name} quarantined "
+                         f"with no live peer")
+            req.resume = None
+            if self._slo_metrics:
+                registry().counter("serve_requests_failed").inc()
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "serve", event="failed", request=req.rid,
+                    policy="fleet", error="no-live-replica",
+                    detail=req.error, prompt_tokens=req.prompt_len,
+                    new_tokens=len(req.generated))
+            return 0
+        target, reason, loads = self.router.pick(req.prompt, live,
+                                                 migrate=True)
+        pages = int(req.resume["k"].shape[1]) if req.resume else 0
+        target.engine.enqueue(req)
+        self._migrations += 1
+        if self._slo_metrics:
+            registry().counter("serve_router_assignments").inc()
+            registry().counter("serve_migrations").inc()
+        if self.telemetry is not None:
+            # A drain placement is an assignment like any other: the
+            # typed router record (reason=migrate, or `only` with one
+            # peer) keeps the report's folded counts, the counter and
+            # Router.assignments in agreement.
+            self.telemetry.record(
+                "router", request=req.rid, replica=target.name,
+                reason=reason, round=self._rounds,
+                loads={k: round(v, 3) for k, v in sorted(loads.items())})
+            self.telemetry.record(
+                "migration", request=req.rid, from_replica=source.name,
+                to_replica=target.name, round=self._rounds,
+                state=(req.resume["state"] if req.resume else "queued"),
+                tokens_committed=len(req.generated), pages=pages,
+                loads={k: round(v, 3) for k, v in sorted(loads.items())})
+        return 1
+
+    def _revive(self, rep: Replica) -> None:
+        """Grow the replica back: reinstate + re-claim its exact device
+        slice, then let the router resume sending it traffic (its cache
+        is empty — the prefix tree refills from live traffic)."""
+        self.pool.reinstate(rep.device_ids)
+        self.pool.assign_ids(self._holder(rep), rep.device_ids)
+        rep.state = LIVE
+        rep.quarantined_round = None
+        self.revive_times[rep.name] = self._now
+        self._set_live_gauge()
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "event", message=f"fleet grow-back: replica {rep.name} "
+                                 f"devices {rep.device_ids} back in "
+                                 f"service")
+
+    def _fail_fleet(self, detail: str) -> None:
+        for rep in self.replicas:
+            rep.engine._fail_inflight(detail)
+        self._fail_pending(detail)
+
+    def _fail_pending(self, detail: str) -> None:
+        while self._pending:
+            req = self._pending.popleft()
+            req.state = RequestState.FAILED
+            req.error = f"fleet-killed: {detail}"
+            if self._slo_metrics:
+                registry().counter("serve_requests_failed").inc()
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "serve", event="failed", request=req.rid,
+                    policy="fleet", error="fleet-killed", detail=detail,
+                    prompt_tokens=req.prompt_len,
+                    new_tokens=len(req.generated))
+
+    # -- results -------------------------------------------------------------
+
+    def summary(self, *, record: bool = True) -> dict:
+        """Fleet-level SLO + throughput rollup (one typed ``serve``
+        summary record with ``policy="fleet"`` when recording)."""
+        completed = [r for r in self._requests
+                     if r.state is RequestState.COMPLETED]
+        failed = [r for r in self._requests
+                  if r.state is RequestState.FAILED]
+        tokens = sum(len(r.generated) for r in completed)
+        ttft = [max(0.0, r.t_first_token - r.arrival_s) for r in completed
+                if r.t_first_token is not None]
+        waits = [max(0.0, r.t_admitted - r.arrival_s) for r in completed
+                 if r.t_admitted is not None]
+        token_lat = [
+            (r.t_done - r.t_first_token) / (len(r.generated) - 1)
+            for r in completed
+            if len(r.generated) > 1 and r.t_first_token is not None]
+        out = {
+            "policy": "fleet",
+            "n_replicas": len(self.replicas),
+            "n_slots": self.serve.n_slots,
+            "live_replicas": len(self._live()),
+            "replicas": {r.name: {"state": r.state,
+                                  "devices": list(r.device_ids),
+                                  "kills": r.kills}
+                         for r in self.replicas},
+            "requests_completed": len(completed),
+            "requests_failed": len(failed),
+            "requests_migrated": sum(1 for r in self._requests
+                                     if r.migrations > 0),
+            "migrations": self._migrations,
+            "replica_kills": self._kills,
+            "tokens_generated": tokens,
+            "wall_s": self._wall_s,
+            "tokens_per_s": (tokens / self._wall_s if self._wall_s > 0
+                             else None),
+            "rounds": self._rounds,
+            "router": {"assignments": dict(self.router.assignments),
+                       "affinity_hits": self.router.affinity_hits},
+            "ttft_s": summarize(ttft),
+            "queue_wait_s": summarize(waits),
+            "token_latency_s": summarize(token_lat),
+        }
+        if record and self.telemetry is not None:
+            self.telemetry.record("serve", event="summary", **out)
+        return out

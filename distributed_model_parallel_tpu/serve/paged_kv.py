@@ -23,6 +23,16 @@ runs — and their telemetry — reproducible. Pages are **not** cleared on
 free: the attention read path masks past-length positions to exact 0.0
 (ops/paged_attention.attend_rows), so stale contents are unreachable by
 construction rather than by memset.
+
+Pages are also the **migration unit** (serve/fleet.py): a live sequence
+leaves one replica and resumes on another by copying its written pages'
+contents — :meth:`PagedKVCache.export_request` serializes the K/V
+contents of a sequence's written prefix to host arrays, and
+:meth:`PagedKVCache.import_request` allocates **fresh** pages on the
+destination pool and writes those contents back. The payload is pure
+values, never page ids, so a migrated sequence carries no references
+into the source replica's pool or radix tree — the source can drop
+everything (and be quarantined) the moment the export returns.
 """
 
 from __future__ import annotations
@@ -301,6 +311,82 @@ class PagedKVCache:
     @property
     def shared_pages(self) -> int:
         return self.pool.shared_pages
+
+    # -- live request migration (serve/fleet.py) -----------------------------
+
+    def export_request(self, sid, n_tokens: int):
+        """Serialize the K/V **contents** of ``sid``'s first ``n_tokens``
+        written positions to host arrays ``(k, v)`` of shape
+        ``[L, pages, page_size, Hkv, Dh]`` — whole pages, values only.
+        Shared prefix pages are exported by value like any other, so the
+        payload holds no reference to this pool (the destination
+        allocates fresh pages; see :meth:`import_request`). The caller
+        guarantees every exported position's KV is actually written —
+        the engine's drain hook passes the committed-and-written prefix
+        (serve/engine.py ``drain``)."""
+        table = self._tables[sid]
+        n = self.pages_needed(n_tokens)
+        if n > len(table):
+            raise PagePoolError(
+                f"sequence {sid!r}: exporting {n_tokens} tokens spans "
+                f"{n} pages but the table holds {len(table)}")
+        idx = np.asarray(table[:n], np.int32)
+        # One host fetch per pool: [L, n, page, Hkv, Dh].
+        k = np.asarray(self.ck[:, idx]) if n else np.zeros(
+            (self.cfg.n_layers, 0, self.page_size, self.ck.shape[3],
+             self.ck.shape[4]), self.ck.dtype)
+        v = np.asarray(self.cv[:, idx]) if n else np.zeros_like(k)
+        return k, v
+
+    def import_request(self, sid, k, v, capacity: int) -> bool:
+        """Admit a migrated sequence: reserve ``capacity`` positions of
+        **fresh** pages (evicting tree-only pages if the room is needed
+        — the exported KV is authoritative, so nothing is shared on
+        arrival) and write the exported page contents into them. Returns
+        ``False`` without side effects when the reservation does not
+        fit — the scheduler keeps the request queued, exactly like a
+        cold admission that finds no pages."""
+        need = self.pages_needed(capacity)
+        avail = self.pool.free_pages
+        if self.prefix is not None:
+            avail += self.prefix.evictable_pages()
+        if need > avail:
+            return False
+        n = int(k.shape[1])
+        if n > need:
+            raise PagePoolError(
+                f"sequence {sid!r}: payload carries {n} pages but the "
+                f"reservation is only {need}")
+        self.open(sid)
+        short = need - self.pool.free_pages
+        if short > 0:
+            self.prefix.evict(short)
+        self.ensure(sid, capacity)
+        if n:
+            idx = jnp.asarray(self._tables[sid][:n], jnp.int32)
+            self.ck = self.ck.at[:, idx].set(
+                jnp.asarray(k).astype(self.ck.dtype))
+            self.cv = self.cv.at[:, idx].set(
+                jnp.asarray(v).astype(self.cv.dtype))
+        return True
+
+    def cached_prefix_tokens(self, tokens: list[int]) -> int:
+        """Usable cached-prefix length for ``tokens`` (quantized to the
+        share granularity, side-effect free) — the router's
+        prefix-affinity signal (serve/router.py). 0 without a cache."""
+        if self.prefix is None:
+            return 0
+        pages = self.prefix.match(tokens, touch=False)
+        return self._usable_prefix(tokens, len(pages))
+
+    def drop_prefix(self) -> int:
+        """Evict the ENTIRE radix tree (a replica being quarantined must
+        return every page it holds). Pages still referenced by a
+        resident sequence survive its tree reference dropping — callers
+        drain sequences first. Returns pages freed."""
+        if self.prefix is None:
+            return 0
+        return len(self.prefix.evict(len(self.prefix)))
 
 
 def share_granularity_for(page_size: int, prefill_chunk: int) -> int:

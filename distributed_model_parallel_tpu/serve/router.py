@@ -1,0 +1,108 @@
+"""SLO-aware request router for a multi-replica serving fleet.
+
+One fleet-level admission decision per request: which replica gets it.
+The policy is **power-of-two-choices** over live load — sample two
+distinct live replicas (seeded rng, deterministic) and take the less
+loaded — with a **prefix-affinity** override: when some replica's radix
+tree already holds a usable prefix of the prompt (PR 13's prefix cache
+is per-replica), sending the request there converts prefill work into a
+page-table share, so affinity wins unless that replica is materially
+busier than the least-loaded one (``affinity_slack``).
+
+Load is the signal the SLOs actually feel: queued requests + resident
+requests + page-pool occupancy (the fraction term breaks ties between
+otherwise-equal replicas toward the emptier pool). Power-of-two-choices
+gives near-best-of-all balancing at O(1) cost and — unlike
+least-loaded-of-all — does not herd every burst onto one replica between
+load refreshes (the classic Mitzenmacher result).
+
+Determinism: the rng is seeded, sampling order is submission order, and
+load is pure bookkeeping — the same trace through the same fleet yields
+the same assignment sequence (tests/test_fleet.py pins it). Migration
+re-admissions bypass p2c and go least-loaded: a drain dumps a burst of
+requests at once, and spreading them by load is the point.
+"""
+
+from __future__ import annotations
+
+import random
+
+__all__ = ["Router"]
+
+
+class Router:
+    """Deterministic SLO-aware replica picker (see module docstring).
+
+    The fleet (serve/fleet.py) owns replica lifecycle; the router is
+    pure policy — it reads live queue/slot/page state off the candidate
+    engines at decision time and keeps only its own assignment
+    bookkeeping.
+    """
+
+    def __init__(self, seed: int = 0, *, affinity_slack: float = 2.0,
+                 affinity_min_tokens: int = 1):
+        if affinity_slack < 0:
+            raise ValueError(f"affinity_slack must be >= 0, got "
+                             f"{affinity_slack}")
+        self._rng = random.Random(seed)
+        self.affinity_slack = affinity_slack
+        self.affinity_min_tokens = affinity_min_tokens
+        # name -> requests routed there (statusz + the fleet summary)
+        self.assignments: dict[str, int] = {}
+        self.affinity_hits = 0
+
+    @staticmethod
+    def load(replica) -> float:
+        """A replica's live load: queued + resident requests, plus page
+        occupancy as the fractional tie-break toward the emptier pool."""
+        eng = replica.engine
+        return (len(eng.sched.queue)
+                + sum(1 for s in eng.sched.slots if s is not None)
+                + eng.cache.occupancy)
+
+    def pick(self, prompt: list[int], replicas: list, *,
+             migrate: bool = False) -> tuple[object, str, dict]:
+        """Choose a live replica for ``prompt``. Returns ``(replica,
+        reason, loads)`` where reason is ``affinity`` (prefix-cache
+        match won), ``p2c`` (power-of-two-choices), ``only`` (one
+        candidate), or ``migrate`` (least-loaded drain placement).
+        ``loads`` maps replica name -> load at decision time (the typed
+        ``router`` record's payload)."""
+        if not replicas:
+            raise ValueError("no live replica to route to")
+        loads = {r.name: self.load(r) for r in replicas}
+        if len(replicas) == 1:
+            chosen, reason = replicas[0], "only"
+        elif migrate:
+            # Drain placement: the exported KV rides with the request
+            # (no prefix to exploit), and a whole replica's worth of
+            # requests arrives at once — spread strictly by load.
+            chosen = min(replicas, key=lambda r: (loads[r.name], r.name))
+            reason = "migrate"
+        else:
+            chosen, reason = self._pick_new(prompt, replicas, loads)
+        self.assignments[chosen.name] = (
+            self.assignments.get(chosen.name, 0) + 1)
+        if reason == "affinity":
+            self.affinity_hits += 1
+        return chosen, reason, loads
+
+    def _pick_new(self, prompt, replicas, loads):
+        best_aff, aff_rep = 0, None
+        for r in replicas:
+            cached = r.engine.cache.cached_prefix_tokens(prompt)
+            if cached > best_aff:
+                best_aff, aff_rep = cached, r
+        min_load = min(loads.values())
+        if (aff_rep is not None and best_aff >= self.affinity_min_tokens
+                and loads[aff_rep.name] <= min_load + self.affinity_slack):
+            return aff_rep, "affinity"
+        # Power-of-two-choices: two distinct seeded samples, less loaded
+        # wins. Exact ties go to the FIRST sampled — the sample order is
+        # itself seeded-random, so idle replicas share ties instead of
+        # herding onto a fixed favorite (a (load, name) tie-break would
+        # send a lightly-loaded fleet's whole trace to one replica).
+        a, b = self._rng.sample(range(len(replicas)), 2)
+        ra, rb = replicas[a], replicas[b]
+        chosen = ra if loads[ra.name] <= loads[rb.name] else rb
+        return chosen, "p2c"

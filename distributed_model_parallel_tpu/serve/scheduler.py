@@ -74,6 +74,13 @@ class Request:                     # objects in slots/queues, not values
     t_admitted: float | None = None
     t_first_token: float | None = None
     t_done: float | None = None
+    # Live migration (serve/fleet.py): a drained request carries its
+    # exported KV page contents here until the destination replica
+    # admits it — admission then runs ``PagedKVCache.import_request``
+    # instead of a cold allocation and the engine resumes the request
+    # at its exact committed position.
+    resume: dict | None = None
+    migrations: int = 0              # times this request moved replicas
 
     @property
     def prompt_len(self) -> int:
@@ -87,6 +94,28 @@ class Request:                     # objects in slots/queues, not values
     @property
     def done(self) -> bool:
         return self.state in (RequestState.COMPLETED, RequestState.FAILED)
+
+
+def validate_request(req: Request, cache) -> None:
+    """Shape/feasibility checks shared by per-engine submission and the
+    fleet's router-time admission (serve/fleet.py) — every replica runs
+    the same geometry, so one cache's limits speak for the fleet."""
+    if req.prompt_len < 1:
+        raise ValueError(f"request {req.rid!r}: empty prompt")
+    if req.max_new_tokens < 1:
+        raise ValueError(f"request {req.rid!r}: max_new_tokens must "
+                         f"be >= 1, got {req.max_new_tokens}")
+    if req.total_capacity > cache.max_seq_len:
+        raise ValueError(
+            f"request {req.rid!r}: prompt ({req.prompt_len}) + "
+            f"max_new_tokens ({req.max_new_tokens}) exceeds the "
+            f"engine's max_seq_len {cache.max_seq_len}")
+    if cache.pages_needed(req.total_capacity) > cache.pool.n_pages:
+        raise ValueError(
+            f"request {req.rid!r} needs "
+            f"{cache.pages_needed(req.total_capacity)} pages but "
+            f"the whole pool holds {cache.pool.n_pages}; it can "
+            f"never be admitted")
 
 
 class Scheduler:
@@ -120,23 +149,7 @@ class Scheduler:
     def submit(self, req: Request) -> None:
         if req.rid in self._ids:
             raise ValueError(f"duplicate request id {req.rid!r}")
-        if req.prompt_len < 1:
-            raise ValueError(f"request {req.rid!r}: empty prompt")
-        if req.max_new_tokens < 1:
-            raise ValueError(f"request {req.rid!r}: max_new_tokens must "
-                             f"be >= 1, got {req.max_new_tokens}")
-        if req.total_capacity > self.cache.max_seq_len:
-            raise ValueError(
-                f"request {req.rid!r}: prompt ({req.prompt_len}) + "
-                f"max_new_tokens ({req.max_new_tokens}) exceeds the "
-                f"engine's max_seq_len {self.cache.max_seq_len}")
-        if self.cache.pages_needed(req.total_capacity) > \
-                self.cache.pool.n_pages:
-            raise ValueError(
-                f"request {req.rid!r} needs "
-                f"{self.cache.pages_needed(req.total_capacity)} pages but "
-                f"the whole pool holds {self.cache.pool.n_pages}; it can "
-                f"never be admitted")
+        validate_request(req, self.cache)
         self._ids.add(req.rid)
         self.queue.append(req)
 
@@ -167,23 +180,42 @@ class Scheduler:
             if not self.queue or self.queue[0].arrival_s > now:
                 break
             req = self.queue[0]
-            # One-pass fit check + admission (try_admit peeks the
-            # POST-SHARING bill — a cached prefix's pages are retained,
-            # not allocated, and tree-only pages count as reclaimable —
-            # and only when it fits performs the reservation; no second
-            # radix match / evictable walk on the hot path). A cold
-            # request on a warm pool queues exactly when its full
-            # reservation exceeds free + evictable
-            # (tests/test_prefix_cache.py pins the regression).
-            got = self.cache.try_admit(req.rid, req.prompt,
-                                       req.total_capacity)
-            if got is None:
-                break                      # head-of-line: wait for pages
+            if req.resume is not None:
+                # A migrated-in request: its exported KV is
+                # authoritative, so the reservation is all fresh pages
+                # (no prefix sharing on arrival) with the payload's page
+                # contents written back in — same backpressure contract
+                # as a cold admission (False -> keep queuing, no side
+                # effects).
+                if not self.cache.import_request(
+                        req.rid, req.resume["k"], req.resume["v"],
+                        req.total_capacity):
+                    break                  # head-of-line: wait for pages
+            else:
+                # One-pass fit check + admission (try_admit peeks the
+                # POST-SHARING bill — a cached prefix's pages are
+                # retained, not allocated, and tree-only pages count as
+                # reclaimable — and only when it fits performs the
+                # reservation; no second radix match / evictable walk on
+                # the hot path). A cold request on a warm pool queues
+                # exactly when its full reservation exceeds free +
+                # evictable (tests/test_prefix_cache.py pins the
+                # regression).
+                got = self.cache.try_admit(req.rid, req.prompt,
+                                           req.total_capacity)
+                if got is None:
+                    break                  # head-of-line: wait for pages
+                req.cached_prompt_tokens = got
             self.queue.popleft()
-            req.cached_prompt_tokens = got
             req.slot = slot
             req.state = RequestState.PREFILL
-            req.t_admitted = now
+            if req.t_admitted is None:
+                # First admission only: a migrated request keeps its
+                # original admission stamp — queue-wait and the
+                # pre/post-kill TTFT split in BENCH_serve fleet mode
+                # both mean "when did this request first get a slot",
+                # not "when did it land on its latest replica".
+                req.t_admitted = now
             self.slots[slot] = req
             admitted.append(req)
         if admitted and trace:
@@ -216,6 +248,21 @@ class Scheduler:
         self.cache.release(req.rid)
         self.slots[req.slot] = None
         req.slot = None
+
+    def withdraw(self, req: Request) -> None:
+        """Remove a LIVE request from this scheduler entirely (the drain
+        half of migration, serve/fleet.py): a resident request gives up
+        its slot and pages, a queued one leaves the queue, and the rid
+        leaves the id set — the request will be resubmitted to a peer
+        replica's scheduler, and may even return here after a
+        quarantine/reinstate cycle."""
+        if req.slot is not None:
+            self.evict(req)
+        else:
+            if not any(q is req for q in self.queue):
+                raise ValueError(f"request {req.rid!r} is not queued here")
+            self.queue = deque(q for q in self.queue if q is not req)
+        self._ids.discard(req.rid)
 
     def pending(self, now: float | None = None) -> int:
         """Queued requests (optionally only those already arrived)."""

@@ -73,6 +73,16 @@ GATE_METRICS: dict[str, bool] = {
     # faster machine — gate the ratios directly.
     "cache_hit_rate": True,
     "draft_accept_rate": True,
+    # Fleet-mode serve drill (BENCH_serve with DMP_BENCH_SERVE_FLEET):
+    # the headline value is fleet tokens/s/chip (-> throughput above);
+    # these cover the self-healing half. post_kill_ttft_p99_s is the
+    # admission latency after a replica kill — the number the whole
+    # migration machinery exists to hold down. migrations gates
+    # higher-better: a drop below the band means the drill stopped
+    # actually migrating (requests restarting from scratch, or the kill
+    # not landing mid-stream anymore).
+    "post_kill_ttft_p99_s": False,
+    "migrations": True,
 }
 
 DEFAULT_K = 3.0
@@ -160,7 +170,9 @@ def ingest_artifact(path: str) -> list[dict]:
     for src, dst in (("mfu", "mfu"), ("ttft_p99_s", "ttft_p99_s"),
                      ("token_latency_p99_s", "token_latency_p99_s"),
                      ("cache_hit_rate", "cache_hit_rate"),
-                     ("draft_accept_rate", "draft_accept_rate")):
+                     ("draft_accept_rate", "draft_accept_rate"),
+                     ("post_kill_ttft_p99_s", "post_kill_ttft_p99_s"),
+                     ("migrations", "migrations")):
         v = parsed.get(src)
         if isinstance(v, (int, float)):
             metrics[dst] = float(v)
@@ -248,7 +260,8 @@ def extract_points(records: list[dict]) -> list[dict]:
             continue
         metrics: dict[str, float] = {"throughput": float(b["value"])}
         for k in ("mfu", "ttft_p99_s", "token_latency_p99_s",
-                  "cache_hit_rate", "draft_accept_rate"):
+                  "cache_hit_rate", "draft_accept_rate",
+                  "post_kill_ttft_p99_s", "migrations"):
             if isinstance(b.get(k), (int, float)):
                 metrics[k] = float(b[k])
         if step_p50 is not None:

@@ -19,6 +19,9 @@ Signals (all host-side wall clock, fed by the trainers and supervisors):
   train/consistency.py);
 * checkpoint I/O latency from the supervisor's good-slot saves
   (``observe_io``, train/resilience.py);
+* serving-replica engine-iteration wall time (``observe_serve``,
+  serve/fleet.py — the serving fleet is a tenant of this sentinel too:
+  a quarantined replica's requests migrate live to its peers);
 * watchdog stall escalations (``observe_stall`` — a hard penalty, no
   baseline needed).
 
@@ -59,6 +62,7 @@ __all__ = [
     "installed",
     "observe_fetch",
     "observe_io",
+    "observe_serve",
     "observe_stall",
     "observe_step",
     "observe_step_warmed",
@@ -339,6 +343,16 @@ def observe_step_warmed(trainer, device_ids: Iterable[int],
         trainer._health_warmed = True
         return
     observe_step(device_ids, per_step_s, n)
+
+
+def observe_serve(device_ids: Iterable[int], seconds: float) -> None:
+    """One serving replica's engine-iteration wall time on its device
+    slice (serve/fleet.py) — the signal that lets the sentinel
+    quarantine a degrading replica and trigger live request migration.
+    Fed per fleet round; a fleet constructed with its own monitor feeds
+    that directly instead."""
+    if _monitor is not None:
+        _monitor.observe("serve", device_ids, seconds)
 
 
 def observe_sync(device_ids: Iterable[int], seconds: float) -> None:

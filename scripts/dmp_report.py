@@ -325,6 +325,53 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
                      f"({str(r.get('detail', ''))[:80]})")
 
 
+def _fleet_serving_section(lines: list[str], by_kind: dict) -> None:
+    """Multi-replica fleet serving (serve/fleet.py): router assignment
+    counts from the typed ``router`` records, live migrations from the
+    ``migration`` records, and the fleet summary's replica table — the
+    post-mortem view of a replica-kill drill."""
+    routed = by_kind.get("router") or []
+    migs = by_kind.get("migration") or []
+    fleet_sums = [r for r in by_kind.get("serve") or []
+                  if r.get("event") == "summary"
+                  and r.get("policy") == "fleet"]
+    if not routed and not migs and not fleet_sums:
+        return
+    lines.append(f"== fleet serving ({len(routed)} routed, "
+                 f"{len(migs)} migrated) ==")
+    per: dict[str, int] = {}
+    reasons: dict[str, int] = {}
+    for r in routed:
+        per[str(r.get("replica"))] = per.get(str(r.get("replica")), 0) + 1
+        reasons[str(r.get("reason"))] = (
+            reasons.get(str(r.get("reason")), 0) + 1)
+    if per:
+        lines.append("router: " + "  ".join(
+            f"{name}={n}" for name, n in sorted(per.items()))
+            + "   (" + ", ".join(f"{k} {v}"
+                                 for k, v in sorted(reasons.items())) + ")")
+    shown = migs[:12]
+    for m in shown:
+        lines.append(
+            f"  migrated {m.get('request')}: {m.get('from_replica')} -> "
+            f"{m.get('to_replica')} at {m.get('tokens_committed')} "
+            f"committed tokens ({m.get('state')}, {m.get('pages')} pages, "
+            f"round {m.get('round')})")
+    if len(migs) > len(shown):
+        lines.append(f"  ... and {len(migs) - len(shown)} more migrations")
+    for s in fleet_sums:
+        reps = s.get("replicas") or {}
+        states = "  ".join(
+            f"{name}={info.get('state')}"
+            + (f"(x{info.get('kills')} kills)" if info.get("kills") else "")
+            for name, info in sorted(reps.items()))
+        lines.append(
+            f"fleet: {s.get('live_replicas')}/{s.get('n_replicas')} "
+            f"replicas live, {s.get('requests_migrated', 0)} requests "
+            f"migrated over {s.get('migrations', 0)} moves, "
+            f"{s.get('replica_kills', 0)} kills   {states}")
+
+
 def _plan_section(lines: list[str], by_kind: dict) -> None:
     """Parallelism-plan records (autotune/planner.emit_plan_record): which
     layout the autotuner chose, at which global step, and the nearest
@@ -602,6 +649,7 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     _mfu_section(lines, meta, device, by_kind, times)
     _phase_section(lines, by_kind)
     _serving_section(lines, by_kind)
+    _fleet_serving_section(lines, by_kind)
     _plan_section(lines, by_kind)
     _spans_section(lines, by_kind)
     _gate_section(lines, by_kind)

@@ -52,6 +52,10 @@ class FleetState:
         self.postmortems: list[str] = []
         self.statusz: dict | None = None
         self.last_ts: float = 0.0
+        # Fleet serving (serve/fleet.py): live request migrations and
+        # router assignment counts folded off the typed records.
+        self.migrations: int = 0
+        self.router_assignments: dict[str, int] = {}
         # Untenanted streams (a plain trainer run) attribute their
         # records to the last run_start's run name.
         self._default_run = ""
@@ -121,6 +125,12 @@ class FleetState:
                 self.firing.pop(key, None)
         elif kind == "postmortem":
             self.postmortems.append(str(rec.get("bundle")))
+        elif kind == "migration":
+            self.migrations += 1
+        elif kind == "router":
+            rep = str(rec.get("replica"))
+            self.router_assignments[rep] = (
+                self.router_assignments.get(rep, 0) + 1)
 
     def _refresh_mfu(self, t: dict) -> None:
         """MFU from stream data alone: FLOPs/step / n_devices /
@@ -185,12 +195,43 @@ class FleetState:
                            f"threshold={rec.get('threshold')}")
         for p in self.postmortems[-3:]:
             lines.append(f"POSTMORTEM  {p}")
+        if self.migrations or self.router_assignments:
+            lines.append(
+                f"fleet serving  migrations={self.migrations}  router="
+                + (" ".join(f"{k}:{v}" for k, v in
+                            sorted(self.router_assignments.items()))
+                   or "-"))
         if self.statusz is not None:
             if "error" in self.statusz:
                 lines.append(f"statusz: {self.statusz['error']}")
             else:
                 for name, prov in sorted(
                         (self.statusz.get("providers") or {}).items()):
+                    if prov.get("workload") == "serve-fleet":
+                        # The fleet provider: one header plus a row per
+                        # replica (state, queue depth, page occupancy,
+                        # router assignment counts).
+                        lines.append(
+                            f"fleet[{name}]  "
+                            f"{len(prov.get('live') or [])}"
+                            f"/{prov.get('n_replicas')} live"
+                            f"  pending={prov.get('pending')}"
+                            f"  migrations={prov.get('migrations')}"
+                            f"  kills={prov.get('replica_kills')}")
+                        for rname, rep in sorted(
+                                (prov.get("replicas") or {}).items()):
+                            occ = rep.get("page_occupancy")
+                            lines.append(
+                                f"  replica {rname}  "
+                                f"{str(rep.get('state')):<12}"
+                                f"queue={rep.get('queue_depth')}"
+                                f"  active={rep.get('active_requests')}"
+                                + (f"  pages={occ:.2f}"
+                                   if isinstance(occ, (int, float))
+                                   else "")
+                                + f"  routed={rep.get('assignments')}"
+                                + f"  devices={rep.get('devices')}")
+                        continue
                     if prov.get("workload") == "serve":
                         line = (
                             f"serve[{name}]  queue={prov.get('queue_depth')}"
