@@ -1059,6 +1059,200 @@ def bench_serve_fleet() -> None:
     _enforce_gate(gate)
 
 
+def bench_serve_overload() -> None:
+    """Overload-protection bench (``DMP_BENCH_SERVE_TRACE=overload``;
+    docs/SERVING.md "Overload and graceful degradation").
+
+    Phase A replays the seeded request population closed-loop through a
+    plain engine — the clean **capacity** and every request's reference
+    tokens. Phase B replays it open-loop at ``OVERLOAD_FACTOR`` × that
+    capacity (default 2x, plus a 0.3x cool-down tail the brownout
+    resolves against) through an engine with the whole overload plane
+    armed: queue-wait budgets + total deadlines, a bounded submission
+    queue, and the brownout ladder. Headline: **goodput tokens/s/chip**
+    — tokens of requests completed within deadline over the saturated
+    window — plus ``shed_fraction``; both gate in the baseline ledger
+    (utils/baseline.GATE_METRICS).
+
+    Asserted every run (RuntimeError on violation): every non-completed
+    request carries a typed shed record, the live queue stays bounded
+    every iteration, brownout fires and resolves, and every completed
+    request's tokens are bitwise the capacity run's (level-3-clamped
+    requests: its prefix). The goodput band
+    (``DMP_BENCH_SERVE_GOODPUT_BAND``, default 0.8 of capacity) exits
+    nonzero AFTER the headline JSON prints, like the fleet drill's TTFT
+    gate.
+    """
+    from distributed_model_parallel_tpu.config import MeshConfig
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import Engine, ServeConfig
+    from distributed_model_parallel_tpu.serve.scheduler import RequestState
+
+    trace, cfg = build_serve_trace()
+    rng = np.random.default_rng(
+        int(os.environ.get("DMP_BENCH_SERVE_SEED", "0")) + 1)
+    factor = float(os.environ.get("DMP_BENCH_SERVE_OVERLOAD_FACTOR", "2.0"))
+    band = float(os.environ.get("DMP_BENCH_SERVE_GOODPUT_BAND", "0.8"))
+    n_chips = len(jax.devices())
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots = int(os.environ.get("DMP_BENCH_SERVE_SLOTS", "8"))
+    page = int(os.environ.get("DMP_BENCH_SERVE_PAGE", "16"))
+    pages_per_seq = -(-cfg.max_seq_len // page)
+    base = dict(
+        n_slots=n_slots, page_size=page,
+        n_pages=(n_slots + 1) * pages_per_seq,
+        max_seq_len=cfg.max_seq_len,
+        prefill_chunk=int(os.environ.get("DMP_BENCH_SERVE_CHUNK", "32")))
+    telemetry = _telemetry_run("serve", dict(
+        trace="overload", n_requests=len(trace), n_slots=n_slots,
+        page_size=page, overload_factor=factor,
+        d_model=cfg.d_model, n_layers=cfg.n_layers))
+    Engine(params, cfg, ServeConfig(**base), slo_metrics=False).warmup()
+    _log("serve-overload: programs warmed (compile excluded)")
+
+    # -- phase A: clean capacity, closed loop, nothing sheds
+    cap_eng = Engine(params, cfg, ServeConfig(**base), telemetry=telemetry)
+    for i, r in enumerate(trace):
+        cap_eng.submit(r["prompt"], r["max_new_tokens"], rid=f"o{i}",
+                       seed=r["seed"])
+    cap = cap_eng.run()
+    capacity = cap["tokens_per_s"] or 0.0
+    wall_a = max(cap["wall_s"], 1e-3)
+    reference = {q.rid: list(q.generated) for q in cap_eng.results()}
+    _log(f"serve-overload[capacity]: {cap['tokens_generated']} tokens at "
+         f"{capacity:.1f} tok/s")
+
+    # -- phase B: the same population at factor x capacity + cool-down
+    n_over = max(1, int(len(trace) * 0.75))
+    mean_tokens = sum(len(v) for v in reference.values()) / len(reference)
+    t, arrivals = 0.0, []
+    for i in range(len(trace)):
+        rate = ((factor if i < n_over else 0.3) * capacity / mean_tokens
+                if capacity else 1.0)
+        t += float(rng.exponential(1.0 / rate))
+        arrivals.append(t)
+    # Budgets scale with the measured capacity wall so the drill is
+    # machine-speed-independent; the absolute floors only need to clear
+    # scheduler-granularity jitter (~ms), so a short CPU smoke trace
+    # still genuinely overloads.
+    serve = ServeConfig(
+        **base,
+        queue_budget_s=float(os.environ.get(
+            "DMP_BENCH_SERVE_QUEUE_BUDGET_S", max(0.15 * wall_a, 0.05))),
+        deadline_s=float(os.environ.get(
+            "DMP_BENCH_SERVE_DEADLINE_S", max(1.2 * wall_a, 0.4))),
+        max_queue=int(os.environ.get("DMP_BENCH_SERVE_MAX_QUEUE",
+                                     2 * n_slots)),
+        brownout=True,
+        brownout_ttft_target_s=max(0.08 * wall_a, 0.02),
+        brownout_budget=0.25,
+        brownout_window_s=max(0.10 * wall_a, 0.06),
+        brownout_max_new=max(8, int(mean_tokens / 2)),
+        brownout_hold_iters=4)
+    eng = Engine(params, cfg, serve, telemetry=telemetry)
+    queue_bounded = True
+
+    def hook(_it):
+        # eng._now still holds the PREVIOUS iteration's clock here, and
+        # that iteration's overflow trim ran at exactly that clock — so
+        # the arrived backlog it reports must already be within bound.
+        nonlocal queue_bounded
+        if eng.sched.arrived_backlog(eng._now) > serve.max_queue:
+            queue_bounded = False
+
+    eng.step_hook = hook
+    for i, (r, arr) in enumerate(zip(trace, arrivals)):
+        eng.submit(r["prompt"], r["max_new_tokens"], rid=f"o{i}",
+                   seed=r["seed"], arrival_s=arr,
+                   priority="batch" if i % 3 == 2 else "interactive")
+    over = eng.run()
+    results = {q.rid: q for q in eng.results()}
+    phase1 = [results[f"o{i}"] for i in range(n_over)]
+    t_end = max((q.t_done for q in phase1 if q.t_done is not None),
+                default=None)
+    completed = [q for q in results.values()
+                 if q.state is RequestState.COMPLETED]
+    goodput = (sum(len(q.generated) for q in completed
+                   if eng._in_deadline(q) and q.t_done is not None
+                   and q.t_done <= t_end) / t_end if t_end else 0.0)
+    _log(f"serve-overload[{factor:g}x]: {over['tokens_generated']} tokens, "
+         f"goodput {goodput:.1f} tok/s "
+         f"({goodput / capacity if capacity else 0:.2f}x capacity), "
+         f"shed {over['requests_shed']}, brownout {over['brownout']}")
+    # Hard invariants — a violation is a broken engine, not a slow one.
+    unaccounted = [q.rid for q in results.values()
+                   if q.state is not RequestState.COMPLETED
+                   and q.shed_reason is None]
+    if unaccounted or over["requests_failed"]:
+        raise RuntimeError(
+            f"overload run lost requests without typed shed records: "
+            f"unaccounted {unaccounted}, failed {over['requests_failed']}")
+    if not queue_bounded:
+        raise RuntimeError("live queue exceeded its bound mid-run — the "
+                           "per-iteration overflow trim is broken")
+    for q in completed:
+        ref = reference[q.rid]
+        ok = (q.generated == ref[:len(q.generated)]
+              if q.max_new_requested is not None else q.generated == ref)
+        if not ok:
+            raise RuntimeError(
+                f"request {q.rid} decoded different tokens under "
+                f"overload — degradation must never change tokens")
+    bo = over["brownout"] or {}
+    if not bo.get("max_level_seen"):
+        raise RuntimeError("brownout never fired under "
+                           f"{factor:g}x overload — the ladder is dead "
+                           f"or the drill is not actually overloading")
+    if bo.get("level"):
+        raise RuntimeError(f"brownout did not resolve after the load "
+                           f"dropped (final level {bo['level']})")
+    goodput_chip = goodput / n_chips
+    # requests_rejected (queue-full) is a SUBSET of requests_shed —
+    # every typed shed, deadline or bound, counts exactly once here.
+    shed_fraction = over["requests_shed"] / len(trace)
+    out = {
+        "metric": (f"lm_serve_overload_bs{n_slots}"
+                   f"_goodput_tokens_per_sec_per_chip"),
+        "value": round(goodput_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,   # the reference repo has no serving path
+        "mfu": None,
+        "goodput_tokens_per_s": round(goodput_chip, 1),
+        "capacity_tokens_per_s_per_chip": round(capacity / n_chips, 1),
+        "goodput_fraction_of_capacity": (round(goodput / capacity, 3)
+                                         if capacity else None),
+        "goodput_band": band,
+        "overload_factor": factor,
+        "requests": len(trace),
+        "requests_completed": over["requests_completed"],
+        "requests_shed": over["requests_shed"],
+        "requests_rejected": over["requests_rejected"],
+        "shed_by_reason": over["shed_by_reason"],
+        "shed_fraction": round(shed_fraction, 4),
+        "brownout_max_level": bo.get("max_level_seen"),
+        "brownout_transitions": bo.get("transitions"),
+        "queue_budget_s": serve.queue_budget_s,
+        "deadline_s": serve.deadline_s,
+        "max_queue": serve.max_queue,
+        "tokens_identical_to_capacity_run": True,
+        "ttft_p99_s": round(over["ttft_s"].get("p99", 0), 4),
+        "token_latency_p99_s": round(
+            over["token_latency_s"].get("p99", 0), 5),
+        "plan": plan_payload(MeshConfig(), "serve"),
+    }
+    telemetry.memory()
+    telemetry.record("bench", **out)
+    gate = _maybe_gate(telemetry)
+    telemetry.finish()
+    print(json.dumps(out))
+    if capacity and goodput < band * capacity:
+        raise SystemExit(
+            f"goodput {goodput:.1f} tok/s under {factor:g}x overload is "
+            f"below {band:.0%} of clean capacity {capacity:.1f} tok/s — "
+            f"the overload plane is not holding throughput at saturation")
+    _enforce_gate(gate)
+
+
 def build_cnn_bench(model_name: str, batch: int, steps_per_dispatch: int,
                     image_size: int = 32):
     """The headline CNN workload: a device-resident Trainer plus a
@@ -1272,6 +1466,8 @@ def _run_workload() -> None:
             bench_serve_fleet()
         elif os.environ.get("DMP_BENCH_SERVE_TRACE") == "chat":
             bench_serve_chat()
+        elif os.environ.get("DMP_BENCH_SERVE_TRACE") == "overload":
+            bench_serve_overload()
         else:
             bench_serve()
         return

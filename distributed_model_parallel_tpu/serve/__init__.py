@@ -47,6 +47,10 @@ from distributed_model_parallel_tpu.serve.fleet import (  # noqa: F401
     Replica,
     ServeFleet,
 )
+from distributed_model_parallel_tpu.serve.overload import (  # noqa: F401
+    BrownoutController,
+    CircuitBreaker,
+)
 from distributed_model_parallel_tpu.serve.router import (  # noqa: F401
     Router,
 )

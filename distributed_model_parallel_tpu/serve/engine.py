@@ -92,6 +92,32 @@ class ServeConfig:
     top_k: int | None = None
     top_p: float | None = None
     eos_id: int | None = None
+    # -- overload protection (docs/SERVING.md "Overload and graceful
+    # degradation"). Defaults are per-request-overridable on Request.
+    # A queued request older than queue_budget_s sheds typed (reason
+    # queue-deadline); one past deadline_s sheds (queued) or aborts
+    # (in-flight, pages returned immediately) with reason
+    # total-deadline. None = no budget (the PR 9 behavior).
+    queue_budget_s: float | None = None
+    deadline_s: float | None = None
+    # Submission-queue bound: beyond it, submission is REJECTED with a
+    # typed record (reason queue-full) instead of growing an unbounded
+    # host-side queue. The fleet bounds its own pending list at
+    # max_queue * n_replicas. None = unbounded (PR 9 behavior).
+    max_queue: int | None = None
+    # Brownout: the deterministic degradation ladder (serve/overload.py)
+    # driven by a TTFT burn-rate rule and a page-occupancy ceiling —
+    # spec-off -> prefill-share -> clamp-max-new, walked back on
+    # resolution. Degradation never changes a completed request's
+    # tokens (a clamped request's stream is the bitwise prefix of its
+    # unclamped one).
+    brownout: bool = False
+    brownout_ttft_target_s: float = 1.0   # SLO target feeding the burn rule
+    brownout_budget: float = 0.25         # tolerated violation fraction
+    brownout_window_s: float = 10.0       # short burn window (long = 4x)
+    brownout_occupancy_ceiling: float = 0.95
+    brownout_max_new: int = 32            # level-3 cap on admissions' max_new
+    brownout_hold_iters: int = 8          # min ticks between level moves
     # Live status exporter (utils/statusz.py): queue depth, page
     # occupancy and slot state under /statusz, SLO histograms under
     # /metrics. Same one-exporter-per-process semantics as
@@ -161,7 +187,22 @@ class Engine:
         self.sched = Scheduler(self.cache, serve.n_slots,
                                policy=serve.policy,
                                prefill_chunks_per_iter=(
-                                   serve.prefill_chunks_per_iter))
+                                   serve.prefill_chunks_per_iter),
+                               queue_budget_s=serve.queue_budget_s,
+                               deadline_s=serve.deadline_s,
+                               max_queue=serve.max_queue)
+        # Brownout ladder (serve/overload.py): per-engine, fed and
+        # ticked once per iteration; None = feature off, zero cost.
+        if serve.brownout:
+            from distributed_model_parallel_tpu.serve.overload import (
+                BrownoutController,
+            )
+
+            self.brownout = BrownoutController(serve)
+        else:
+            self.brownout = None
+        self._shed_by_reason: dict[str, int] = {}
+        self._rejected = 0
         self._sampled = serve.temperature > 0
         kw = dict(page_size=serve.page_size, n_pages=serve.n_pages,
                   impl=serve.attn_impl, temperature=serve.temperature,
@@ -205,6 +246,7 @@ class Engine:
             (serve.n_slots, self.cache.pages_per_seq), np.int32)
         self._auto_rid = 0
         self._iterations = 0
+        self._now = 0.0               # live open-loop clock (last iteration)
         self._decode_steps = 0
         self._decode_tokens = 0       # useful tokens out of decode steps
         self._occupancy: list[float] = []
@@ -242,6 +284,13 @@ class Engine:
             "n_slots": self.serve.n_slots,
             "page_occupancy": self.cache.occupancy,
             "requests_submitted": len(self._requests),
+            # overload protection, live (docs/SERVING.md)
+            "requests_shed": sum(self._shed_by_reason.values()),
+            "requests_rejected": self._rejected,
+            "shed_by_reason": dict(sorted(self._shed_by_reason.items())),
+            "brownout_level": (self.brownout.level
+                               if self.brownout is not None else None),
+            "max_queue": self.serve.max_queue,
             # prefix sharing + speculative decoding, live
             "prefix_cache": self.serve.prefix_cache,
             "spec_k": self.serve.spec_k,
@@ -304,29 +353,87 @@ class Engine:
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *, rid: str | None = None,
-               arrival_s: float = 0.0, seed: int = 0) -> Request:
+               arrival_s: float = 0.0, seed: int = 0,
+               priority: str = "interactive",
+               queue_budget_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
         prompt = [int(t) for t in prompt]
         if rid is None:
             rid = f"req-{self._auto_rid}"
             self._auto_rid += 1
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
-                      arrival_s=float(arrival_s), seed=int(seed))
+                      arrival_s=float(arrival_s), seed=int(seed),
+                      priority=priority, queue_budget_s=queue_budget_s,
+                      deadline_s=deadline_s)
         return self.enqueue(req)
 
-    def enqueue(self, req: Request) -> Request:
-        """Accept an already-built :class:`Request` — the fleet router's
-        entry point (serve/fleet.py), and the re-admission path for a
-        request drained off a quarantined peer (its committed tokens,
-        cursor and ``resume`` payload ride on the object)."""
+    def _validate_prompt(self, req: Request) -> None:
         bad = [t for t in req.prompt
                if not (0 <= t < self.cfg.vocab_size)]
         if bad:
             raise ValueError(f"prompt tokens {bad} outside vocab "
                              f"[0, {self.cfg.vocab_size})")
+
+    def enqueue(self, req: Request, *, force: bool = False) -> Request:
+        """Accept an already-built :class:`Request` — the fleet router's
+        entry point (serve/fleet.py), and the re-admission path for a
+        request drained off a quarantined peer (its committed tokens,
+        cursor and ``resume`` payload ride on the object). A full
+        bounded queue (``ServeConfig.max_queue``) REJECTS the request
+        with a typed ``shed`` record (reason ``queue-full``) instead of
+        growing without bound — callers check ``req.done``.
+        ``force=True`` bypasses the bound: a migrated-in request is
+        already-admitted load being moved, not new demand, and must
+        never be dropped by its destination's queue bound."""
+        self._validate_prompt(req)
+        # The bound rejects ALREADY-ARRIVED submissions against the live
+        # arrived backlog (the runaway-client case). Future-dated
+        # open-loop trace entries are pre-registrations, not load — they
+        # enqueue, and the per-iteration overflow trim (``_iterate``)
+        # bounds the live backlog once they arrive.
+        if (not force and self.sched.max_queue is not None
+                and req.arrival_s <= self._now
+                and self.sched.arrived_backlog(self._now)
+                >= self.sched.max_queue):
+            self._reject(req, "queue-full")
+            return req
         self.sched.submit(req)
         self._requests.append(req)
         return req
+
+    def try_enqueue(self, req: Request) -> bool:
+        """Bounded enqueue with NO side effects on refusal — the fleet
+        dispatcher's entry point: a ``False`` feeds the router's
+        circuit breaker and the request stays on the fleet queue."""
+        self._validate_prompt(req)
+        if self.sched.full:
+            return False
+        self.sched.submit(req)
+        self._requests.append(req)
+        return True
+
+    def _reject(self, req: Request, reason: str) -> None:
+        """Typed submission rejection (queue-full): terminal, counted,
+        recorded — never an unbounded host-side list."""
+        req.state = RequestState.FAILED
+        req.shed_reason = reason
+        req.error = f"rejected: {reason}"
+        self._requests.append(req)
+        self._rejected += 1
+        self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
+        if self._slo_metrics:
+            reg = registry()
+            reg.counter("serve_rejected_total").inc()
+            reg.counter("serve_shed_total").inc()
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "shed", request=req.rid, reason=reason,
+                priority=req.priority, state="queued",
+                policy=self.serve.policy, prompt_tokens=req.prompt_len,
+                new_tokens=len(req.generated),
+                **({"replica": self.replica}
+                   if self.replica is not None else {}))
 
     # -- live migration (serve/fleet.py) ------------------------------------
 
@@ -472,10 +579,40 @@ class Engine:
         if self.step_hook is not None:
             self.step_hook(self._iterations)
         self._iterations += 1
+        self._now = now
         return self._iterate(now, t0)
 
     def _iterate(self, now: float, t0: float) -> bool:
         progress = False
+        # Overload protection first: shed queued requests past their
+        # budgets, abort in-flight ones past their total deadline (pages
+        # return immediately — before admission, so a freed reservation
+        # can admit someone this very iteration), then apply the
+        # brownout ladder's admission-side knobs.
+        for req, reason in self.sched.expire(now):
+            self._shed(req, reason, now)
+        for req in self.sched.active():
+            dl = (req.deadline_s if req.deadline_s is not None
+                  else self.serve.deadline_s)
+            if dl is not None and now - req.arrival_s > dl:
+                self._shed(req, "total-deadline", now)
+        bo = self.brownout
+        if bo is not None:
+            self.sched.prefill_chunks_per_iter = (
+                self.serve.prefill_chunks_per_iter
+                if bo.prefill_full_share else 1)
+            cap = bo.max_new_cap
+            if cap is not None:
+                # Clamp while waiting under level-3 brownout: the
+                # reservation shrinks BEFORE admission bills it. The
+                # clamp sticks (deterministic accounting); the clamped
+                # stream is the bitwise prefix of the unclamped one.
+                for r in self.sched.queue:
+                    if r.arrival_s <= now and r.max_new_tokens > cap \
+                            and r.resume is None:
+                        if r.max_new_requested is None:
+                            r.max_new_requested = r.max_new_tokens
+                        r.max_new_tokens = cap
         for req in self.sched.admit(now):
             self._tables_np[req.slot] = self.cache.table_array(req.rid)
             if req.resume is not None:
@@ -499,6 +636,12 @@ class Engine:
                 registry().counter("serve_prefill_tokens_saved").inc(
                     req.cached_prompt_tokens)
             self._record_queue_wait(req)
+        # Queue-bound trim AFTER admission (work-conserving: a request a
+        # freed slot just absorbed must not count against the bound),
+        # so the arrived backlog leaves every iteration within
+        # max_queue — batch first, newest first.
+        for req in self.sched.overflow(now):
+            self._shed(req, "queue-full", now)
         for req in self.sched.prefilling():
             self._prefill_chunk(req, t0)
             progress = True
@@ -508,6 +651,18 @@ class Engine:
             progress = True
         occ = self.cache.occupancy
         self._occupancy.append(occ)
+        if bo is not None:
+            bo.observe_occupancy(occ)
+            transition = bo.tick(now)
+            if transition is not None:
+                if self.telemetry is not None:
+                    self.telemetry.record(
+                        "brownout", policy=self.serve.policy,
+                        **transition,
+                        **({"replica": self.replica}
+                           if self.replica is not None else {}))
+                if self._slo_metrics and self.replica is None:
+                    registry().gauge("serve_brownout_level").set(bo.level)
         # Fleet replicas (self.replica set) skip the process-global
         # gauge writes: N engines flapping one unlabeled gauge would
         # report whichever iterated last. The fleet aggregates ALL of
@@ -572,9 +727,13 @@ class Engine:
     # -- decode -------------------------------------------------------------
 
     def _decode_round(self, decoding: list[Request], t0: float) -> None:
-        with span("decode_round", batch=len(decoding),
-                  spec=bool(self._verify)):
-            if self._verify:
+        # Brownout level >= 1 sheds the speculative verify windows: the
+        # single-token program commits identical tokens (the pinned
+        # spec-on/off parity) at guaranteed-progress cost per round.
+        spec = bool(self._verify) and (self.brownout is None
+                                       or self.brownout.spec_enabled)
+        with span("decode_round", batch=len(decoding), spec=spec):
+            if spec:
                 self._spec_round_inner(decoding, t0)
             else:
                 self._decode_round_inner(decoding, t0)
@@ -759,6 +918,8 @@ class Engine:
         self._spec_streak.pop(req.rid, None)
         self._spec_live.pop(req.rid, None)
         self.sched.evict(req)
+        if self.brownout is not None:
+            self.brownout.observe_completed(self._ttft(req), req.t_done)
         token_s = None
         if len(req.generated) > 1 and req.t_first_token is not None:
             token_s = ((req.t_done - req.t_first_token)
@@ -779,6 +940,39 @@ class Engine:
                 ttft_s=self._ttft(req), token_latency_s=token_s,
                 wall_s=req.t_done - req.arrival_s,
                 **({"replica": self.replica, "migrations": req.migrations}
+                   if self.replica is not None else {}))
+
+    def _shed(self, req: Request, reason: str, now: float) -> None:
+        """Shed one request with a typed record: a queued expiry (the
+        scheduler already dequeued it) or an in-flight deadline abort —
+        the latter evicts mid-stream, returning every reserved page
+        immediately (chunk-aligned mid-prefill aborts included: eviction
+        frees the whole table). Terminal, counted, never silent."""
+        state_at = req.state.value
+        if req.slot is not None:
+            self.sched.evict(req)
+        self._proposers.pop(req.rid, None)
+        self._spec_streak.pop(req.rid, None)
+        self._spec_live.pop(req.rid, None)
+        req.state = RequestState.FAILED
+        req.shed_reason = reason
+        req.error = f"shed: {reason}"
+        self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
+        if reason == "queue-full":
+            self._rejected += 1
+        if self._slo_metrics:
+            registry().counter("serve_shed_total").inc()
+            if reason == "queue-full":
+                registry().counter("serve_rejected_total").inc()
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "shed", request=req.rid, reason=reason,
+                priority=req.priority, state=state_at,
+                policy=self.serve.policy,
+                waited_s=round(max(0.0, now - req.arrival_s), 4),
+                prompt_tokens=req.prompt_len,
+                new_tokens=len(req.generated),
+                **({"replica": self.replica}
                    if self.replica is not None else {}))
 
     def _fail_inflight(self, detail: str) -> None:
@@ -829,6 +1023,16 @@ class Engine:
         if t is not None and self._slo_metrics:
             registry().histogram("serve_ttft_s").observe(t)
 
+    def _in_deadline(self, req: Request) -> bool:
+        """Did this completed request land within its total deadline?
+        (Always True with no deadline configured — goodput then equals
+        throughput.)"""
+        dl = (req.deadline_s if req.deadline_s is not None
+              else self.serve.deadline_s)
+        if dl is None or req.t_done is None:
+            return True
+        return req.t_done - req.arrival_s <= dl
+
     # -- results ------------------------------------------------------------
 
     def results(self) -> list[Request]:
@@ -839,9 +1043,16 @@ class Engine:
         record when a telemetry stream is attached and ``record``)."""
         completed = [r for r in self._requests
                      if r.state is RequestState.COMPLETED]
+        # Shed requests (typed: deadlines, queue-full) are accounted
+        # apart from real failures — shedding is the overload plane
+        # WORKING, a failure is something breaking.
+        shed = [r for r in self._requests
+                if r.state is RequestState.FAILED and r.shed_reason]
         failed = [r for r in self._requests
-                  if r.state is RequestState.FAILED]
+                  if r.state is RequestState.FAILED and not r.shed_reason]
         tokens = sum(len(r.generated) for r in completed)
+        goodput_tokens = sum(len(r.generated) for r in completed
+                             if self._in_deadline(r))
         token_lat = [
             (r.t_done - r.t_first_token) / (len(r.generated) - 1)
             for r in completed
@@ -851,6 +1062,19 @@ class Engine:
             "n_slots": self.serve.n_slots,
             "requests_completed": len(completed),
             "requests_failed": len(failed),
+            # Overload-protection accounting (docs/SERVING.md): typed
+            # sheds by reason, bounded-queue rejections, goodput (tokens
+            # of requests that completed WITHIN their deadline — equal
+            # to tokens_generated when no deadline is configured), and
+            # the brownout ladder's travel.
+            "requests_shed": len(shed),
+            "requests_rejected": self._rejected,
+            "shed_by_reason": dict(sorted(self._shed_by_reason.items())),
+            "goodput_tokens": goodput_tokens,
+            "goodput_tokens_per_s": (goodput_tokens / self._wall_s
+                                     if self._wall_s > 0 else None),
+            "brownout": (self.brownout.summary()
+                         if self.brownout is not None else None),
             "tokens_generated": tokens,
             "wall_s": self._wall_s,
             "tokens_per_s": (tokens / self._wall_s if self._wall_s > 0

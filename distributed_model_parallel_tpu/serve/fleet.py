@@ -51,15 +51,20 @@ from distributed_model_parallel_tpu.serve.engine import (
     EngineKilled,
     ServeConfig,
 )
+from distributed_model_parallel_tpu.serve.overload import CircuitBreaker
 from distributed_model_parallel_tpu.serve.router import Router
 from distributed_model_parallel_tpu.serve.scheduler import (
     Request,
     RequestState,
+    expiry_reason,
+    next_arrived_by_class,
+    overflow_victims,
     summarize,
     validate_request,
 )
 from distributed_model_parallel_tpu.utils import health as health_mod
 from distributed_model_parallel_tpu.utils import tracing
+from distributed_model_parallel_tpu.utils.faults import FaultInjector
 from distributed_model_parallel_tpu.utils.telemetry import registry
 
 __all__ = ["Replica", "ServeFleet"]
@@ -97,7 +102,9 @@ class ServeFleet:
                  n_replicas: int, *, pool=None, devices=None,
                  health=None, telemetry=None, router_seed: int = 0,
                  affinity_slack: float = 2.0, revive_after: int | None = None,
-                 step_hook=None, slo_metrics: bool = True):
+                 step_hook=None, slo_metrics: bool = True,
+                 breaker: CircuitBreaker | None = None,
+                 faults=(), fault_replica: str | None = None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if serve.policy != "continuous":
@@ -133,9 +140,38 @@ class ServeFleet:
                 name=name, engine=eng,
                 device_ids=tuple(d.id for d in devs)))
         self.router = Router(router_seed, affinity_slack=affinity_slack)
+        # Router-level admission circuit breaker (serve/overload.py):
+        # repeated admission failures — a replica's bounded queue
+        # staying full, or injected admission chaos — take the replica
+        # out of the routing set until a half-open probe lands.
+        # Distinct from health quarantine: an open breaker's replica
+        # keeps serving its residents.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # Serve-side chaos (utils/faults.py): slow_replica sleeps inside
+        # the victim replica's timed round, admission_fail refuses its
+        # admissions for a bounded run of attempts.
+        self.injector = FaultInjector(faults) if faults else None
+        for spec in (self.injector.plan if self.injector else ()):
+            if spec.site not in ("serve", "admit"):
+                raise ValueError(
+                    f"fleet fault plans serve only the serve/admit sites; "
+                    f"{spec.kind!r} fires at {spec.site!r} (train-side "
+                    f"faults belong on trainer RecoveryConfig plans)")
+        self._fault_replica = fault_replica or self.replicas[-1].name
+        if not any(r.name == self._fault_replica for r in self.replicas):
+            raise ValueError(f"unknown fault_replica "
+                             f"{self._fault_replica!r}")
+        # Bounded fleet admission: beyond max_queue * n_replicas the
+        # fleet REJECTS (typed, reason queue-full) instead of growing an
+        # unbounded host-side list — batch sheds first: an arriving
+        # interactive request displaces the newest queued batch one.
+        self._max_pending = (serve.max_queue * n_replicas
+                            if serve.max_queue is not None else None)
         self._pending: deque[Request] = deque()
         self._requests: list[Request] = []
         self._ids: set[str] = set()
+        self._shed_by_reason: dict[str, int] = {}
+        self._rejected = 0
         self._auto_rid = 0
         self._rounds = 0
         self._now = 0.0
@@ -193,6 +229,11 @@ class ServeFleet:
                 reg.gauge("serve_draft_accept_rate").set(
                     sum(r.engine._draft_accepted for r in live)
                     / proposed)
+        if self.serve.brownout:
+            # Worst (deepest) live replica level — the saturation view,
+            # like the occupancy max above.
+            reg.gauge("serve_brownout_level").set(
+                max(r.engine.brownout.level for r in live))
 
     def _status(self) -> dict:
         """The fleet's /statusz provider: replica table + router state."""
@@ -201,6 +242,14 @@ class ServeFleet:
             "n_replicas": len(self.replicas),
             "live": [r.name for r in self._live()],
             "pending": len(self._pending),
+            "pending_bound": self._max_pending,
+            "requests_shed": (
+                sum(self._shed_by_reason.values())
+                + sum(sum(r.engine._shed_by_reason.values())
+                      for r in self.replicas)),
+            "requests_rejected": (
+                self._rejected
+                + sum(r.engine._rejected for r in self.replicas)),
             "rounds": self._rounds,
             "migrations": self._migrations,
             "replica_kills": self._kills,
@@ -214,6 +263,10 @@ class ServeFleet:
                     "active_requests": len(r.engine.sched.active()),
                     "page_occupancy": r.engine.cache.occupancy,
                     "assignments": self.router.assignments.get(r.name, 0),
+                    "breaker": self.breaker.state(r.name),
+                    "brownout_level": (r.engine.brownout.level
+                                       if r.engine.brownout is not None
+                                       else None),
                 } for r in self.replicas},
             "healthy": bool(self._live()),
         }
@@ -239,10 +292,17 @@ class ServeFleet:
     # -- submission ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *, rid: str | None = None,
-               arrival_s: float = 0.0, seed: int = 0) -> Request:
+               arrival_s: float = 0.0, seed: int = 0,
+               priority: str = "interactive",
+               queue_budget_s: float | None = None,
+               deadline_s: float | None = None) -> Request:
         """Queue a request at fleet level; the router assigns it to a
         replica when it arrives (open loop), so placement sees the load
-        at arrival time, not submission time."""
+        at arrival time, not submission time. A full fleet queue
+        (``ServeConfig.max_queue`` × replicas) REJECTS with a typed
+        record (reason ``queue-full``) — batch first: an interactive
+        arrival displaces the newest queued batch request instead of
+        being turned away itself. Callers check ``req.done``."""
         prompt = [int(t) for t in prompt]
         if rid is None:
             rid = f"req-{self._auto_rid}"
@@ -251,7 +311,9 @@ class ServeFleet:
             raise ValueError(f"duplicate request id {rid!r}")
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
-                      arrival_s=float(arrival_s), seed=int(seed))
+                      arrival_s=float(arrival_s), seed=int(seed),
+                      priority=priority, queue_budget_s=queue_budget_s,
+                      deadline_s=deadline_s)
         # Geometry is fleet-uniform: any replica's cache speaks for all.
         ref = self.replicas[0].engine
         validate_request(req, ref.cache)
@@ -260,9 +322,73 @@ class ServeFleet:
             raise ValueError(f"prompt tokens {bad} outside vocab "
                              f"[0, {ref.cfg.vocab_size})")
         self._ids.add(rid)
-        self._pending.append(req)
         self._requests.append(req)
+        # The bound rejects ALREADY-ARRIVED submissions against the live
+        # arrived backlog (the runaway-client case); future-dated
+        # open-loop trace entries enqueue and the per-round trim
+        # (``_bound_pending``) sheds overflow once they arrive.
+        if (self._max_pending is not None
+                and req.arrival_s <= self._now
+                and sum(1 for r in self._pending
+                        if r.arrival_s <= self._now) >= self._max_pending):
+            if req.priority == "batch":
+                self._shed_request(req, "queue-full")
+                return req
+            victim = next((r for r in reversed(self._pending)
+                           if r.priority == "batch"
+                           and r.arrival_s <= self._now), None)
+            if victim is None:
+                self._shed_request(req, "queue-full")
+                return req
+            # Batch sheds first: the newest queued batch request gives
+            # its place to the interactive arrival.
+            self._pending.remove(victim)
+            self._shed_request(victim, "queue-full")
+        self._pending.append(req)
         return req
+
+    def _bound_pending(self, now: float) -> None:
+        """Per-round queue bound: shed arrived fleet-queue overflow
+        beyond ``max_queue`` × replicas with typed ``queue-full``
+        records — batch first, newest-arrival first within a class, so
+        the oldest interactive waiters keep their place and the live
+        backlog stays bounded no matter the offered load."""
+        if self._max_pending is None:
+            return
+        arrived = [r for r in self._pending if r.arrival_s <= now]
+        victims = overflow_victims(arrived, self._max_pending)
+        if not victims:
+            return
+        gone = {id(r) for r in victims}
+        self._pending = deque(r for r in self._pending
+                              if id(r) not in gone)
+        for req in victims:
+            self._shed_request(req, "queue-full",
+                               waited_s=max(0.0, now - req.arrival_s))
+
+    def _shed_request(self, req: Request, reason: str, *,
+                      waited_s: float | None = None) -> None:
+        """Typed fleet-level shed: queue-full rejection/displacement or
+        a fleet-queue deadline expiry — terminal, counted, recorded."""
+        req.state = RequestState.FAILED
+        req.shed_reason = reason
+        req.error = f"shed: {reason}"
+        self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
+        if reason == "queue-full":
+            self._rejected += 1
+        if self._slo_metrics:
+            reg = registry()
+            reg.counter("serve_shed_total").inc()
+            if reason == "queue-full":
+                reg.counter("serve_rejected_total").inc()
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "shed", request=req.rid, reason=reason,
+                priority=req.priority, state="queued", policy="fleet",
+                prompt_tokens=req.prompt_len,
+                new_tokens=len(req.generated),
+                **({"waited_s": round(waited_s, 4)}
+                   if waited_s is not None else {}))
 
     def warmup(self) -> None:
         """Compile every program once (engine builders are memoized per
@@ -288,11 +414,22 @@ class ServeFleet:
                     if self.step_hook is not None:
                         self.step_hook(self._rounds)
                     self._rounds += 1
+                    self._expire_pending(now)
                     progress = self._dispatch(now)
+                    # Queue-bound trim AFTER dispatch (work-conserving:
+                    # requests the replicas just absorbed must not count
+                    # against the bound).
+                    self._bound_pending(now)
                     for rep in self.replicas:
                         if rep.state != LIVE:
                             continue
                         w0 = time.monotonic()
+                        if (self.injector is not None
+                                and rep.name == self._fault_replica):
+                            # slow_replica sleeps HERE, inside the timed
+                            # window, so the health sentinel's serve
+                            # signal observes it like a real throttle.
+                            self.injector.poll("serve")
                         stepped = rep.engine.step_once(now, t0)
                         if stepped:
                             # Only WORKING rounds feed the sentinel: an
@@ -344,17 +481,80 @@ class ServeFleet:
         return not self._pending and all(r.engine.sched.idle()
                                          for r in self.replicas)
 
+    def _expire_pending(self, now: float) -> None:
+        """Shed arrived fleet-queue requests past their queue budget or
+        total deadline — under sustained overload most shedding happens
+        HERE, before any replica spends a page on the request."""
+        expired = [
+            (r, reason) for r in self._pending if r.arrival_s <= now
+            and (reason := expiry_reason(
+                r, now, queue_budget_s=self.serve.queue_budget_s,
+                deadline_s=self.serve.deadline_s)) is not None]
+        if not expired:
+            return
+        gone = {id(r) for r, _ in expired}
+        self._pending = deque(r for r in self._pending
+                              if id(r) not in gone)
+        for req, reason in expired:
+            self._shed_request(req, reason,
+                               waited_s=max(0.0, now - req.arrival_s))
+
+    def _next_pending(self, now: float) -> Request | None:
+        """Next arrived fleet-queue request — the engine scheduler's
+        two-class order, one shared definition
+        (:func:`~serve.scheduler.next_arrived_by_class`)."""
+        return next_arrived_by_class(self._pending, now)
+
+    def _try_admit(self, rep: Replica, req: Request) -> bool:
+        """One admission attempt: the injected ``admission_fail`` chaos
+        (victim replica only) or a full bounded submission queue refuses
+        it — the refusal feeds the circuit breaker."""
+        if (self.injector is not None and rep.name == self._fault_replica):
+            self.injector.poll("admit")
+            if self.injector.admission_blocked():
+                return False
+        return rep.engine.try_enqueue(req)
+
+    def _emit_breaker_records(self) -> None:
+        for tr in self.breaker.drain_transitions():
+            if self.telemetry is not None:
+                self.telemetry.record("breaker", **tr)
+
     def _dispatch(self, now: float) -> bool:
-        """Route every arrived fleet-queue request to a live replica."""
+        """Route every arrived fleet-queue request to a live replica
+        whose circuit breaker admits traffic. A refused admission
+        (bounded queue, chaos) feeds the breaker and leaves the request
+        on the fleet queue for the next round — bounded-queue
+        backpressure, never a drop."""
         progress = False
-        while self._pending and self._pending[0].arrival_s <= now:
+        while True:
+            req = self._next_pending(now)
+            if req is None:
+                break
             live = self._live()
             if not live:
                 break                 # all quarantined: wait for grow-back
-            req = self._pending[0]
-            rep, reason, loads = self.router.pick(req.prompt, live)
-            self._pending.popleft()
-            rep.engine.enqueue(req)
+            candidates = [r for r in live
+                          if self.breaker.allows(r.name, self._rounds)]
+            self._emit_breaker_records()   # half-open transitions
+            if not candidates:
+                break                 # every breaker open: wait it out
+            placed = None
+            while candidates:
+                rep, reason, loads = self.router.pick(
+                    req.prompt, candidates, commit=False)
+                ok = self._try_admit(rep, req)
+                self.breaker.note(rep.name, ok, self._rounds)
+                self._emit_breaker_records()
+                if ok:
+                    placed = (rep, reason, loads)
+                    break
+                candidates = [r for r in candidates if r is not rep]
+            if placed is None:
+                break                 # nobody would take it: next round
+            rep, reason, loads = placed
+            self.router.commit(rep.name, reason)
+            self._pending.remove(req)
             if self._slo_metrics:
                 registry().counter("serve_router_assignments").inc()
             if self.telemetry is not None:
@@ -478,10 +678,17 @@ class ServeFleet:
                     detail=req.error, prompt_tokens=req.prompt_len,
                     new_tokens=len(req.generated))
             return 0
-        target, reason, loads = self.router.pick(req.prompt, live,
+        # Prefer breaker-admitting peers, but never fail a migration
+        # over an open breaker — a migrated request is existing load
+        # being rescued, and the bounded queue is bypassed for the same
+        # reason (enqueue force=True).
+        candidates = [r for r in live
+                      if self.breaker.allows(r.name, self._rounds)] or live
+        self._emit_breaker_records()
+        target, reason, loads = self.router.pick(req.prompt, candidates,
                                                  migrate=True)
         pages = int(req.resume["k"].shape[1]) if req.resume else 0
-        target.engine.enqueue(req)
+        target.engine.enqueue(req, force=True)
         self._migrations += 1
         if self._slo_metrics:
             registry().counter("serve_router_assignments").inc()
@@ -545,9 +752,25 @@ class ServeFleet:
         summary record with ``policy="fleet"`` when recording)."""
         completed = [r for r in self._requests
                      if r.state is RequestState.COMPLETED]
+        shed = [r for r in self._requests
+                if r.state is RequestState.FAILED and r.shed_reason]
         failed = [r for r in self._requests
-                  if r.state is RequestState.FAILED]
+                  if r.state is RequestState.FAILED and not r.shed_reason]
+        # Fleet-wide shed-by-reason and rejected counts: the fleet's
+        # own (queue-full, fleet-queue expiry) plus every replica
+        # engine's (post-dispatch expiries and aborts land there) — the
+        # two must stay in one scope, or the report's "shed (rejected)"
+        # line stops reconciling.
+        shed_by_reason: dict[str, int] = dict(self._shed_by_reason)
+        rejected = self._rejected
+        for rep in self.replicas:
+            rejected += rep.engine._rejected
+            for reason, n in rep.engine._shed_by_reason.items():
+                shed_by_reason[reason] = shed_by_reason.get(reason, 0) + n
         tokens = sum(len(r.generated) for r in completed)
+        goodput_tokens = sum(
+            len(r.generated) for r in completed
+            if self.replicas[0].engine._in_deadline(r))
         ttft = [max(0.0, r.t_first_token - r.arrival_s) for r in completed
                 if r.t_first_token is not None]
         waits = [max(0.0, r.t_admitted - r.arrival_s) for r in completed
@@ -567,6 +790,19 @@ class ServeFleet:
                          for r in self.replicas},
             "requests_completed": len(completed),
             "requests_failed": len(failed),
+            "requests_shed": len(shed),
+            "requests_rejected": rejected,
+            "shed_by_reason": dict(sorted(shed_by_reason.items())),
+            "goodput_tokens": goodput_tokens,
+            "goodput_tokens_per_s": (goodput_tokens / self._wall_s
+                                     if self._wall_s > 0 else None),
+            "breaker": {"opens": self.breaker.opens,
+                        "states": self.breaker.snapshot()},
+            "brownout_level_max": (
+                max((r.engine.brownout.max_level_seen
+                     for r in self.replicas
+                     if r.engine.brownout is not None), default=None)
+                if self.serve.brownout else None),
             "requests_migrated": sum(1 for r in self._requests
                                      if r.migrations > 0),
             "migrations": self._migrations,

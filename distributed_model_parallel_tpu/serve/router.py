@@ -61,13 +61,18 @@ class Router:
                 + eng.cache.occupancy)
 
     def pick(self, prompt: list[int], replicas: list, *,
-             migrate: bool = False) -> tuple[object, str, dict]:
+             migrate: bool = False,
+             commit: bool = True) -> tuple[object, str, dict]:
         """Choose a live replica for ``prompt``. Returns ``(replica,
         reason, loads)`` where reason is ``affinity`` (prefix-cache
         match won), ``p2c`` (power-of-two-choices), ``only`` (one
         candidate), or ``migrate`` (least-loaded drain placement).
         ``loads`` maps replica name -> load at decision time (the typed
-        ``router`` record's payload)."""
+        ``router`` record's payload). ``commit=False`` defers the
+        assignment bookkeeping to an explicit :meth:`commit` — the
+        fleet's dispatch path, where an admission can still be refused
+        (bounded queue, circuit breaker, injected chaos) and a refused
+        pick must not inflate the assignment counts."""
         if not replicas:
             raise ValueError("no live replica to route to")
         loads = {r.name: self.load(r) for r in replicas}
@@ -81,11 +86,16 @@ class Router:
             reason = "migrate"
         else:
             chosen, reason = self._pick_new(prompt, replicas, loads)
-        self.assignments[chosen.name] = (
-            self.assignments.get(chosen.name, 0) + 1)
+        if commit:
+            self.commit(chosen.name, reason)
+        return chosen, reason, loads
+
+    def commit(self, name: str, reason: str) -> None:
+        """Count an assignment that actually LANDED (the engine accepted
+        the request)."""
+        self.assignments[name] = self.assignments.get(name, 0) + 1
         if reason == "affinity":
             self.affinity_hits += 1
-        return chosen, reason, loads
 
     def _pick_new(self, prompt, replicas, loads):
         best_aff, aff_rep = 0, None

@@ -48,6 +48,62 @@ class RequestState(enum.Enum):
     FAILED = "failed"
 
 
+# Admission classes, in shed order: under overload ``batch`` requests
+# wait behind (and are displaced by) ``interactive`` ones, so best-effort
+# work sheds first (docs/SERVING.md "Overload and graceful degradation").
+PRIORITIES = ("interactive", "batch")
+
+
+def next_arrived_by_class(requests, now: float) -> "Request | None":
+    """The next candidate among ``requests`` under the two-class order:
+    an arrived interactive request jumps queued batch ones (batch
+    waits, and therefore sheds, first), FIFO within a class. Shared by
+    the engine scheduler's admission and the fleet's dispatch — one
+    definition of the priority order."""
+    batch_head = None
+    for r in requests:
+        if r.arrival_s > now:
+            continue
+        if r.priority != "batch":
+            return r
+        if batch_head is None:
+            batch_head = r
+    return batch_head
+
+
+def overflow_victims(arrived: list["Request"],
+                     bound: int) -> list["Request"]:
+    """The requests to shed when ``arrived`` exceeds ``bound``, in shed
+    order — batch first, newest first within a class, so the oldest
+    interactive waiters keep their place. Shared by the engine
+    scheduler's per-iteration trim and the fleet's per-round trim."""
+    excess = len(arrived) - bound
+    if excess <= 0:
+        return []
+    batch = [r for r in arrived if r.priority == "batch"]
+    rest = [r for r in arrived if r.priority != "batch"]
+    return (list(reversed(batch)) + list(reversed(rest)))[:excess]
+
+
+def expiry_reason(req: "Request", now: float, *,
+                  queue_budget_s: float | None = None,
+                  deadline_s: float | None = None) -> str | None:
+    """Typed shed reason for an arrived, still-queued request at clock
+    ``now`` — ``total-deadline`` (the whole request can no longer matter)
+    beats ``queue-deadline`` (it waited past its queue budget); ``None``
+    while the request is still worth admitting. The per-request fields
+    override the engine defaults passed in."""
+    age = now - req.arrival_s
+    dl = req.deadline_s if req.deadline_s is not None else deadline_s
+    if dl is not None and age > dl:
+        return "total-deadline"
+    qb = (req.queue_budget_s if req.queue_budget_s is not None
+          else queue_budget_s)
+    if qb is not None and age > qb:
+        return "queue-deadline"
+    return None
+
+
 @dataclasses.dataclass(eq=False)   # identity semantics: requests are live
 class Request:                     # objects in slots/queues, not values
     """One generation request plus its lifecycle bookkeeping.
@@ -63,6 +119,17 @@ class Request:                     # objects in slots/queues, not values
     max_new_tokens: int
     arrival_s: float = 0.0
     seed: int = 0
+    # -- overload protection (docs/SERVING.md) --
+    # Admission class: "interactive" jumps queued "batch" requests and
+    # displaces them from a full submission queue — batch sheds first.
+    priority: str = "interactive"
+    # Queue-wait budget / total deadline (seconds from arrival_s); None
+    # defers to the ServeConfig defaults. A queued request past either
+    # is shed with a typed record instead of waiting forever; an
+    # in-flight request past its total deadline is aborted and its
+    # pages returned immediately.
+    queue_budget_s: float | None = None
+    deadline_s: float | None = None
 
     # -- runtime state (engine-owned) --
     state: RequestState = RequestState.QUEUED
@@ -74,6 +141,11 @@ class Request:                     # objects in slots/queues, not values
     t_admitted: float | None = None
     t_first_token: float | None = None
     t_done: float | None = None
+    # Overload bookkeeping: why this request was shed (queue-deadline /
+    # total-deadline / queue-full; None for a real failure or success),
+    # and the pre-brownout-clamp max_new when level-3 brownout capped it.
+    shed_reason: str | None = None
+    max_new_requested: int | None = None
     # Live migration (serve/fleet.py): a drained request carries its
     # exported KV page contents here until the destination replica
     # admits it — admission then runs ``PagedKVCache.import_request``
@@ -116,6 +188,14 @@ def validate_request(req: Request, cache) -> None:
             f"{cache.pages_needed(req.total_capacity)} pages but "
             f"the whole pool holds {cache.pool.n_pages}; it can "
             f"never be admitted")
+    if req.priority not in PRIORITIES:
+        raise ValueError(f"request {req.rid!r}: unknown priority "
+                         f"{req.priority!r}; known: {PRIORITIES}")
+    for name, v in (("queue_budget_s", req.queue_budget_s),
+                    ("deadline_s", req.deadline_s)):
+        if v is not None and v <= 0:
+            raise ValueError(f"request {req.rid!r}: {name} must be > 0, "
+                             f"got {v}")
 
 
 class Scheduler:
@@ -127,7 +207,10 @@ class Scheduler:
     """
 
     def __init__(self, cache, n_slots: int, *, policy: str = "continuous",
-                 prefill_chunks_per_iter: int = 1):
+                 prefill_chunks_per_iter: int = 1,
+                 queue_budget_s: float | None = None,
+                 deadline_s: float | None = None,
+                 max_queue: int | None = None):
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown policy {policy!r}; known: "
                              f"continuous, static")
@@ -136,15 +219,60 @@ class Scheduler:
         if prefill_chunks_per_iter < 1:
             raise ValueError(f"prefill_chunks_per_iter must be >= 1, got "
                              f"{prefill_chunks_per_iter}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.cache = cache
         self.n_slots = n_slots
         self.policy = policy
         self.prefill_chunks_per_iter = prefill_chunks_per_iter
+        # Engine-wide deadline defaults (per-request fields override) and
+        # the submission-queue bound — the overload-protection knobs
+        # (docs/SERVING.md "Overload and graceful degradation").
+        self.queue_budget_s = queue_budget_s
+        self.deadline_s = deadline_s
+        self.max_queue = max_queue
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * n_slots
         self._ids: set[str] = set()
 
     # -- submission ---------------------------------------------------------
+
+    @property
+    def full(self) -> bool:
+        """The submission queue is at its bound — the caller must reject
+        with a typed record, not enqueue. (In fleet mode every queued
+        request has already arrived — the fleet gates arrivals — so the
+        raw count IS the live backlog; open-loop standalone engines
+        bound the *arrived* backlog instead, via
+        :meth:`arrived_backlog` + the engine's per-iteration trim.)"""
+        return (self.max_queue is not None
+                and len(self.queue) >= self.max_queue)
+
+    def arrived_backlog(self, now: float) -> int:
+        """Queued requests that have actually arrived by ``now`` — the
+        backlog the queue bound applies to (future-dated open-loop trace
+        entries are pre-registrations, not load)."""
+        return sum(1 for r in self.queue if r.arrival_s <= now)
+
+    def overflow(self, now: float) -> list[Request]:
+        """Arrived requests beyond ``max_queue``, in shed order
+        (:func:`overflow_victims`). The engine sheds these with typed
+        ``queue-full`` records each iteration, so the live backlog
+        stays bounded no matter how fast submissions arrive. Migrated
+        requests (``resume`` payload) are exempt — rescued load is not
+        new demand, the same contract that lets their force-enqueue
+        bypass the bound — so they neither count against it nor get
+        trimmed."""
+        if self.max_queue is None:
+            return []
+        arrived = [r for r in self.queue
+                   if r.arrival_s <= now and r.resume is None]
+        victims = overflow_victims(arrived, self.max_queue)
+        if not victims:
+            return []
+        gone = {id(r) for r in victims}
+        self.queue = deque(r for r in self.queue if id(r) not in gone)
+        return victims
 
     def submit(self, req: Request) -> None:
         if req.rid in self._ids:
@@ -152,6 +280,30 @@ class Scheduler:
         validate_request(req, self.cache)
         self._ids.add(req.rid)
         self.queue.append(req)
+
+    # -- shedding -----------------------------------------------------------
+
+    def expire(self, now: float) -> list[tuple[Request, str]]:
+        """Remove arrived queued requests whose queue budget or total
+        deadline has passed; returns ``(request, reason)`` pairs for the
+        engine to shed with typed records. Queued requests hold no page
+        reservation (reservation happens at admission), so removal is
+        pure bookkeeping; their rids stay burned (a shed request is
+        terminal, not resubmittable)."""
+        out: list[tuple[Request, str]] = []
+        keep: deque[Request] = deque()
+        for r in self.queue:
+            reason = (expiry_reason(r, now,
+                                    queue_budget_s=self.queue_budget_s,
+                                    deadline_s=self.deadline_s)
+                      if r.arrival_s <= now else None)
+            if reason is None:
+                keep.append(r)
+            else:
+                out.append((r, reason))
+        if out:
+            self.queue = keep
+        return out
 
     # -- admission ----------------------------------------------------------
 
@@ -177,9 +329,9 @@ class Scheduler:
         for slot in range(self.n_slots):
             if self.slots[slot] is not None:
                 continue
-            if not self.queue or self.queue[0].arrival_s > now:
+            req = self._next_admittable(now)
+            if req is None:
                 break
-            req = self.queue[0]
             if req.resume is not None:
                 # A migrated-in request: its exported KV is
                 # authoritative, so the reservation is all fresh pages
@@ -206,7 +358,7 @@ class Scheduler:
                 if got is None:
                     break                  # head-of-line: wait for pages
                 req.cached_prompt_tokens = got
-            self.queue.popleft()
+            self.queue.remove(req)
             req.slot = slot
             req.state = RequestState.PREFILL
             if req.t_admitted is None:
@@ -223,6 +375,13 @@ class Scheduler:
                 "admit", time.monotonic() - t0m, t0=t0w, n=len(admitted),
                 requests=",".join(r.rid for r in admitted))
         return admitted
+
+    def _next_admittable(self, now: float) -> Request | None:
+        """The next admission candidate (:func:`next_arrived_by_class`).
+        Head-of-line blocking applies to the CHOSEN candidate: when it
+        does not fit, admission waits rather than skipping deeper
+        (deterministic, starvation-free within class)."""
+        return next_arrived_by_class(self.queue, now)
 
     # -- iteration views ----------------------------------------------------
 

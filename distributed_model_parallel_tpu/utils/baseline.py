@@ -83,6 +83,14 @@ GATE_METRICS: dict[str, bool] = {
     # not landing mid-stream anymore).
     "post_kill_ttft_p99_s": False,
     "migrations": True,
+    # Overload drill (BENCH_serve overload mode): goodput is tokens/s
+    # of requests completed WITHIN deadline under 2x offered load — the
+    # number the whole shedding/brownout plane exists to hold up; the
+    # shed fraction gates lower-better so a drifting admission path
+    # (shedding more than the band needs) fails loudly even when
+    # goodput holds.
+    "goodput_tokens_per_s": True,
+    "shed_fraction": False,
 }
 
 DEFAULT_K = 3.0
@@ -172,7 +180,9 @@ def ingest_artifact(path: str) -> list[dict]:
                      ("cache_hit_rate", "cache_hit_rate"),
                      ("draft_accept_rate", "draft_accept_rate"),
                      ("post_kill_ttft_p99_s", "post_kill_ttft_p99_s"),
-                     ("migrations", "migrations")):
+                     ("migrations", "migrations"),
+                     ("goodput_tokens_per_s", "goodput_tokens_per_s"),
+                     ("shed_fraction", "shed_fraction")):
         v = parsed.get(src)
         if isinstance(v, (int, float)):
             metrics[dst] = float(v)
@@ -261,7 +271,8 @@ def extract_points(records: list[dict]) -> list[dict]:
         metrics: dict[str, float] = {"throughput": float(b["value"])}
         for k in ("mfu", "ttft_p99_s", "token_latency_p99_s",
                   "cache_hit_rate", "draft_accept_rate",
-                  "post_kill_ttft_p99_s", "migrations"):
+                  "post_kill_ttft_p99_s", "migrations",
+                  "goodput_tokens_per_s", "shed_fraction"):
             if isinstance(b.get(k), (int, float)):
                 metrics[k] = float(b[k])
         if step_p50 is not None:

@@ -49,6 +49,19 @@ kind           site    effect when fired
                        (default 0.05) — an intermittently flaky link whose
                        stalls stay under the watchdog budget and are only
                        visible as latency jitter
+``slow_replica`` serve PERSISTENT degradation: from the firing serve-site
+                       poll on, every poll sleeps ``param`` seconds
+                       (default 0.05) — a serving replica slowing down
+                       (thermal throttle, noisy neighbor), as the health
+                       sentinel's ``serve`` signal sees it. The serving
+                       fleet polls this site inside the victim replica's
+                       timed engine round (serve/fleet.py)
+``admission_fail`` admit PERSISTENT (bounded): from the firing admit-site
+                       poll on, the next ``param`` admission attempts
+                       (default 6) to the victim replica FAIL — a replica
+                       whose submission path is broken while its residents
+                       keep decoding; the router's circuit breaker is the
+                       intended detector (serve/overload.py)
 =============  ======  =====================================================
 
 Sites are consulted by the trainers (``step``), ``GuardRunner.watch``
@@ -101,6 +114,8 @@ FAULT_SITES = {
     "grad_skew": "step",
     "slow_device": "step",
     "flaky_sync": "sync",
+    "slow_replica": "serve",
+    "admission_fail": "admit",
 }
 
 # Faults that silently corrupt ONE data-parallel replica's state (served by
@@ -113,13 +128,18 @@ CORRUPTION_KINDS = frozenset({"bitflip", "desync", "grad_skew"})
 # every later poll of their site — gradual decline, not an event. Served by
 # FaultInjector.poll itself (the injector owns the ramp state), detected by
 # the device-health sentinel (utils/health.py), not by the guards.
-DEGRADATION_KINDS = frozenset({"slow_device", "flaky_sync"})
+DEGRADATION_KINDS = frozenset({"slow_device", "flaky_sync",
+                               "slow_replica", "admission_fail"})
 
 # slow_device ramp: delay = param * min(polls_since_firing, cap) — linear
 # decline toward a bounded worst case, so a soak stays finite.
 SLOW_DEVICE_RAMP_CAP = 4
 # flaky_sync intermittency: sleep on every PERIOD-th sync after firing.
 FLAKY_SYNC_PERIOD = 2
+# admission_fail duration: admissions fail for this many admit-site polls
+# after firing (param overrides) — bounded, so the breaker's half-open
+# probe eventually lands and the cycle closes.
+ADMISSION_FAIL_POLLS = 6
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +258,29 @@ class FaultInjector:
                            * min(n, SLOW_DEVICE_RAMP_CAP))
             elif s.kind == "flaky_sync" and n % FLAKY_SYNC_PERIOD == 0:
                 time.sleep(s.param if s.param is not None else 0.05)
+            elif s.kind == "slow_replica":
+                # Flat per-round delay inside the fleet's timed engine
+                # round (serve/fleet.py polls the serve site there) —
+                # the health sentinel's serve signal sees the outlier.
+                time.sleep(s.param if s.param is not None else 0.05)
+            # admission_fail: no sleep — queried via admission_blocked().
+
+    def admission_blocked(self) -> bool:
+        """True while an ``admission_fail`` degradation is active: it
+        fired, and fewer than its duration (``param`` admit-site polls,
+        default ADMISSION_FAIL_POLLS) have elapsed since. The serving
+        fleet consults this on every admission attempt to the victim
+        replica (serve/fleet.py) — the failures open the router's
+        circuit breaker, and the recovery closes it through a half-open
+        probe."""
+        for s, n in self._degradations.items():
+            if s.kind != "admission_fail":
+                continue
+            dur = (int(s.param) if s.param is not None
+                   else ADMISSION_FAIL_POLLS)
+            if n <= dur:
+                return True
+        return False
 
     def maybe_stall(self, site: str = "sync") -> None:
         """Poll ``site`` and serve any ``stall`` fault by sleeping — called

@@ -257,13 +257,41 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
     occupancy) — BENCH_serve writes one summary per policy, so the
     continuous-vs-static comparison reads directly off this section."""
     recs = by_kind.get("serve") or []
-    if not recs:
+    sheds = by_kind.get("shed") or []
+    brownouts = by_kind.get("brownout") or []
+    if not recs and not sheds and not brownouts:
         return
     completed = [r for r in recs if r.get("event") == "completed"]
     failed = [r for r in recs if r.get("event") == "failed"]
     summaries = [r for r in recs if r.get("event") == "summary"]
     lines.append(f"== serving ({len(completed)} completed, "
-                 f"{len(failed)} failed) ==")
+                 f"{len(failed)} failed"
+                 + (f", {len(sheds)} shed" if sheds else "") + ") ==")
+    # Overload protection (docs/SERVING.md): typed sheds by reason and
+    # the brownout ladder's travel — absent entirely on a run that
+    # never shed (the common case stays terse).
+    if sheds:
+        by_reason: dict[str, int] = {}
+        for r in sheds:
+            by_reason[str(r.get("reason"))] = (
+                by_reason.get(str(r.get("reason")), 0) + 1)
+        lines.append("shed: " + ", ".join(
+            f"{reason} {n}" for reason, n in sorted(by_reason.items())))
+    if brownouts:
+        max_level = max((r.get("level", 0) for r in brownouts), default=0)
+        final = brownouts[-1].get("level")
+        lines.append(
+            f"brownout: {len(brownouts)} transitions, max level "
+            f"{max_level}, final level {final} "
+            f"({', '.join(brownouts[-1].get('applied') or []) or 'clear'})")
+    breakers = by_kind.get("breaker") or []
+    if breakers:
+        opens = sum(1 for r in breakers if r.get("state") == "open")
+        last: dict[str, str] = {}
+        for r in breakers:
+            last[str(r.get("replica"))] = str(r.get("state"))
+        lines.append("breaker: " + f"{opens} opens   " + "  ".join(
+            f"{k}={v}" for k, v in sorted(last.items())))
     # One percentile block PER POLICY: BENCH_serve writes both the
     # continuous and the static runs' per-request records onto one
     # stream, and a blended percentile would describe neither run.
@@ -288,6 +316,7 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
         util = s.get("slot_utilization")
         hit = s.get("cache_hit_rate")
         accept = s.get("draft_accept_rate")
+        shed_n = s.get("requests_shed")
         lines.append(
             f"engine[{s.get('policy')}]: "
             f"{s.get('tokens_generated')} tokens"
@@ -297,7 +326,9 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
                if isinstance(util, (int, float)) else "")
             + (f", page occupancy mean {occ.get('mean'):.2f} "
                f"max {occ.get('max'):.2f}"
-               if isinstance(occ.get("mean"), (int, float)) else ""))
+               if isinstance(occ.get("mean"), (int, float)) else "")
+            + (f", {shed_n} shed ({s.get('requests_rejected', 0)} "
+               f"rejected)" if shed_n else ""))
         # Prefix-cache + speculative-decoding line only when either
         # lever was on (docs/SERVING.md) — a plain engine stays terse.
         if s.get("prefix_cache") or s.get("spec_k"):
@@ -745,6 +776,11 @@ def build_report_data(records: list[dict]) -> dict:
         "failed": len([r for r in serve if r.get("event") == "failed"]),
         "policies": policies,
         "summaries": [r for r in serve if r.get("event") == "summary"],
+        # Overload protection (docs/SERVING.md): the typed shed records
+        # and brownout-ladder transitions, verbatim.
+        "shed": by_kind.get("shed") or [],
+        "brownout": by_kind.get("brownout") or [],
+        "breaker": by_kind.get("breaker") or [],
     }
     gates = by_kind.get("gate") or []
     gate = None

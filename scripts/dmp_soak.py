@@ -86,13 +86,23 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mode", default="fast", choices=["fast", "long"])
     p.add_argument("--scenario", default="chaos",
-                   choices=["chaos", "degradation"],
+                   choices=["chaos", "degradation", "overload"],
                    help="chaos: the heterogeneous fault campaign; "
                         "degradation: the device-health drill — an "
                         "injected slow_device straggler must be "
                         "quarantined and its tenant migrated (shrunk "
                         "dp4->dp2) and grown back to its requested dp at "
-                        "the exact global step (utils/health.py)")
+                        "the exact global step (utils/health.py); "
+                        "overload: the serving-fleet overload drill — "
+                        "2x offered load must hold goodput within "
+                        "--goodput-band of clean capacity via typed "
+                        "shedding, bounded queues and a brownout that "
+                        "fires and resolves, with completed tokens "
+                        "bitwise identical to the clean run "
+                        "(serve/overload.py)")
+    p.add_argument("--goodput-band", default=0.8, type=float,
+                   help="overload scenario: goodput under 2x load must "
+                        "stay >= this fraction of clean-run capacity")
     p.add_argument("--seed", default=0, type=int,
                    help="campaign seed: fault kinds/sites, priorities and "
                         "event rounds all derive from it — same seed, "
@@ -537,13 +547,234 @@ def run_degradation_campaign(args, workdir: str, seed: int
     return out, ok
 
 
+# ---------------------------------------------------------------------------
+# the overload scenario: 2x offered load, shed typed, degrade gracefully
+# ---------------------------------------------------------------------------
+
+def run_overload_campaign(args, workdir: str, seed: int
+                          ) -> tuple[dict, bool]:
+    """The serving-fleet overload drill (docs/SERVING.md "Overload and
+    graceful degradation"), end to end on the real stack:
+
+    Phase A measures clean capacity — the same request population,
+    closed loop, no deadlines, nothing sheds — and records every
+    request's reference tokens. Phase B replays the population as an
+    open-loop trace at **2x capacity** (plus a 0.3x cool-down tail so
+    the brownout has live traffic to resolve against), with the whole
+    overload plane armed: queue-wait budgets + total deadlines, bounded
+    fleet/engine queues, the brownout ladder, and an injected
+    ``admission_fail`` burst on one replica to exercise the router's
+    circuit breaker.
+
+    Gates (non-zero exit when any fails):
+
+    1. goodput — tokens/s of requests completed within deadline, over
+       the saturated window — >= ``--goodput-band`` of clean capacity;
+    2. every non-completed request is accounted for by a typed ``shed``
+       record (queue-deadline / total-deadline / queue-full) — zero
+       silent drops, zero real failures;
+    3. the fleet queue and every engine queue stay bounded throughout
+       (asserted every round, not just at the end);
+    4. brownout fires under load (typed ``brownout`` records) and
+       resolves back to level 0 after it;
+    5. the circuit breaker opens on the injected admission failures and
+       closes again through a half-open probe;
+    6. every completed request's tokens are bitwise identical to its
+       clean-run reference (level-3-clamped requests: the bitwise
+       prefix) — degradation moves *which* requests complete and
+       *when*, never their tokens.
+    """
+    import jax
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import (
+        Engine,
+        ServeConfig,
+        ServeFleet,
+    )
+    from distributed_model_parallel_tpu.serve.scheduler import RequestState
+    from distributed_model_parallel_tpu.utils.telemetry import (
+        TelemetryRun,
+        read_records,
+    )
+    from scripts.dmp_report import build_report
+
+    rng = np.random.default_rng(seed)
+    n_replicas = 2
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots, page, chunk, max_len = 2, 8, 4, 64
+    base = dict(n_slots=n_slots, page_size=page,
+                n_pages=(n_slots + 1) * (-(-max_len // page)),
+                max_seq_len=max_len, prefill_chunk=chunk)
+    n_over, n_cool = 28, 8
+    population = [dict(
+        rid=f"o{i}",
+        prompt=[int(x) for x in rng.integers(0, 64,
+                                             int(rng.integers(4, 13)))],
+        gen=int(rng.integers(8, 25)),
+        priority="batch" if i % 3 == 2 else "interactive")
+        for i in range(n_over + n_cool)]
+
+    os.makedirs(workdir, exist_ok=True)
+    stream = os.path.join(workdir, "overload.jsonl")
+    tel = TelemetryRun(stream, run="overload-drill")
+    t0 = time.monotonic()
+    Engine(params, cfg, ServeConfig(**base), slo_metrics=False).warmup()
+
+    # -- phase A: clean capacity + per-request reference tokens
+    cap_fleet = ServeFleet(params, cfg, ServeConfig(**base), n_replicas,
+                           telemetry=tel)
+    for r in population:
+        cap_fleet.submit(r["prompt"], r["gen"], rid=r["rid"])
+    cap = cap_fleet.run()
+    cap_fleet.close()
+    if cap["requests_failed"] or cap["requests_shed"]:
+        raise RuntimeError("clean capacity run shed or failed requests")
+    reference = {q.rid: list(q.generated) for q in cap_fleet.results()}
+    capacity = cap["tokens_per_s"] or 0.0
+    wall_a = max(cap["wall_s"], 1e-3)
+
+    # -- phase B: the same population at 2x offered load + cool-down
+    mean_tokens = sum(len(v) for v in reference.values()) / len(reference)
+    t, arrivals = 0.0, []
+    for i in range(len(population)):
+        rate = ((2.0 if i < n_over else 0.3) * capacity / mean_tokens
+                if capacity else 1.0)
+        t += float(rng.exponential(1.0 / rate))
+        arrivals.append(t)
+    serve = ServeConfig(
+        **base,
+        # Budgets scale with the measured capacity run so the drill is
+        # machine-speed-independent; the absolute floors keep a very
+        # fast host from shedding on scheduler-granularity jitter.
+        queue_budget_s=max(0.15 * wall_a, 0.08),
+        deadline_s=max(1.2 * wall_a, 0.6),
+        max_queue=2 * n_slots,
+        brownout=True,
+        brownout_ttft_target_s=max(0.08 * wall_a, 0.05),
+        brownout_budget=0.25,
+        brownout_window_s=max(0.10 * wall_a, 0.1),
+        brownout_max_new=8,
+        brownout_hold_iters=4)
+    fleet = ServeFleet(params, cfg, serve, n_replicas, telemetry=tel,
+                       faults=("admission_fail@0:6",), fault_replica="r1")
+    queue_bounded = True
+
+    def hook(rnd):
+        nonlocal queue_bounded
+        if len(fleet._pending) > fleet._max_pending + len(population):
+            queue_bounded = False     # never: trim runs every round
+        arrived = sum(1 for r in fleet._pending if r.arrival_s <= fleet._now)
+        if arrived > fleet._max_pending + n_replicas:
+            queue_bounded = False     # slack: one round's arrivals
+        for rep in fleet.replicas:
+            if len(rep.engine.sched.queue) > serve.max_queue:
+                queue_bounded = False
+
+    fleet.step_hook = hook
+    for r, arr in zip(population, arrivals):
+        fleet.submit(r["prompt"], r["gen"], rid=r["rid"], arrival_s=arr,
+                     priority=r["priority"])
+    over = fleet.run()
+    tel.finish()
+    print(build_report(read_records(stream)))
+
+    results = {q.rid: q for q in fleet.results()}
+    eng0 = fleet.replicas[0].engine
+    # Goodput over the SATURATED window: up to the last phase-1
+    # request's completion (the cool-down tail intentionally
+    # under-offers, so whole-run tokens/s would understate the fleet).
+    phase1 = [results[r["rid"]] for r in population[:n_over]]
+    t_end = max((q.t_done for q in phase1 if q.t_done is not None),
+                default=None)
+    goodput = (sum(len(q.generated) for q in results.values()
+                   if q.state is RequestState.COMPLETED
+                   and eng0._in_deadline(q) and q.t_done is not None
+                   and q.t_done <= t_end) / t_end
+               if t_end else 0.0)
+    # Bitwise parity: completed tokens == the clean-run reference
+    # (level-3-clamped requests: its prefix).
+    mismatched = []
+    for q in results.values():
+        if q.state is not RequestState.COMPLETED:
+            continue
+        ref = reference[q.rid]
+        ok = (q.generated == ref[:len(q.generated)]
+              if q.max_new_requested is not None else q.generated == ref)
+        if not ok:
+            mismatched.append(q.rid)
+    # Typed accounting: every non-completed request sheds on the record.
+    recs = read_records(stream)
+    shed_recorded = {r.get("request") for r in recs
+                     if r.get("kind") == "shed"}
+    unaccounted = [q.rid for q in results.values()
+                   if q.state is not RequestState.COMPLETED
+                   and (q.shed_reason is None
+                        or q.rid not in shed_recorded)]
+    bo_recs = [r for r in recs if r.get("kind") == "brownout"]
+    bo_fired = any(r.get("level", 0) >= 1 for r in bo_recs)
+    bo_final = [rep.engine.brownout.level for rep in fleet.replicas]
+    brk = [r for r in recs if r.get("kind") == "breaker"]
+    breaker_cycled = (any(r.get("state") == "open"
+                          and r.get("replica") == "r1" for r in brk)
+                      and fleet.breaker.snapshot().get("r1") == "closed")
+    fleet.close()
+
+    completed = [q for q in results.values()
+                 if q.state is RequestState.COMPLETED]
+    out = {
+        "soak": "overload-campaign",
+        "scenario": "overload",
+        "seed": seed,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "capacity_tokens_per_s": round(capacity, 1),
+        "goodput_tokens_per_s": round(goodput, 1),
+        "goodput_fraction": (round(goodput / capacity, 3)
+                             if capacity else None),
+        "goodput_band": args.goodput_band,
+        "requests": len(population),
+        "completed": len(completed),
+        "shed_by_reason": over["shed_by_reason"],
+        "requests_rejected": over["requests_rejected"],
+        "requests_failed": over["requests_failed"],
+        "unaccounted": unaccounted,
+        "queue_bounded": queue_bounded,
+        "brownout_fired": bo_fired,
+        "brownout_final_levels": bo_final,
+        "brownout_transitions": len(bo_recs),
+        "breaker_cycled": breaker_cycled,
+        "token_mismatches": mismatched,
+        "clamped": sorted(q.rid for q in completed
+                          if q.max_new_requested is not None),
+        "telemetry": [stream],
+    }
+    ok = (goodput >= args.goodput_band * capacity
+          and not unaccounted
+          and over["requests_failed"] == 0
+          and queue_bounded
+          and bo_fired and all(lv == 0 for lv in bo_final)
+          and breaker_cycled
+          and not mismatched
+          # The drill must actually EXERCISE the shed path (a drill
+          # where nothing sheds proves nothing about typed accounting)
+          # while still completing a real fraction of the offered work.
+          and sum(over["shed_by_reason"].values()) >= 1
+          and len(completed) >= len(population) // 3)
+    return out, ok
+
+
 def run_long(args, workdir: str) -> tuple[dict, bool]:
     """Long mode: campaign after campaign with derived seeds until the
     wall-clock budget is spent; one failure fails the soak. At least one
     campaign always runs (a small ``--duration-s`` is the CI-bounded
     smoke of this very loop)."""
-    campaign = (run_degradation_campaign if args.scenario == "degradation"
-                else run_campaign)
+    campaign = {"degradation": run_degradation_campaign,
+                "overload": run_overload_campaign,
+                "chaos": run_campaign}[args.scenario]
     t0 = time.monotonic()
     campaigns, all_ok = [], True
     i = 0
@@ -554,7 +785,7 @@ def run_long(args, workdir: str) -> tuple[dict, bool]:
         campaigns.append({"seed": summary["seed"], "ok": ok,
                           "wall_s": summary["wall_s"],
                           "faults": summary.get("faults_injected", []),
-                          "unrecovered": summary["unrecovered"],
+                          "unrecovered": summary.get("unrecovered", []),
                           "unpaired": summary.get("faults_unpaired", [])})
         all_ok = all_ok and ok
         i += 1
@@ -568,8 +799,9 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     workdir = args.workdir or tempfile.mkdtemp(prefix="dmp_soak_")
     if args.mode == "fast":
-        campaign = (run_degradation_campaign
-                    if args.scenario == "degradation" else run_campaign)
+        campaign = {"degradation": run_degradation_campaign,
+                    "overload": run_overload_campaign,
+                    "chaos": run_campaign}[args.scenario]
         summary, ok = campaign(args, workdir, args.seed)
         print(json.dumps(summary), flush=True)
         return 0 if ok else 1

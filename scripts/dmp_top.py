@@ -56,6 +56,11 @@ class FleetState:
         # router assignment counts folded off the typed records.
         self.migrations: int = 0
         self.router_assignments: dict[str, int] = {}
+        # Overload protection (serve/overload.py): typed shed counts by
+        # reason, the live brownout level, and breaker states.
+        self.shed_by_reason: dict[str, int] = {}
+        self.brownout_level: int | None = None
+        self.breaker_states: dict[str, str] = {}
         # Untenanted streams (a plain trainer run) attribute their
         # records to the last run_start's run name.
         self._default_run = ""
@@ -125,6 +130,16 @@ class FleetState:
                 self.firing.pop(key, None)
         elif kind == "postmortem":
             self.postmortems.append(str(rec.get("bundle")))
+        elif kind == "shed":
+            reason = str(rec.get("reason"))
+            self.shed_by_reason[reason] = (
+                self.shed_by_reason.get(reason, 0) + 1)
+        elif kind == "brownout":
+            if isinstance(rec.get("level"), int):
+                self.brownout_level = rec["level"]
+        elif kind == "breaker":
+            self.breaker_states[str(rec.get("replica"))] = \
+                str(rec.get("state"))
         elif kind == "migration":
             self.migrations += 1
         elif kind == "router":
@@ -201,6 +216,16 @@ class FleetState:
                 + (" ".join(f"{k}:{v}" for k, v in
                             sorted(self.router_assignments.items()))
                    or "-"))
+        if (self.shed_by_reason or self.brownout_level
+                or self.breaker_states):
+            shed = (" ".join(f"{k}:{v}" for k, v in
+                             sorted(self.shed_by_reason.items())) or "-")
+            brk = (" ".join(f"{k}:{v}" for k, v in
+                            sorted(self.breaker_states.items())) or "-")
+            level = (self.brownout_level
+                     if self.brownout_level is not None else "-")
+            lines.append(f"overload  shed={shed}  brownout={level}  "
+                         f"breaker={brk}")
         if self.statusz is not None:
             if "error" in self.statusz:
                 lines.append(f"statusz: {self.statusz['error']}")
@@ -217,7 +242,9 @@ class FleetState:
                             f"/{prov.get('n_replicas')} live"
                             f"  pending={prov.get('pending')}"
                             f"  migrations={prov.get('migrations')}"
-                            f"  kills={prov.get('replica_kills')}")
+                            f"  kills={prov.get('replica_kills')}"
+                            + (f"  shed={prov.get('requests_shed')}"
+                               if prov.get("requests_shed") else ""))
                         for rname, rep in sorted(
                                 (prov.get("replicas") or {}).items()):
                             occ = rep.get("page_occupancy")
@@ -230,6 +257,9 @@ class FleetState:
                                    if isinstance(occ, (int, float))
                                    else "")
                                 + f"  routed={rep.get('assignments')}"
+                                + (f"  brk={rep.get('breaker')}"
+                                   if rep.get("breaker") not in
+                                   (None, "closed") else "")
                                 + f"  devices={rep.get('devices')}")
                         continue
                     if prov.get("workload") == "serve":
@@ -252,6 +282,13 @@ class FleetState:
                         if prov.get("spec_k"):
                             line += (f"  accept={acc:.2f}" if isinstance(
                                 acc, (int, float)) else "  accept=-")
+                        # live overload state (shed counts, brownout)
+                        if prov.get("requests_shed"):
+                            line += (f"  shed={prov.get('requests_shed')}"
+                                     f" (rej "
+                                     f"{prov.get('requests_rejected')})")
+                        if prov.get("brownout_level") is not None:
+                            line += f"  bo={prov.get('brownout_level')}"
                         lines.append(line)
                 spans = self.statusz.get("spans") or {}
                 for thread, stack in sorted(spans.items()):

@@ -272,7 +272,12 @@ def test_degradation_kinds_parse_and_sites():
     assert [s.kind for s in specs] == ["slow_device", "flaky_sync"]
     assert FAULT_SITES["slow_device"] == "step"
     assert FAULT_SITES["flaky_sync"] == "sync"
-    assert DEGRADATION_KINDS == {"slow_device", "flaky_sync"}
+    # PR 15 adds the serve-side degradations (slow_replica /
+    # admission_fail, served through the fleet — serve/fleet.py).
+    assert DEGRADATION_KINDS == {"slow_device", "flaky_sync",
+                                 "slow_replica", "admission_fail"}
+    assert FAULT_SITES["slow_replica"] == "serve"
+    assert FAULT_SITES["admission_fail"] == "admit"
 
 
 def test_slow_device_ramps_and_flaky_sync_is_intermittent(monkeypatch):

@@ -96,7 +96,8 @@ def test_serving_section_schema(stream):
     report = _load("dmp_report")
     data = report.build_report_data(telemetry.read_records(stream))
     s = data["serving"]
-    assert {"completed", "failed", "policies", "summaries"} == set(s)
+    assert {"completed", "failed", "policies", "summaries",
+            "shed", "brownout", "breaker"} == set(s)
     assert s["completed"] == 2 and s["failed"] == 0
     # one percentile block per policy, never blended
     assert set(s["policies"]) == {"continuous", "static"}
