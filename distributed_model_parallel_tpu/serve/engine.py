@@ -39,6 +39,7 @@ from distributed_model_parallel_tpu.serve.model import (
 from distributed_model_parallel_tpu.serve.paged_kv import (
     PagedKVCache,
     PagePoolError,
+    memory_gauges,
     share_granularity_for,
 )
 from distributed_model_parallel_tpu.serve.spec import NGramProposer
@@ -191,6 +192,15 @@ class Engine:
                                queue_budget_s=serve.queue_budget_s,
                                deadline_s=serve.deadline_s,
                                max_queue=serve.max_queue)
+        # Request-trace plane (docs/TRACING.md "Request tracing"): the
+        # scheduler's admission-side rtrace records go to this engine's
+        # stream, tagged with the replica origin in fleet mode so the
+        # timeline joiner can attribute them (and link migration hops)
+        # on the fleet's shared stream.
+        self.sched.sink = telemetry
+        self._trace_fields = ({"replica": replica}
+                              if replica is not None else {})
+        self.sched.trace_fields = self._trace_fields
         # Brownout ladder (serve/overload.py): per-engine, fed and
         # ticked once per iteration; None = feature off, zero cost.
         if serve.brownout:
@@ -366,7 +376,22 @@ class Engine:
                       arrival_s=float(arrival_s), seed=int(seed),
                       priority=priority, queue_budget_s=queue_budget_s,
                       deadline_s=deadline_s)
+        # Stamp the request trace at entry into the serving tier: every
+        # later rtrace record (admission, prefill, decode, terminal)
+        # rides this identity. No stream, no stamp — rtrace then no-ops
+        # everywhere downstream.
+        if self.telemetry is not None and req.trace_id is None:
+            req.trace_id = tracing.new_trace_id()
+            self._rtrace(req, "submitted", prompt_tokens=req.prompt_len,
+                         max_new_tokens=req.max_new_tokens,
+                         priority=req.priority)
         return self.enqueue(req)
+
+    def _rtrace(self, req: Request, event: str, **fields) -> None:
+        """Engine-side rtrace emission: this engine's stream as the
+        sink, tagged with the replica origin in fleet mode."""
+        tracing.rtrace(req, event, sink=self.telemetry,
+                       **self._trace_fields, **fields)
 
     def _validate_prompt(self, req: Request) -> None:
         bad = [t for t in req.prompt
@@ -419,6 +444,7 @@ class Engine:
         req.state = RequestState.FAILED
         req.shed_reason = reason
         req.error = f"rejected: {reason}"
+        self._rtrace(req, "shed", reason=reason, state="queued")
         self._requests.append(req)
         self._rejected += 1
         self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
@@ -463,7 +489,9 @@ class Engine:
                     # rejected draft's write) — the same boundary
                     # ``_complete`` trims before the prefix tree.
                     n_written = req.prompt_len + len(req.generated) - 1
-                k, v = self.cache.export_request(req.rid, n_written)
+                k, v = self.cache.export_request(
+                    req.rid, n_written, req=req, sink=self.telemetry,
+                    trace_fields=self._trace_fields)
                 req.resume = {
                     "k": k, "v": v, "n_written": n_written,
                     "state": ("decode" if req.state is RequestState.DECODE
@@ -598,21 +626,22 @@ class Engine:
                 self._shed(req, "total-deadline", now)
         bo = self.brownout
         if bo is not None:
+            from distributed_model_parallel_tpu.serve.overload import (
+                apply_max_new_cap,
+            )
+
             self.sched.prefill_chunks_per_iter = (
                 self.serve.prefill_chunks_per_iter
                 if bo.prefill_full_share else 1)
-            cap = bo.max_new_cap
-            if cap is not None:
-                # Clamp while waiting under level-3 brownout: the
-                # reservation shrinks BEFORE admission bills it. The
-                # clamp sticks (deterministic accounting); the clamped
-                # stream is the bitwise prefix of the unclamped one.
-                for r in self.sched.queue:
-                    if r.arrival_s <= now and r.max_new_tokens > cap \
-                            and r.resume is None:
-                        if r.max_new_requested is None:
-                            r.max_new_requested = r.max_new_tokens
-                        r.max_new_tokens = cap
+            # Clamp while waiting under level-3 brownout: the
+            # reservation shrinks BEFORE admission bills it. The clamp
+            # sticks (deterministic accounting); the clamped stream is
+            # the bitwise prefix of the unclamped one. Each newly
+            # clamped request gets a ``clamp`` rtrace record
+            # (serve/overload.py).
+            apply_max_new_cap(bo, self.sched.queue, now,
+                              sink=self.telemetry,
+                              trace_fields=self._trace_fields)
         for req in self.sched.admit(now):
             self._tables_np[req.slot] = self.cache.table_array(req.rid)
             if req.resume is not None:
@@ -702,7 +731,10 @@ class Engine:
             self.params, self.cache.ck, self.cache.cv, jnp.asarray(toks),
             jnp.int32(lo), jnp.int32(n_valid), table, key)
         req.prefill_cursor = lo + n_valid
-        if req.prefill_cursor >= req.prompt_len:
+        if req.prefill_cursor < req.prompt_len:
+            self._rtrace(req, "prefill", cursor=req.prefill_cursor,
+                         tokens=n_valid)
+        else:
             # Final chunk: its sampled token is the request's first
             # generated token (position t0) — TTFT stops here.
             first = int(jax.device_get(tok)[0])
@@ -710,6 +742,8 @@ class Engine:
             req.t_first_token = time.monotonic() - t0
             req.state = RequestState.DECODE
             self._record_ttft(req)
+            self._rtrace(req, "prefill", cursor=req.prefill_cursor,
+                         tokens=n_valid, ttft_s=self._ttft(req))
             # Every prompt position's KV is now written — offer the full
             # prompt pages to the prefix tree so the next request with
             # this prefix (the multi-turn case) admits warm.
@@ -759,9 +793,17 @@ class Engine:
         nxt = np.asarray(jax.device_get(nxt))
         self._decode_steps += 1
         self._decode_tokens += len(decoding)
+        # Memory-pressure gauges ride every decode rtrace, computed once
+        # per round (page state only moves on admission/eviction, never
+        # inside the round) — the attribution that tells a memory stall
+        # from a compute stall (ISSUE 16).
+        gauges = (memory_gauges(self.cache) if self.telemetry is not None
+                  else None)
         for req in decoding:
             tok = int(nxt[req.slot])
             req.generated.append(tok)
+            if gauges is not None:
+                self._rtrace(req, "decode", new_tokens=1, **gauges)
             if self._finished(req, tok):
                 self._complete(req, t0)
             else:
@@ -841,6 +883,8 @@ class Engine:
         out = np.asarray(jax.device_get(out))
         self._decode_steps += 1
         round_proposed = round_accepted = 0
+        gauges = (memory_gauges(self.cache) if self.telemetry is not None
+                  else None)
         for req in decoding:
             s = req.slot
             draft = drafts[req.rid]
@@ -868,6 +912,10 @@ class Engine:
                     self._spec_streak[req.rid] = 0
             else:
                 self._shadow_score(req, emitted[0])
+            if gauges is not None:
+                self._rtrace(req, "decode", new_tokens=len(emitted),
+                             spec_proposed=len(draft),
+                             spec_accepted=accepted, **gauges)
             if self._finished(req, emitted[-1]):
                 self._complete(req, t0)
             else:
@@ -929,7 +977,13 @@ class Engine:
             reg.counter("serve_requests_completed").inc()
             reg.counter("serve_tokens_generated").inc(len(req.generated))
             if token_s is not None:
-                reg.histogram("serve_token_latency_s").observe(token_s)
+                reg.histogram("serve_token_latency_s").observe(
+                    token_s, exemplar=req.trace_id)
+        self._rtrace(req, "completed", new_tokens=len(req.generated),
+                     ttft_s=self._ttft(req),
+                     queue_wait_s=self._queue_wait(req),
+                     token_latency_s=token_s,
+                     wall_s=req.t_done - req.arrival_s)
         if self.telemetry is not None:
             self.telemetry.record(
                 "serve", event="completed", request=req.rid,
@@ -964,6 +1018,14 @@ class Engine:
             registry().counter("serve_shed_total").inc()
             if reason == "queue-full":
                 registry().counter("serve_rejected_total").inc()
+        # Typed terminal rtrace: deadline expiries are ``expired``,
+        # everything else (queue-full displacement) is ``shed`` — the
+        # joiner requires exactly one terminal event per trace.
+        self._rtrace(req,
+                     "expired" if reason in ("total-deadline",
+                                             "queue-deadline") else "shed",
+                     reason=reason, state=state_at,
+                     waited_s=round(max(0.0, now - req.arrival_s), 4))
         if self.telemetry is not None:
             self.telemetry.record(
                 "shed", request=req.rid, reason=reason,
@@ -989,6 +1051,7 @@ class Engine:
             self._spec_live.pop(req.rid, None)
             req.state = RequestState.FAILED
             req.error = f"engine-killed: {detail}"
+            self._rtrace(req, "failed", error="engine-killed")
             if self._slo_metrics:
                 registry().counter("serve_requests_failed").inc()
             if self.telemetry is not None:
@@ -1016,12 +1079,14 @@ class Engine:
     def _record_queue_wait(self, req: Request) -> None:
         w = self._queue_wait(req)
         if w is not None and self._slo_metrics:
-            registry().histogram("serve_queue_wait_s").observe(w)
+            registry().histogram("serve_queue_wait_s").observe(
+                w, exemplar=req.trace_id)
 
     def _record_ttft(self, req: Request) -> None:
         t = self._ttft(req)
         if t is not None and self._slo_metrics:
-            registry().histogram("serve_ttft_s").observe(t)
+            registry().histogram("serve_ttft_s").observe(
+                t, exemplar=req.trace_id)
 
     def _in_deadline(self, req: Request) -> bool:
         """Did this completed request land within its total deadline?

@@ -323,6 +323,16 @@ class ServeFleet:
                              f"[0, {ref.cfg.vocab_size})")
         self._ids.add(rid)
         self._requests.append(req)
+        # Stamp the request trace at fleet admission — the identity that
+        # survives routing, migration between replicas, and brownout
+        # clamps (docs/TRACING.md "Request tracing"). Fleet-level rtrace
+        # records carry no ``replica`` field: their origin IS the fleet.
+        if self.telemetry is not None:
+            req.trace_id = tracing.new_trace_id()
+            tracing.rtrace(req, "submitted", sink=self.telemetry,
+                           prompt_tokens=req.prompt_len,
+                           max_new_tokens=req.max_new_tokens,
+                           priority=req.priority)
         # The bound rejects ALREADY-ARRIVED submissions against the live
         # arrived backlog (the runaway-client case); future-dated
         # open-loop trace entries enqueue and the per-round trim
@@ -373,6 +383,13 @@ class ServeFleet:
         req.state = RequestState.FAILED
         req.shed_reason = reason
         req.error = f"shed: {reason}"
+        tracing.rtrace(req,
+                       "expired" if reason in ("total-deadline",
+                                               "queue-deadline")
+                       else "shed",
+                       sink=self.telemetry, reason=reason, state="queued",
+                       **({"waited_s": round(waited_s, 4)}
+                          if waited_s is not None else {}))
         self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
         if reason == "queue-full":
             self._rejected += 1
@@ -553,7 +570,9 @@ class ServeFleet:
             if placed is None:
                 break                 # nobody would take it: next round
             rep, reason, loads = placed
-            self.router.commit(rep.name, reason)
+            self.router.commit(
+                rep.name, reason, request=req, sink=self.telemetry,
+                loads={k: round(v, 3) for k, v in sorted(loads.items())})
             self._pending.remove(req)
             if self._slo_metrics:
                 registry().counter("serve_router_assignments").inc()
@@ -669,6 +688,8 @@ class ServeFleet:
             req.error = (f"fleet-killed: replica {source.name} quarantined "
                          f"with no live peer")
             req.resume = None
+            tracing.rtrace(req, "failed", sink=self.telemetry,
+                           error="no-live-replica")
             if self._slo_metrics:
                 registry().counter("serve_requests_failed").inc()
             if self.telemetry is not None:
@@ -686,7 +707,8 @@ class ServeFleet:
                       if self.breaker.allows(r.name, self._rounds)] or live
         self._emit_breaker_records()
         target, reason, loads = self.router.pick(req.prompt, candidates,
-                                                 migrate=True)
+                                                 migrate=True, request=req,
+                                                 sink=self.telemetry)
         pages = int(req.resume["k"].shape[1]) if req.resume else 0
         target.engine.enqueue(req, force=True)
         self._migrations += 1
@@ -736,6 +758,8 @@ class ServeFleet:
             req = self._pending.popleft()
             req.state = RequestState.FAILED
             req.error = f"fleet-killed: {detail}"
+            tracing.rtrace(req, "failed", sink=self.telemetry,
+                           error="fleet-killed")
             if self._slo_metrics:
                 registry().counter("serve_requests_failed").inc()
             if self.telemetry is not None:

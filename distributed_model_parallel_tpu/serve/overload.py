@@ -44,8 +44,10 @@ from distributed_model_parallel_tpu.utils.alerts import (
     BurnRate,
     GaugeCeiling,
 )
+from distributed_model_parallel_tpu.utils import tracing
 
-__all__ = ["BrownoutController", "CircuitBreaker", "LADDER"]
+__all__ = ["BrownoutController", "CircuitBreaker", "LADDER",
+           "apply_max_new_cap"]
 
 # The degradation ladder, mildest first; level N applies steps [0, N).
 LADDER = ("spec-off", "prefill-share", "clamp-max-new")
@@ -175,6 +177,32 @@ class BrownoutController:
         return {"level": self.level,
                 "max_level_seen": self.max_level_seen,
                 "transitions": len(self.transitions)}
+
+
+def apply_max_new_cap(brown: BrownoutController, queue, now: float,
+                      sink=None, trace_fields=None) -> int:
+    """Apply the level-3 brownout clamp to arrived queued requests: cap
+    ``max_new_tokens`` at the controller's ``max_new_cap``, remembering
+    the original ask in ``max_new_requested``. Migrated-in requests
+    (``resume`` payload) are exempt — their generation length is already
+    committed on the source replica. Each newly clamped request gets a
+    ``clamp`` rtrace record (the brownout's per-request attribution);
+    returns how many were clamped this pass. A no-op below level 3."""
+    cap = brown.max_new_cap
+    if cap is None:
+        return 0
+    clamped = 0
+    for r in queue:
+        if r.arrival_s <= now and r.max_new_tokens > cap \
+                and r.resume is None:
+            if r.max_new_requested is None:
+                r.max_new_requested = r.max_new_tokens
+            r.max_new_tokens = cap
+            clamped += 1
+            tracing.rtrace(r, "clamp", sink=sink, cap=cap, level=3,
+                           requested=r.max_new_requested,
+                           **(trace_fields or {}))
+    return clamped
 
 
 # ---------------------------------------------------------------------------

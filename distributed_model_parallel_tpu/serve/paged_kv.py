@@ -43,6 +43,8 @@ from collections import deque
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_model_parallel_tpu.utils import tracing
+
 
 class PagePoolError(RuntimeError):
     """A page-accounting invariant was violated (double alloc/free) or an
@@ -67,6 +69,10 @@ class PagePool:
         self.n_pages = n_pages
         self._free: deque[int] = deque(range(n_pages))
         self._refs: dict[int, int] = {}
+        # Low-water mark of the free list over the pool's lifetime — the
+        # memory-pressure gauge rtrace decode records carry (how close
+        # did this pool ever come to stalling admission).
+        self.free_watermark = n_pages
 
     @property
     def free_pages(self) -> int:
@@ -95,6 +101,8 @@ class PagePool:
         pages = [self._free.popleft() for _ in range(n)]
         for p in pages:
             self._refs[p] = 1
+        if len(self._free) < self.free_watermark:
+            self.free_watermark = len(self._free)
         return pages
 
     def retain(self, pages: list[int]) -> None:
@@ -314,7 +322,8 @@ class PagedKVCache:
 
     # -- live request migration (serve/fleet.py) -----------------------------
 
-    def export_request(self, sid, n_tokens: int):
+    def export_request(self, sid, n_tokens: int, *, req=None, sink=None,
+                       trace_fields=None):
         """Serialize the K/V **contents** of ``sid``'s first ``n_tokens``
         written positions to host arrays ``(k, v)`` of shape
         ``[L, pages, page_size, Hkv, Dh]`` — whole pages, values only.
@@ -323,7 +332,9 @@ class PagedKVCache:
         allocates fresh pages; see :meth:`import_request`). The caller
         guarantees every exported position's KV is actually written —
         the engine's drain hook passes the committed-and-written prefix
-        (serve/engine.py ``drain``)."""
+        (serve/engine.py ``drain``). When the caller passes the traced
+        ``req`` (and its stream ``sink``), the hop's source half lands
+        on the request timeline as an ``export`` rtrace record."""
         table = self._tables[sid]
         n = self.pages_needed(n_tokens)
         if n > len(table):
@@ -336,16 +347,22 @@ class PagedKVCache:
             (self.cfg.n_layers, 0, self.page_size, self.ck.shape[3],
              self.ck.shape[4]), self.ck.dtype)
         v = np.asarray(self.cv[:, idx]) if n else np.zeros_like(k)
+        if req is not None:
+            tracing.rtrace(req, "export", sink=sink, pages=n,
+                           n_tokens=n_tokens, **(trace_fields or {}))
         return k, v
 
-    def import_request(self, sid, k, v, capacity: int) -> bool:
+    def import_request(self, sid, k, v, capacity: int, *,
+                       req=None, sink=None, trace_fields=None) -> bool:
         """Admit a migrated sequence: reserve ``capacity`` positions of
         **fresh** pages (evicting tree-only pages if the room is needed
         — the exported KV is authoritative, so nothing is shared on
         arrival) and write the exported page contents into them. Returns
         ``False`` without side effects when the reservation does not
         fit — the scheduler keeps the request queued, exactly like a
-        cold admission that finds no pages."""
+        cold admission that finds no pages. A traced ``req``/``sink``
+        records the hop's destination half (an ``import`` rtrace) on
+        success only — a bounced import is queue time, not a hop."""
         need = self.pages_needed(capacity)
         avail = self.pool.free_pages
         if self.prefix is not None:
@@ -368,6 +385,9 @@ class PagedKVCache:
                 jnp.asarray(k).astype(self.ck.dtype))
             self.cv = self.cv.at[:, idx].set(
                 jnp.asarray(v).astype(self.cv.dtype))
+        if req is not None:
+            tracing.rtrace(req, "import", sink=sink, pages=n,
+                           **(trace_fields or {}))
         return True
 
     def cached_prefix_tokens(self, tokens: list[int]) -> int:
@@ -387,6 +407,21 @@ class PagedKVCache:
         if self.prefix is None:
             return 0
         return len(self.prefix.evict(len(self.prefix)))
+
+
+def memory_gauges(cache: PagedKVCache) -> dict:
+    """The memory-pressure snapshot rtrace ``decode`` records carry
+    (docs/TRACING.md "Request tracing"): pool occupancy, free/used page
+    counts, pages resident under the prefix radix tree, and the pool's
+    lifetime free-list low-water mark — enough to tell a latency stall
+    caused by page pressure from one caused by compute."""
+    return {
+        "occupancy": cache.occupancy,
+        "free_pages": cache.pool.free_pages,
+        "used_pages": cache.pool.used_pages,
+        "prefix_pages": len(cache.prefix) if cache.prefix is not None else 0,
+        "free_watermark": cache.pool.free_watermark,
+    }
 
 
 def share_granularity_for(page_size: int, prefill_chunk: int) -> int:

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import random
 
+from distributed_model_parallel_tpu.utils import tracing
+
 __all__ = ["Router"]
 
 
@@ -61,8 +63,8 @@ class Router:
                 + eng.cache.occupancy)
 
     def pick(self, prompt: list[int], replicas: list, *,
-             migrate: bool = False,
-             commit: bool = True) -> tuple[object, str, dict]:
+             migrate: bool = False, commit: bool = True,
+             request=None, sink=None) -> tuple[object, str, dict]:
         """Choose a live replica for ``prompt``. Returns ``(replica,
         reason, loads)`` where reason is ``affinity`` (prefix-cache
         match won), ``p2c`` (power-of-two-choices), ``only`` (one
@@ -72,7 +74,10 @@ class Router:
         assignment bookkeeping to an explicit :meth:`commit` — the
         fleet's dispatch path, where an admission can still be refused
         (bounded queue, circuit breaker, injected chaos) and a refused
-        pick must not inflate the assignment counts."""
+        pick must not inflate the assignment counts. A traced
+        ``request``/``sink`` puts the landed decision on the request
+        timeline as a ``route`` rtrace record — emitted at commit time,
+        so a refused pick never fakes a hop."""
         if not replicas:
             raise ValueError("no live replica to route to")
         loads = {r.name: self.load(r) for r in replicas}
@@ -87,15 +92,22 @@ class Router:
         else:
             chosen, reason = self._pick_new(prompt, replicas, loads)
         if commit:
-            self.commit(chosen.name, reason)
+            self.commit(chosen.name, reason, request=request, sink=sink,
+                        loads=loads)
         return chosen, reason, loads
 
-    def commit(self, name: str, reason: str) -> None:
+    def commit(self, name: str, reason: str, *, request=None, sink=None,
+               loads: dict | None = None) -> None:
         """Count an assignment that actually LANDED (the engine accepted
-        the request)."""
+        the request); a traced ``request`` gets its ``route`` rtrace
+        record here."""
         self.assignments[name] = self.assignments.get(name, 0) + 1
         if reason == "affinity":
             self.affinity_hits += 1
+        if request is not None:
+            fields = {"loads": loads} if loads is not None else {}
+            tracing.rtrace(request, "route", sink=sink, replica=name,
+                           reason=reason, **fields)
 
     def _pick_new(self, prompt, replicas, loads):
         best_aff, aff_rep = 0, None

@@ -33,7 +33,6 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
-import time
 from collections import deque
 from typing import Any
 
@@ -153,6 +152,18 @@ class Request:                     # objects in slots/queues, not values
     # at its exact committed position.
     resume: dict | None = None
     migrations: int = 0              # times this request moved replicas
+    # Request tracing (docs/TRACING.md "Request tracing"): the identity
+    # stamped once at admission into the serving tier, and the
+    # per-request causal sequence number ``utils.tracing.rtrace``
+    # increments per record. The Request OBJECT migrates between
+    # replicas, so the sequence stays monotonic across the hop — the
+    # timeline joiner links the two stream segments by (trace, seq).
+    trace_id: str | None = None
+    trace_seq: int = 0
+    # Dedup flag for the ``memory_stall`` rtrace event: set on the first
+    # head-of-line page-pressure block, cleared when the request finally
+    # admits — one event per stall episode, not one per iteration.
+    mem_stalled: bool = False
 
     @property
     def prompt_len(self) -> int:
@@ -234,6 +245,14 @@ class Scheduler:
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * n_slots
         self._ids: set[str] = set()
+        # Request-trace sink: the engine points this at its telemetry
+        # stream so admission's per-request ``rtrace`` records land even
+        # when the scheduler runs outside a ``tracing.sink_scope``; a
+        # fleet replica's engine also sets ``trace_fields`` to tag every
+        # record with its origin (``{"replica": name}``) — the joiner
+        # links migration hops by origin change (utils/telemetry.py).
+        self.sink = None
+        self.trace_fields: dict = {}
 
     # -- submission ---------------------------------------------------------
 
@@ -311,70 +330,87 @@ class Scheduler:
         """Move arrived queue-head requests into free slots (continuous),
         or refill the whole batch once it has fully drained (static).
         Allocates every admitted request's full page reservation. An
-        admission that placed someone records a span (utils/tracing.py)
-        so the page-table writes show up on the engine timeline; idle
-        passes stay span-free (one per engine iteration would drown the
-        trace)."""
+        admission pass with a live queue records a span (utils/tracing.py)
+        so the page-table writes show up on the engine timeline; empty
+        passes stay span-free (one per idle engine iteration would drown
+        the trace), and per-request attribution rides on the ``rtrace``
+        plane — one ``admitted`` record per placed request, plus a
+        deduplicated ``memory_stall`` when the queue head blocks on
+        page pressure (docs/TRACING.md "Request tracing")."""
         if self.policy == "static" and any(
                 r is not None for r in self.slots):
             return []
-        # Clock reads only when a span could actually be recorded — this
-        # runs once per engine iteration, and the tracing-off contract is
-        # "no clock call" (utils/tracing.py).
-        trace = tracing.installed() is not None and tracing.enabled()
-        if trace:
-            t0m = time.monotonic()
-            t0w = time.time()
+        if not self.queue:
+            return []
         admitted: list[Request] = []
-        for slot in range(self.n_slots):
-            if self.slots[slot] is not None:
-                continue
-            req = self._next_admittable(now)
-            if req is None:
-                break
-            if req.resume is not None:
-                # A migrated-in request: its exported KV is
-                # authoritative, so the reservation is all fresh pages
-                # (no prefix sharing on arrival) with the payload's page
-                # contents written back in — same backpressure contract
-                # as a cold admission (False -> keep queuing, no side
-                # effects).
-                if not self.cache.import_request(
-                        req.rid, req.resume["k"], req.resume["v"],
-                        req.total_capacity):
-                    break                  # head-of-line: wait for pages
-            else:
-                # One-pass fit check + admission (try_admit peeks the
-                # POST-SHARING bill — a cached prefix's pages are
-                # retained, not allocated, and tree-only pages count as
-                # reclaimable — and only when it fits performs the
-                # reservation; no second radix match / evictable walk on
-                # the hot path). A cold request on a warm pool queues
-                # exactly when its full reservation exceeds free +
-                # evictable (tests/test_prefix_cache.py pins the
-                # regression).
-                got = self.cache.try_admit(req.rid, req.prompt,
-                                           req.total_capacity)
-                if got is None:
-                    break                  # head-of-line: wait for pages
-                req.cached_prompt_tokens = got
-            self.queue.remove(req)
-            req.slot = slot
-            req.state = RequestState.PREFILL
-            if req.t_admitted is None:
-                # First admission only: a migrated request keeps its
-                # original admission stamp — queue-wait and the
-                # pre/post-kill TTFT split in BENCH_serve fleet mode
-                # both mean "when did this request first get a slot",
-                # not "when did it land on its latest replica".
-                req.t_admitted = now
-            self.slots[slot] = req
-            admitted.append(req)
-        if admitted and trace:
-            tracing.record_span(
-                "admit", time.monotonic() - t0m, t0=t0w, n=len(admitted),
-                requests=",".join(r.rid for r in admitted))
+        with tracing.span("admit") as sp:
+            for slot in range(self.n_slots):
+                if self.slots[slot] is not None:
+                    continue
+                req = self._next_admittable(now)
+                if req is None:
+                    break
+                if req.resume is not None:
+                    # A migrated-in request: its exported KV is
+                    # authoritative, so the reservation is all fresh pages
+                    # (no prefix sharing on arrival) with the payload's
+                    # page contents written back in — same backpressure
+                    # contract as a cold admission (False -> keep queuing,
+                    # no side effects).
+                    if not self.cache.import_request(
+                            req.rid, req.resume["k"], req.resume["v"],
+                            req.total_capacity, req=req, sink=self.sink,
+                            trace_fields=self.trace_fields):
+                        self._note_memory_stall(req)
+                        break              # head-of-line: wait for pages
+                else:
+                    # One-pass fit check + admission (try_admit peeks the
+                    # POST-SHARING bill — a cached prefix's pages are
+                    # retained, not allocated, and tree-only pages count
+                    # as reclaimable — and only when it fits performs the
+                    # reservation; no second radix match / evictable walk
+                    # on the hot path). A cold request on a warm pool
+                    # queues exactly when its full reservation exceeds
+                    # free + evictable (tests/test_prefix_cache.py pins
+                    # the regression).
+                    got = self.cache.try_admit(req.rid, req.prompt,
+                                               req.total_capacity)
+                    if got is None:
+                        self._note_memory_stall(req)
+                        break              # head-of-line: wait for pages
+                    req.cached_prompt_tokens = got
+                self.queue.remove(req)
+                req.slot = slot
+                req.state = RequestState.PREFILL
+                if req.t_admitted is None:
+                    # First admission only: a migrated request keeps its
+                    # original admission stamp — queue-wait and the
+                    # pre/post-kill TTFT split in BENCH_serve fleet mode
+                    # both mean "when did this request first get a slot",
+                    # not "when did it land on its latest replica".
+                    req.t_admitted = now
+                self.slots[slot] = req
+                admitted.append(req)
+                req.mem_stalled = False    # stall episode (if any) ended
+                tracing.rtrace(
+                    req, "admitted", sink=self.sink, slot=slot,
+                    cached_tokens=req.cached_prompt_tokens,
+                    resumed=req.resume is not None, **self.trace_fields)
+            sp.annotate(n=len(admitted))
         return admitted
+
+    def _note_memory_stall(self, req: Request) -> None:
+        """One ``memory_stall`` rtrace per stall episode: emitted when the
+        queue-head request first blocks on page pressure, re-armed only
+        after it admits — attribution for latency that is memory, not
+        compute (ISSUE 16 memory-pressure telemetry)."""
+        if req.mem_stalled:
+            return
+        req.mem_stalled = True
+        tracing.rtrace(req, "memory_stall", sink=self.sink,
+                       free_pages=self.cache.pool.free_pages,
+                       need_capacity=req.total_capacity,
+                       **self.trace_fields)
 
     def _next_admittable(self, now: float) -> Request | None:
         """The next admission candidate (:func:`next_arrived_by_class`).

@@ -162,7 +162,12 @@ def prometheus_text() -> str:
     (label ``tenant``); gauges render when set; histograms render as
     summaries — ``{quantile="0.5|0.9|0.99"}`` series plus ``_count`` and
     ``_sum`` — matching the interpolated bucket quantiles the telemetry
-    snapshot reports."""
+    snapshot reports, PLUS true cumulative ``_bucket{le="..."}`` series
+    (ending at ``le="+Inf"`` == ``_count``) so an external scraper can
+    compute its own quantiles. Buckets that captured an exemplar (e.g. a
+    request ``trace_id`` — serve/engine.py passes them on the TTFT /
+    queue-wait / token-latency observations) carry an OpenMetrics-style
+    exemplar suffix: ``... # {trace_id="..."} <value>``."""
     lines: list[str] = []
     seen_types: set[str] = set()
     for name, tags, metric in registry().items():
@@ -192,6 +197,23 @@ def prometheus_text() -> str:
                         f"{v:g}")
             lines.append(f"{name}_count{_labels(tags)} {metric.count}")
             lines.append(f"{name}_sum{_labels(tags)} {metric.sum:g}")
+            # Cumulative buckets: counts[i] is the per-bucket tally for
+            # le=bounds[i] (the trailing slot is the +Inf overflow), so
+            # the running sum is the Prometheus-native cumulative form.
+            cum = 0
+            for i, bound in enumerate(metric.bounds):
+                cum += metric.counts[i]
+                line = (f"{name}_bucket"
+                        f"{_labels(tags, le=f'{bound:g}')} {cum}")
+                ex = metric.exemplars.get(i)
+                if ex is not None:
+                    line += f' # {{trace_id="{_esc(ex[0])}"}} {ex[1]:g}'
+                lines.append(line)
+            line = f"{name}_bucket{_labels(tags, le='+Inf')} {metric.count}"
+            ex = metric.exemplars.get(len(metric.bounds))
+            if ex is not None:
+                line += f' # {{trace_id="{_esc(ex[0])}"}} {ex[1]:g}'
+            lines.append(line)
     return "\n".join(lines) + "\n"
 
 

@@ -129,6 +129,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "RTRACE_TERMINAL_EVENTS",
     "StreamFollower",
     "TelemetryRun",
     "current_tenant",
@@ -136,6 +137,7 @@ __all__ = [
     "device_memory_snapshot",
     "follow_records",
     "install_compile_tracking",
+    "join_request_traces",
     "live_runs",
     "merge_streams",
     "read_records",
@@ -203,9 +205,14 @@ class Histogram:
 
     Exact ``count``/``sum``/``min``/``max``; quantiles come from the bucket
     cumulative counts with linear interpolation inside the crossing bucket.
+    ``observe(v, exemplar=...)`` keeps the last exemplar label (a request
+    trace id) per bucket, so the /metrics exposition can attach an
+    OpenMetrics-style exemplar to each ``_bucket`` series — the hook that
+    lets "p99 TTFT regressed" link straight to a traceable request.
     """
 
-    __slots__ = ("bounds", "counts", "count", "sum", "min", "max")
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max",
+                 "exemplars")
 
     def __init__(self, bounds: Iterable[float] | None = None):
         self.bounds = tuple(sorted(bounds or DEFAULT_TIME_BUCKETS))
@@ -216,8 +223,10 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # bucket index -> (exemplar label, observed value); last wins.
+        self.exemplars: dict[int, tuple[str, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
         self.count += 1
         self.sum += v
@@ -225,11 +234,14 @@ class Histogram:
         self.max = max(self.max, v)
         # First bound >= v (linear scan: bucket counts are small and this
         # is host-side bookkeeping, not a hot loop).
+        idx = len(self.counts) - 1
         for i, b in enumerate(self.bounds):
             if v <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+                idx = i
+                break
+        self.counts[idx] += 1
+        if exemplar is not None:
+            self.exemplars[idx] = (str(exemplar), v)
 
     def percentile(self, q: float) -> float | None:
         """Interpolated q-th percentile (q in [0, 100]); None when empty."""
@@ -595,6 +607,143 @@ def merge_streams(paths: Iterable[str]) -> list[dict]:
             order += 1
     merged.sort(key=lambda t: (t[0], t[1]))
     return [r for _, _, r in merged]
+
+
+# ---------------------------------------------------------------------------
+# Request-trace joining (the serving tier's per-request X-ray)
+# ---------------------------------------------------------------------------
+
+# Events that END a request's timeline — every admitted request must
+# terminate in exactly one of these, or the trace is an orphan (the
+# dmp_soak drill gates and scripts/dmp_xray.py --gate enforce it).
+RTRACE_TERMINAL_EVENTS = frozenset({"completed", "shed", "expired",
+                                    "failed"})
+
+# Events a request emits while it is still waiting (before any prefill
+# work) — the interval LEADING INTO one of these is queue time.
+_RTRACE_QUEUE_EVENTS = frozenset({"submitted", "route", "admitted",
+                                  "clamp", "memory_stall", "shed",
+                                  "expired", "failed"})
+
+
+def _rtrace_origin(rec: dict) -> str:
+    """Which emitter a record came from — the ``replica`` field in fleet
+    mode (the fleet and its replica engines share one stream), falling
+    back to the physical-stream tag dmp_xray stamps when joining several
+    files. Migration hops link where this changes across an
+    export/import pair."""
+    v = rec.get("replica")
+    if v is None:
+        v = rec.get("stream")
+    return str(v) if v is not None else ""
+
+
+def _rtrace_phase(prev: dict, nxt: dict, clamped: bool,
+                  prefilled: bool) -> str:
+    """Attribute the interval between two consecutive (by seq) rtrace
+    events to one phase. The rules partition a trace's whole ts span, so
+    per-phase seconds sum exactly to the timeline's wall time."""
+    pe, ne = prev.get("event"), nxt.get("event")
+    if pe == "export" or ne == "import":
+        return "migration-pause"
+    if pe == "memory_stall":
+        return "memory-stall"
+    if ne == "prefill":
+        return "prefill"
+    if ne in _RTRACE_QUEUE_EVENTS and not prefilled:
+        return "queue"
+    if ne in ("decode", "completed") or (ne in RTRACE_TERMINAL_EVENTS
+                                         and prefilled):
+        return "brownout-clamp" if clamped else "decode"
+    return "other"
+
+
+def join_request_traces(records: Iterable[dict]) -> dict[str, dict]:
+    """Fold ``rtrace`` records (one or more merged streams) into causally
+    ordered per-request timelines, keyed by trace id.
+
+    Ordering is by the per-request ``seq`` stamped at emission — NOT by
+    ``ts`` — so two events inside one engine iteration (identical wall
+    stamps) and events split across replica streams by a migration still
+    reconstruct in their true causal order. Each timeline carries:
+
+    * ``events`` — the records, seq-ordered;
+    * ``terminal`` — the single terminal event name (completed / shed /
+      expired / failed), or None;
+    * ``hops`` — migration hops, linked wherever an ``export`` is
+      followed (by seq; the migration re-route record may intervene)
+      by an ``import`` whose emitting replica/stream differs:
+      ``{seq, from, to}``;
+    * ``orphan`` / ``orphan_reasons`` — a seq gap (a lost span), zero
+      terminals (a silently dropped request) or more than one (a
+      double-accounted one);
+    * ``phases`` — seconds per phase (queue / prefill / decode /
+      brownout-clamp / migration-pause / memory-stall / other) from an
+      interval partition of the event timestamps: phases sum exactly to
+      ``wall_s`` (= last ts - first ts) by construction.
+    """
+    by_trace: dict[str, list[dict]] = {}
+    for r in records:
+        if r.get("kind") != "rtrace" or r.get("trace") is None:
+            continue
+        by_trace.setdefault(str(r["trace"]), []).append(r)
+    out: dict[str, dict] = {}
+    for trace, evs in by_trace.items():
+        evs.sort(key=lambda r: (r.get("seq") or 0))
+        seqs = [int(r.get("seq") or 0) for r in evs]
+        reasons: list[str] = []
+        if seqs != list(range(1, len(evs) + 1)):
+            reasons.append("seq-gap")
+        terminals = [r for r in evs
+                     if r.get("event") in RTRACE_TERMINAL_EVENTS]
+        if not terminals:
+            reasons.append("no-terminal")
+        elif len(terminals) > 1:
+            reasons.append("multiple-terminals")
+        # Pair each export with the NEXT import (the migration re-route
+        # emits a ``route`` record between them, so strict adjacency
+        # would miss the hop).
+        hops = []
+        pending_export = None
+        for r in evs:
+            if r.get("event") == "export":
+                pending_export = r
+            elif r.get("event") == "import" and pending_export is not None:
+                if _rtrace_origin(pending_export) != _rtrace_origin(r):
+                    hops.append({"seq": pending_export.get("seq"),
+                                 "from": _rtrace_origin(pending_export),
+                                 "to": _rtrace_origin(r)})
+                pending_export = None
+        phases: dict[str, float] = {}
+        clamped = prefilled = False
+        for a, b in zip(evs, evs[1:]):
+            phase = _rtrace_phase(a, b, clamped, prefilled)
+            ta, tb = a.get("ts"), b.get("ts")
+            dt = (max(0.0, tb - ta)
+                  if isinstance(ta, (int, float))
+                  and isinstance(tb, (int, float)) else 0.0)
+            phases[phase] = phases.get(phase, 0.0) + dt
+            if a.get("event") == "clamp":
+                clamped = True
+            if a.get("event") == "prefill":
+                prefilled = True
+        ts = [r["ts"] for r in evs
+              if isinstance(r.get("ts"), (int, float))]
+        out[trace] = {
+            "trace": trace,
+            "request": evs[0].get("request"),
+            "events": evs,
+            "terminal": (terminals[0].get("event") if len(terminals) == 1
+                         else None),
+            "hops": hops,
+            "orphan": bool(reasons),
+            "orphan_reasons": reasons,
+            "phases": phases,
+            "t0": min(ts) if ts else None,
+            "t1": max(ts) if ts else None,
+            "wall_s": (max(ts) - min(ts)) if ts else 0.0,
+        }
+    return out
 
 
 # ---------------------------------------------------------------------------

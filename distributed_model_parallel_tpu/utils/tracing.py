@@ -52,7 +52,9 @@ __all__ = [
     "install",
     "installed",
     "live_spans",
+    "new_trace_id",
     "record_span",
+    "rtrace",
     "set_enabled",
     "sink_scope",
     "span",
@@ -157,11 +159,10 @@ def _stack() -> list:
 def record_span(name: str, dur_s: float, *, t0: float | None = None,
                 sink=None, **attrs: Any) -> None:
     """Imperative form: write one ``span`` record for an interval timed
-    by the caller (sites where the span's worth is only known at the end
-    — e.g. the scheduler's admit pass records a span only when it
-    admitted someone, not once per idle iteration). Parent/depth come
-    from the thread's live span stack, so imperative spans nest under
-    whatever ``with span(...)`` is open."""
+    by the caller (sites where the interval already exists as a number
+    and wrapping the work in a context manager would restructure it).
+    Parent/depth come from the thread's live span stack, so imperative
+    spans nest under whatever ``with span(...)`` is open."""
     sink = sink if sink is not None else installed()
     if sink is None or not _enabled:
         return
@@ -176,6 +177,47 @@ def record_span(name: str, dur_s: float, *, t0: float | None = None,
     except Exception:
         # A stale/unwritable sink must not take down the recording site:
         # spans are observability, not control flow.
+        pass
+
+
+_trace_ids = itertools.count(1)   # per-process request trace ids
+
+
+def new_trace_id() -> str:
+    """A process-unique request trace id (``rtrace`` records carry it as
+    ``trace``). Stamped once per request at admission into the serving
+    tier — the identity that survives queueing, migration between
+    replicas, and brownout clamps (docs/TRACING.md "Request tracing")."""
+    return f"{os.getpid():x}-{next(_trace_ids):x}"
+
+
+def rtrace(req, event: str, *, sink=None, **fields: Any) -> None:
+    """Write one typed ``rtrace`` record for a request-scoped event.
+
+    ``req`` is any object carrying ``trace_id`` (str | None), ``trace_seq``
+    (int) and ``rid`` — in practice serve/scheduler.py's ``Request``. The
+    per-request sequence number is incremented HERE, under the emitting
+    thread, so a request's records are causally ordered by ``seq`` even
+    when wall-clock ``ts`` ties (two events inside one engine iteration)
+    or skews across streams. Because the Request OBJECT migrates between
+    replicas (export/import moves KV pages by value, not the request),
+    ``seq`` stays monotonic across the hop — the joiner links the two
+    stream segments by ``(trace, seq)`` adjacency.
+
+    No-op when the request was never stamped (``trace_id`` is None — an
+    engine without telemetry) or no sink resolves; never raises (tracing
+    is observability, not control flow)."""
+    trace = getattr(req, "trace_id", None)
+    if trace is None:
+        return
+    sink = sink if sink is not None else installed()
+    if sink is None:
+        return
+    req.trace_seq += 1
+    try:
+        sink.record("rtrace", trace=trace, seq=req.trace_seq,
+                    request=req.rid, event=event, **fields)
+    except Exception:
         pass
 
 

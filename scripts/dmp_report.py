@@ -55,6 +55,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    join_request_traces,
     read_records,
 )
 
@@ -403,6 +404,55 @@ def _fleet_serving_section(lines: list[str], by_kind: dict) -> None:
             f"{s.get('replica_kills', 0)} kills   {states}")
 
 
+def _rtrace_summary(by_kind: dict) -> dict | None:
+    """Fold the ``rtrace`` plane into the joined-timeline summary both
+    report forms share: timeline/orphan counts, the terminal-event
+    breakdown, linked migration hops, and fleet-wide per-phase seconds.
+    None when the stream carries no request traces."""
+    recs = by_kind.get("rtrace") or []
+    if not recs:
+        return None
+    traces = join_request_traces(recs)
+    terminals: dict[str, int] = {}
+    phases: dict[str, float] = {}
+    orphans = hops = 0
+    for t in traces.values():
+        if t["orphan"]:
+            orphans += 1
+        if t["terminal"]:
+            terminals[t["terminal"]] = terminals.get(t["terminal"], 0) + 1
+        hops += len(t["hops"])
+        for p, s in t["phases"].items():
+            phases[p] = phases.get(p, 0.0) + s
+    return {
+        "traces": len(traces),
+        "orphans": orphans,
+        "terminals": dict(sorted(terminals.items())),
+        "migration_hops": hops,
+        "phase_seconds": {p: round(s, 4)
+                          for p, s in sorted(phases.items())},
+    }
+
+
+def _rtrace_section(lines: list[str], by_kind: dict) -> None:
+    """Request-trace rollup (``rtrace`` records, utils/tracing.py):
+    joined per-request timelines, terminal accounting and fleet-wide
+    phase attribution. The zoomable per-request waterfall is
+    ``scripts/dmp_xray.py``; this is the at-a-glance version."""
+    s = _rtrace_summary(by_kind)
+    if s is None:
+        return
+    lines.append(f"== request traces ({s['traces']} timelines) ==")
+    terms = "  ".join(f"{k}={v}" for k, v in s["terminals"].items())
+    lines.append(f"terminals: {terms or '(none)'}   orphans: "
+                 f"{s['orphans']}   migration hops: {s['migration_hops']}")
+    if s["phase_seconds"]:
+        lines.append("phase seconds: " + "  ".join(
+            f"{p}={v:.4f}s" for p, v in s["phase_seconds"].items()))
+    lines.append("  (per-request waterfall: "
+                 "python scripts/dmp_xray.py <stream> --worst 5)")
+
+
 def _plan_section(lines: list[str], by_kind: dict) -> None:
     """Parallelism-plan records (autotune/planner.emit_plan_record): which
     layout the autotuner chose, at which global step, and the nearest
@@ -681,6 +731,7 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     _phase_section(lines, by_kind)
     _serving_section(lines, by_kind)
     _fleet_serving_section(lines, by_kind)
+    _rtrace_section(lines, by_kind)
     _plan_section(lines, by_kind)
     _spans_section(lines, by_kind)
     _gate_section(lines, by_kind)
@@ -808,6 +859,7 @@ def build_report_data(records: list[dict]) -> dict:
         "headline": headline,
         "resilience": resilience,
         "serving": serving,
+        "rtrace": _rtrace_summary(by_kind),
         "gate": gate,
         "plan": by_kind.get("plan") or [],
         "spans": spans,

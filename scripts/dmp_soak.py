@@ -86,7 +86,7 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mode", default="fast", choices=["fast", "long"])
     p.add_argument("--scenario", default="chaos",
-                   choices=["chaos", "degradation", "overload"],
+                   choices=["chaos", "degradation", "overload", "xray"],
                    help="chaos: the heterogeneous fault campaign; "
                         "degradation: the device-health drill — an "
                         "injected slow_device straggler must be "
@@ -99,7 +99,13 @@ def parse_args(argv=None):
                         "shedding, bounded queues and a brownout that "
                         "fires and resolves, with completed tokens "
                         "bitwise identical to the clean run "
-                        "(serve/overload.py)")
+                        "(serve/overload.py); "
+                        "xray: the request-tracing drill — a replica "
+                        "kill under live traffic must reconstruct a "
+                        "complete causally ordered rtrace timeline for "
+                        "every admitted request, with migration hops "
+                        "linked across the source/destination streams "
+                        "and zero orphan spans (scripts/dmp_xray.py)")
     p.add_argument("--goodput-band", default=0.8, type=float,
                    help="overload scenario: goodput under 2x load must "
                         "stay >= this fraction of clean-run capacity")
@@ -596,6 +602,7 @@ def run_overload_campaign(args, workdir: str, seed: int
     from distributed_model_parallel_tpu.serve.scheduler import RequestState
     from distributed_model_parallel_tpu.utils.telemetry import (
         TelemetryRun,
+        join_request_traces,
         read_records,
     )
     from scripts.dmp_report import build_report
@@ -715,6 +722,12 @@ def run_overload_campaign(args, workdir: str, seed: int
                    if q.state is not RequestState.COMPLETED
                    and (q.shed_reason is None
                         or q.rid not in shed_recorded)]
+    # Trace-plane accounting (gate 7): every request in BOTH phases —
+    # including the shed/expired ones — must reconstruct a complete
+    # causally ordered rtrace timeline with exactly one terminal event.
+    traces = join_request_traces(recs)
+    trace_orphans = sorted(t["trace"] for t in traces.values()
+                           if t["orphan"])
     bo_recs = [r for r in recs if r.get("kind") == "brownout"]
     bo_fired = any(r.get("level", 0) >= 1 for r in bo_recs)
     bo_final = [rep.engine.brownout.level for rep in fleet.replicas]
@@ -750,6 +763,8 @@ def run_overload_campaign(args, workdir: str, seed: int
         "token_mismatches": mismatched,
         "clamped": sorted(q.rid for q in completed
                           if q.max_new_requested is not None),
+        "rtrace_timelines": len(traces),
+        "rtrace_orphans": trace_orphans,
         "telemetry": [stream],
     }
     ok = (goodput >= args.goodput_band * capacity
@@ -763,7 +778,126 @@ def run_overload_campaign(args, workdir: str, seed: int
           # where nothing sheds proves nothing about typed accounting)
           # while still completing a real fraction of the offered work.
           and sum(over["shed_by_reason"].values()) >= 1
-          and len(completed) >= len(population) // 3)
+          and len(completed) >= len(population) // 3
+          # gate 7: complete rtrace timelines, no orphan spans
+          and bool(traces) and not trace_orphans)
+    return out, ok
+
+
+# ---------------------------------------------------------------------------
+# the xray scenario: complete request timelines through a replica kill
+# ---------------------------------------------------------------------------
+
+def run_xray_campaign(args, workdir: str, seed: int) -> tuple[dict, bool]:
+    """The request-tracing drill (docs/OBSERVABILITY.md "Request
+    tracing"): seeded open-loop traffic on a two-replica fleet, one
+    replica killed mid-stream and revived, and the whole run's
+    ``rtrace`` plane audited for reconstruction fidelity.
+
+    Gates (non-zero exit when any fails):
+
+    1. the kill catches live requests and every request still completes
+       (zero failures — the self-healing contract this drill rides on);
+    2. EVERY submitted request reconstructs a complete causally ordered
+       timeline: contiguous per-request seq, exactly one typed terminal
+       event, zero orphan spans;
+    3. the migration hops are linked — every drained request's
+       ``export`` pairs with its destination ``import`` across the
+       source/destination origins, and at least one hop exists;
+    4. per-phase attribution (queue / prefill / decode /
+       migration-pause / ...) sums to within 5% of each timeline's
+       measured wall time.
+
+    The joined timelines are written to ``xray_timelines.json`` in the
+    campaign workdir — the artifact CI uploads on failure.
+    """
+    import jax
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.serve import ServeConfig, ServeFleet
+    from distributed_model_parallel_tpu.utils.telemetry import (
+        TelemetryRun,
+        join_request_traces,
+        read_records,
+    )
+    from scripts.dmp_xray import phase_gate_error, summarize
+
+    rng = np.random.default_rng(seed)
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots, page, max_len = 2, 8, 64
+    base = dict(n_slots=n_slots, page_size=page,
+                n_pages=(n_slots + 1) * (-(-max_len // page)),
+                max_seq_len=max_len, prefill_chunk=4)
+    population = [dict(
+        rid=f"x{i}",
+        prompt=[int(x) for x in rng.integers(0, 64,
+                                             int(rng.integers(4, 13)))],
+        gen=int(rng.integers(10, 25)))
+        for i in range(10)]
+
+    os.makedirs(workdir, exist_ok=True)
+    stream = os.path.join(workdir, "xray.jsonl")
+    tel = TelemetryRun(stream, run="xray-drill")
+    t0 = time.monotonic()
+    fleet = ServeFleet(params, cfg, ServeConfig(**base), 2, telemetry=tel,
+                       router_seed=seed, revive_after=3)
+    kill = {"n": None}
+
+    def hook(rnd):
+        # Round 4: past warmup/prefill ramp, before the backlog drains —
+        # the kill lands on a busy replica so drained requests carry
+        # real committed KV (the export/import hop the drill audits).
+        if rnd == 4 and kill["n"] is None:
+            kill["n"] = fleet.kill_replica("r0")
+
+    fleet.step_hook = hook
+    for i, r in enumerate(population):
+        fleet.submit(r["prompt"], r["gen"], rid=r["rid"], seed=i)
+    summary = fleet.run()
+    tel.finish()
+    fleet.close()
+
+    traces = join_request_traces(read_records(stream))
+    orphans = sorted(t["trace"] for t in traces.values() if t["orphan"])
+    hops = sum(len(t["hops"]) for t in traces.values())
+    phase_bad = sorted(t["trace"] for t in traces.values()
+                       if phase_gate_error(t) > 0.05)
+    artifact = os.path.join(workdir, "xray_timelines.json")
+    with open(artifact, "w") as f:
+        json.dump({"summary": summarize(traces),
+                   "traces": list(traces.values())}, f, default=str)
+
+    out = {
+        "soak": "xray-campaign",
+        "scenario": "xray",
+        "seed": seed,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "requests": len(population),
+        "completed": summary["requests_completed"],
+        "failed": summary["requests_failed"],
+        "migrated_at_kill": kill["n"],
+        "migrations": summary["migrations"],
+        "rtrace_timelines": len(traces),
+        "rtrace_orphans": orphans,
+        "migration_hops": hops,
+        "phase_sum_mismatches": phase_bad,
+        "artifact": artifact,
+        "telemetry": [stream],
+    }
+    ok = (summary["requests_failed"] == 0
+          and summary["requests_completed"] == len(population)
+          and (kill["n"] or 0) > 0
+          # gate 2: one complete timeline per request, zero orphans
+          and len(traces) == len(population)
+          and not orphans
+          # gate 3: the kill's migrations show up as linked hops
+          and hops >= 1
+          # gate 4: phase attribution accounts for the wall time
+          and not phase_bad)
     return out, ok
 
 
@@ -774,6 +908,7 @@ def run_long(args, workdir: str) -> tuple[dict, bool]:
     smoke of this very loop)."""
     campaign = {"degradation": run_degradation_campaign,
                 "overload": run_overload_campaign,
+                "xray": run_xray_campaign,
                 "chaos": run_campaign}[args.scenario]
     t0 = time.monotonic()
     campaigns, all_ok = [], True
@@ -801,6 +936,7 @@ def main(argv=None) -> int:
     if args.mode == "fast":
         campaign = {"degradation": run_degradation_campaign,
                     "overload": run_overload_campaign,
+                    "xray": run_xray_campaign,
                     "chaos": run_campaign}[args.scenario]
         summary, ok = campaign(args, workdir, args.seed)
         print(json.dumps(summary), flush=True)

@@ -36,6 +36,7 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    RTRACE_TERMINAL_EVENTS,
     StreamFollower,
 )
 
@@ -61,6 +62,10 @@ class FleetState:
         self.shed_by_reason: dict[str, int] = {}
         self.brownout_level: int | None = None
         self.breaker_states: dict[str, str] = {}
+        # Request tracing (utils/tracing.rtrace): live trace counts —
+        # how many requests have a trace open vs. terminally accounted.
+        self.rtrace_open: set[str] = set()
+        self.rtrace_terminals: dict[str, int] = {}
         # Untenanted streams (a plain trainer run) attribute their
         # records to the last run_start's run name.
         self._default_run = ""
@@ -146,6 +151,15 @@ class FleetState:
             rep = str(rec.get("replica"))
             self.router_assignments[rep] = (
                 self.router_assignments.get(rep, 0) + 1)
+        elif kind == "rtrace":
+            trace = str(rec.get("trace"))
+            event = str(rec.get("event"))
+            if event in RTRACE_TERMINAL_EVENTS:
+                self.rtrace_open.discard(trace)
+                self.rtrace_terminals[event] = (
+                    self.rtrace_terminals.get(event, 0) + 1)
+            else:
+                self.rtrace_open.add(trace)
 
     def _refresh_mfu(self, t: dict) -> None:
         """MFU from stream data alone: FLOPs/step / n_devices /
@@ -226,6 +240,11 @@ class FleetState:
                      if self.brownout_level is not None else "-")
             lines.append(f"overload  shed={shed}  brownout={level}  "
                          f"breaker={brk}")
+        if self.rtrace_open or self.rtrace_terminals:
+            terms = (" ".join(f"{k}:{v}" for k, v in
+                              sorted(self.rtrace_terminals.items())) or "-")
+            lines.append(f"traces  open={len(self.rtrace_open)}  "
+                         f"terminal={terms}")
         if self.statusz is not None:
             if "error" in self.statusz:
                 lines.append(f"statusz: {self.statusz['error']}")

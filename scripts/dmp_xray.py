@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""dmp_xray — per-request fleet X-ray over rtrace telemetry streams.
+
+Reconstructs causally ordered per-request timelines from the ``rtrace``
+records the serving tier emits (router admission, queue wait, brownout
+clamps, prefill chunks, decode rounds with memory gauges, migration
+export/import hops, terminal events) and renders them three ways:
+
+* fleet summary (default) — trace counts, completion/orphan rates,
+  terminal-event breakdown, migration hops;
+* ``--trace ID`` / ``--request RID`` — a single-request waterfall with
+  per-event deltas and phase attribution;
+* ``--worst K --metric ttft|tbt|queue_wait`` — exemplar report: the K
+  worst requests by the chosen metric, each with its phase breakdown
+  (queue / prefill / decode / brownout-clamp / migration-pause /
+  memory-stall).
+
+Usage:
+    python scripts/dmp_xray.py /tmp/run/serve.jsonl
+    python scripts/dmp_xray.py a.jsonl b.jsonl --timeline
+    python scripts/dmp_xray.py serve.jsonl --trace 1f03-2
+    python scripts/dmp_xray.py serve.jsonl --worst 5 --metric ttft
+    python scripts/dmp_xray.py serve.jsonl --gate --json
+
+``--gate`` exits non-zero when any timeline is orphaned (seq gap, no
+terminal, or multiple terminals) or when a timeline's per-phase seconds
+disagree with its measured wall time by more than 5% — the soak-drill
+acceptance check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    join_request_traces,
+    read_records,
+)
+
+# Phase names in render order (matches utils.telemetry._rtrace_phase).
+PHASES = ("queue", "prefill", "decode", "brownout-clamp",
+          "migration-pause", "memory-stall", "other")
+
+METRICS = ("ttft", "tbt", "queue_wait")
+
+
+def load_traces(paths: list[str]) -> dict[str, dict]:
+    """Read every stream (rotated parts fold in automatically), stamp
+    each rtrace record with its source file's basename as the ``stream``
+    tag — the hop-origin fallback when replicas write separate files —
+    and join into per-request timelines."""
+    records: list[dict] = []
+    for path in paths:
+        tag = os.path.basename(path)
+        for rec in read_records(path):
+            if rec.get("kind") == "rtrace":
+                rec.setdefault("stream", tag)
+            records.append(rec)
+    return join_request_traces(records)
+
+
+def _event_field(tl: dict, event: str, field: str):
+    """First occurrence of ``field`` on an event named ``event``."""
+    for r in tl["events"]:
+        if r.get("event") == event and r.get(field) is not None:
+            return r[field]
+    return None
+
+
+def _event_ts(tl: dict, event: str):
+    for r in tl["events"]:
+        if r.get("event") == event and isinstance(r.get("ts"), (int, float)):
+            return r["ts"]
+    return None
+
+
+def metric_value(tl: dict, metric: str) -> float | None:
+    """Extract the ranking metric for ``--worst`` from a timeline,
+    preferring the measured fields the engine stamped on the records and
+    falling back to timestamp deltas."""
+    if metric == "ttft":
+        v = _event_field(tl, "completed", "ttft_s")
+        if v is None:
+            v = _event_field(tl, "prefill", "ttft_s")
+        if v is None:
+            t_dec, t0 = _event_ts(tl, "decode"), tl.get("t0")
+            if t_dec is not None and t0 is not None:
+                v = t_dec - t0
+        return None if v is None else float(v)
+    if metric == "tbt":
+        v = _event_field(tl, "completed", "token_latency_s")
+        if v is None:
+            n = _event_field(tl, "completed", "new_tokens")
+            if n and tl.get("wall_s"):
+                v = tl["wall_s"] / float(n)
+        return None if v is None else float(v)
+    if metric == "queue_wait":
+        v = _event_field(tl, "completed", "queue_wait_s")
+        if v is None:
+            t_adm, t0 = _event_ts(tl, "admitted"), tl.get("t0")
+            if t_adm is not None and t0 is not None:
+                v = t_adm - t0
+        return None if v is None else float(v)
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+def phase_gate_error(tl: dict) -> float:
+    """Relative disagreement between the per-phase seconds and the
+    timeline's measured wall time (0.0 when wall is ~zero — a trace
+    that started and terminated inside one tick attributes nothing)."""
+    wall = tl.get("wall_s") or 0.0
+    total = sum(tl.get("phases", {}).values())
+    if wall <= 1e-9:
+        return 0.0 if total <= 1e-9 else 1.0
+    return abs(total - wall) / wall
+
+
+def summarize(traces: dict[str, dict]) -> dict:
+    terminals: dict[str, int] = {}
+    orphans = hops = 0
+    phase_totals = {p: 0.0 for p in PHASES}
+    for tl in traces.values():
+        if tl["orphan"]:
+            orphans += 1
+        if tl["terminal"]:
+            terminals[tl["terminal"]] = terminals.get(tl["terminal"], 0) + 1
+        hops += len(tl["hops"])
+        for p, s in tl["phases"].items():
+            phase_totals[p] = phase_totals.get(p, 0.0) + s
+    n = len(traces)
+    return {
+        "traces": n,
+        "complete": n - orphans,
+        "orphans": orphans,
+        "terminals": dict(sorted(terminals.items())),
+        "migration_hops": hops,
+        "phase_seconds": {p: round(s, 4)
+                         for p, s in phase_totals.items() if s > 0},
+    }
+
+
+def _fmt_phases(phases: dict[str, float]) -> str:
+    parts = [f"{p}={phases[p]:.4f}s" for p in PHASES if phases.get(p)]
+    return " ".join(parts) if parts else "(instantaneous)"
+
+
+def render_summary(traces: dict[str, dict], out) -> None:
+    s = summarize(traces)
+    print("== fleet x-ray ==", file=out)
+    print(f"traces: {s['traces']}  complete: {s['complete']}  "
+          f"orphans: {s['orphans']}  migration hops: "
+          f"{s['migration_hops']}", file=out)
+    if s["terminals"]:
+        terms = "  ".join(f"{k}={v}" for k, v in s["terminals"].items())
+        print(f"terminals: {terms}", file=out)
+    if s["phase_seconds"]:
+        print(f"fleet phase seconds: {_fmt_phases(s['phase_seconds'])}",
+              file=out)
+    for tl in traces.values():
+        if tl["orphan"]:
+            print(f"  ORPHAN {tl['trace']} (request={tl['request']}): "
+                  f"{', '.join(tl['orphan_reasons'])}", file=out)
+
+
+def render_waterfall(tl: dict, out) -> None:
+    print(f"== request waterfall: trace={tl['trace']} "
+          f"request={tl['request']} ==", file=out)
+    term = tl["terminal"] or "NONE"
+    print(f"terminal: {term}  wall: {tl['wall_s']:.4f}s  "
+          f"hops: {len(tl['hops'])}"
+          + (f"  ORPHAN: {', '.join(tl['orphan_reasons'])}"
+             if tl["orphan"] else ""), file=out)
+    t0 = tl.get("t0")
+    prev_ts = None
+    for r in tl["events"]:
+        ts = r.get("ts")
+        rel = (ts - t0) if isinstance(ts, (int, float)) \
+            and t0 is not None else None
+        dt = (ts - prev_ts) if isinstance(ts, (int, float)) \
+            and prev_ts is not None else None
+        if isinstance(ts, (int, float)):
+            prev_ts = ts
+        origin = r.get("replica") or r.get("stream") or "-"
+        extras = {k: v for k, v in r.items()
+                  if k not in ("ts", "kind", "trace", "seq", "request",
+                               "event", "replica", "stream", "run",
+                               "tenant")}
+        detail = " ".join(f"{k}={v}" for k, v in extras.items())
+        rel_s = f"{rel:+.4f}s" if rel is not None else "   ?   "
+        dt_s = f"(+{dt:.4f}s)" if dt is not None else ""
+        print(f"  [{r.get('seq'):>3}] {rel_s} {dt_s:>12} "
+              f"{r.get('event'):<13} @{origin:<8} {detail}", file=out)
+    for hop in tl["hops"]:
+        print(f"  hop @seq {hop['seq']}: {hop['from'] or '?'} -> "
+              f"{hop['to'] or '?'}", file=out)
+    print(f"  phases: {_fmt_phases(tl['phases'])}", file=out)
+
+
+def render_timeline(traces: dict[str, dict], out) -> None:
+    """Fleet timeline: every event from every trace, wall-clock ordered,
+    with per-trace seq preserved in the row."""
+    rows = []
+    for tl in traces.values():
+        for r in tl["events"]:
+            ts = r.get("ts")
+            rows.append((ts if isinstance(ts, (int, float)) else 0.0,
+                         tl["trace"], r))
+    rows.sort(key=lambda t: (t[0], t[1]))
+    t0 = rows[0][0] if rows else 0.0
+    print("== fleet timeline ==", file=out)
+    for ts, trace, r in rows:
+        origin = r.get("replica") or r.get("stream") or "-"
+        print(f"  {ts - t0:+9.4f}s {trace:<14} "
+              f"[{r.get('seq'):>3}] {r.get('event'):<13} @{origin}",
+              file=out)
+
+
+def worst_report(traces: dict[str, dict], metric: str, k: int) -> list[dict]:
+    ranked = []
+    for tl in traces.values():
+        v = metric_value(tl, metric)
+        if v is None:
+            continue
+        ranked.append({
+            "trace": tl["trace"],
+            "request": tl["request"],
+            metric: round(v, 6),
+            "terminal": tl["terminal"],
+            "wall_s": round(tl["wall_s"], 6),
+            "hops": len(tl["hops"]),
+            "phases": {p: round(s, 6) for p, s in tl["phases"].items()},
+        })
+    ranked.sort(key=lambda d: -d[metric])
+    return ranked[:k]
+
+
+def render_worst(report: list[dict], metric: str, out) -> None:
+    print(f"== worst {len(report)} by {metric} ==", file=out)
+    for i, row in enumerate(report, 1):
+        print(f"{i:>2}. {metric}={row[metric]:.4f}s  trace={row['trace']}  "
+              f"request={row['request']}  terminal={row['terminal']}  "
+              f"wall={row['wall_s']:.4f}s  hops={row['hops']}", file=out)
+        print(f"    phases: {_fmt_phases(row['phases'])}", file=out)
+
+
+def run_gate(traces: dict[str, dict], tol: float, out) -> int:
+    """The soak acceptance gate: every timeline complete (no orphans)
+    and every timeline's phase attribution within ``tol`` of its wall
+    time. Returns a process exit code."""
+    failures = []
+    for tl in traces.values():
+        if tl["orphan"]:
+            failures.append(f"orphan trace {tl['trace']} "
+                            f"({', '.join(tl['orphan_reasons'])})")
+        err = phase_gate_error(tl)
+        if err > tol:
+            failures.append(f"phase-sum mismatch on {tl['trace']}: "
+                            f"{err:.1%} > {tol:.0%}")
+    if not traces:
+        failures.append("no rtrace timelines found")
+    for f in failures:
+        print(f"GATE FAIL: {f}", file=out)
+    if not failures:
+        print(f"GATE OK: {len(traces)} timelines complete, phase "
+              f"attribution within {tol:.0%}", file=out)
+    return 1 if failures else 0
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dmp_xray",
+        description="Per-request fleet X-ray over rtrace streams.")
+    p.add_argument("streams", nargs="+",
+                   help="telemetry stream path(s) (.jsonl; rotated parts "
+                        "fold in automatically)")
+    p.add_argument("--trace", default=None,
+                   help="render one request's waterfall by trace id")
+    p.add_argument("--request", default=None,
+                   help="render one request's waterfall by request id")
+    p.add_argument("--worst", type=int, default=None, metavar="K",
+                   help="exemplar report: the K worst requests by --metric")
+    p.add_argument("--metric", choices=METRICS, default="ttft",
+                   help="ranking metric for --worst (default: ttft)")
+    p.add_argument("--timeline", action="store_true",
+                   help="render the wall-ordered fleet timeline")
+    p.add_argument("--json", action="store_true",
+                   help="emit JSON instead of text")
+    p.add_argument("--gate", action="store_true",
+                   help="exit non-zero on orphans or phase-sum mismatch")
+    p.add_argument("--gate-tolerance", type=float, default=0.05,
+                   help="relative phase-sum tolerance for --gate "
+                        "(default: 0.05)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    traces = load_traces(args.streams)
+    out = sys.stdout
+
+    if args.trace is not None or args.request is not None:
+        if args.trace is not None:
+            tl = traces.get(str(args.trace))
+        else:
+            tl = next((t for t in traces.values()
+                       if str(t.get("request")) == str(args.request)), None)
+        if tl is None:
+            print("no matching trace", file=sys.stderr)
+            return 2
+        if args.json:
+            json.dump(tl, out, default=str)
+            print(file=out)
+        else:
+            render_waterfall(tl, out)
+        return 0
+
+    rc = 0
+    if args.json:
+        payload = {"summary": summarize(traces)}
+        if args.worst is not None:
+            payload["worst"] = worst_report(traces, args.metric, args.worst)
+        if args.timeline:
+            payload["traces"] = list(traces.values())
+        if args.gate:
+            payload["gate_failures"] = [
+                tl["trace"] for tl in traces.values()
+                if tl["orphan"]
+                or phase_gate_error(tl) > args.gate_tolerance]
+            rc = 1 if (payload["gate_failures"] or not traces) else 0
+        json.dump(payload, out, default=str)
+        print(file=out)
+        return rc
+
+    render_summary(traces, out)
+    if args.worst is not None:
+        render_worst(worst_report(traces, args.metric, args.worst),
+                     args.metric, out)
+    if args.timeline:
+        render_timeline(traces, out)
+    if args.gate:
+        rc = run_gate(traces, args.gate_tolerance, out)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

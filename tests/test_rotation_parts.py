@@ -1,0 +1,87 @@
+"""Telemetry rotation at double-digit part counts (utils/telemetry.py).
+
+The single-rotation case lives in tests/test_telemetry.py; this pins
+the ordering contract once part indexes pass 9 — where a lexicographic
+sort would interleave ``.10.jsonl`` before ``.2.jsonl`` and a merged
+readback would silently reorder a long soak's history:
+
+* ``stream_parts`` returns parts in NUMERIC index order, live file
+  last;
+* ``read_records`` folds >= 10 parts back into one stream whose
+  records are in exact write order;
+* ``merge_streams`` over the rotated stream (alone and with a second
+  stream) keeps that order stable and never double-counts absorbed
+  parts.
+
+Hermetic registry throughout (the PR 13 lesson): ``finish()`` snapshots
+every metric the process ever registered into one ``metrics`` line, so
+against the global registry the part-size/count assertions would depend
+on which tests ran first.
+"""
+
+import os
+
+from distributed_model_parallel_tpu.utils import telemetry
+
+
+def _rotated_run(path, n_records, run="long"):
+    run_ = telemetry.TelemetryRun(path, run=run, track_compiles=False,
+                                  max_bytes=4096,
+                                  registry_=telemetry.MetricsRegistry())
+    for i in range(n_records):
+        # ~420 bytes per line => ~9 records per 4096-byte part.
+        run_.step(step=i, step_time_s=0.01, pad="x" * 360, src=run)
+    run_.finish()
+    return run_
+
+
+def test_ten_plus_parts_sort_numerically_not_lexicographically(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _rotated_run(path, 120)
+    parts = telemetry.stream_parts(path)
+    assert len(parts) >= 11, f"need >= 10 rotated parts, got {len(parts)}"
+    assert parts[-1] == path                      # live file last
+    indexes = [int(p.rsplit(".", 2)[-2]) for p in parts[:-1]]
+    assert indexes == list(range(1, len(indexes) + 1))
+    # The trap this file exists for: lexicographic part order differs
+    # once indexes hit double digits, so equality here would be luck.
+    lex = sorted(parts[:-1])
+    assert lex != parts[:-1]
+
+
+def test_read_records_is_write_ordered_across_many_parts(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    n = 120
+    _rotated_run(path, n)
+    records = telemetry.read_records(path)
+    assert records[0]["kind"] == "run_start"
+    assert records[-1]["kind"] == "run_end"
+    steps = [r["step"] for r in records if r["kind"] == "step"]
+    assert steps == list(range(n))
+    # Every part stayed within the byte budget (the live tail may be
+    # any size).
+    for p in telemetry.stream_parts(path)[:-1]:
+        assert os.path.getsize(p) <= 4096
+
+
+def test_merge_streams_is_order_stable_over_rotated_parts(tmp_path):
+    path_a = str(tmp_path / "a.jsonl")
+    path_b = str(tmp_path / "b.jsonl")
+    n = 120
+    _rotated_run(path_a, n, run="a")
+    _rotated_run(path_b, 30, run="b")
+    merged = telemetry.merge_streams([path_a])
+    assert [r["step"] for r in merged if r["kind"] == "step"] == \
+        list(range(n))
+    # Passing the base path AND its parts (a shell glob) must not
+    # double-count the absorbed parts.
+    expanded = telemetry.merge_streams(
+        sorted(telemetry.stream_parts(path_a)))
+    assert len(expanded) == len(merged)
+    # A two-stream merge interleaves by ts but keeps each stream's own
+    # records in write order (ties broken by read order).
+    both = telemetry.merge_streams([path_a, path_b])
+    a_steps = [r["step"] for r in both
+               if r["kind"] == "step" and r.get("src") == "a"]
+    assert len(both) == len(merged) + len(telemetry.read_records(path_b))
+    assert sorted(a_steps) == a_steps == list(range(n))

@@ -1,0 +1,365 @@
+"""Per-request tracing plane (utils/tracing.rtrace + the
+utils/telemetry.join_request_traces joiner + scripts/dmp_xray.py).
+
+The load-bearing properties (docs/OBSERVABILITY.md "Request tracing"):
+
+* ``rtrace`` is a no-op unless BOTH a trace id is stamped and a sink is
+  attached — bench drivers constructing bare Requests pay nothing;
+* every emission increments the request's own ``trace_seq``, so a
+  joined timeline's seqs are contiguous from 1 even when the events
+  land on different physical streams (the migration case);
+* an engine run with telemetry attached reconstructs one COMPLETE
+  timeline per request: contiguous seq, exactly one typed terminal
+  event, phases summing exactly to the timeline's wall time;
+* a replica kill mid-stream links the drained requests' export/import
+  pairs into migration hops across the source/destination origins, and
+  still orphans nothing;
+* the joiner flags the three orphan shapes (seq gap / no terminal /
+  multiple terminals) instead of silently absorbing them;
+* the dmp_xray CLI renders and gates the same stream (exit 0 on a
+  clean run, non-zero on a doctored orphan).
+"""
+
+import importlib.util
+import os
+import types
+
+import jax
+import pytest
+
+from distributed_model_parallel_tpu.models import transformer as tfm
+from distributed_model_parallel_tpu.serve import (
+    Engine,
+    ServeConfig,
+    ServeFleet,
+)
+from distributed_model_parallel_tpu.serve.scheduler import RequestState
+from distributed_model_parallel_tpu.utils import tracing
+from distributed_model_parallel_tpu.utils.telemetry import (
+    RTRACE_TERMINAL_EVENTS,
+    TelemetryRun,
+    join_request_traces,
+    read_records,
+)
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    return cfg, tfm.init_params(jax.random.key(0), cfg)
+
+
+def _serve(**kw):
+    base = dict(n_slots=2, page_size=8, n_pages=32, max_seq_len=64,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16]]
+GENS = [12, 18, 7]
+
+
+# ---------------------------------------------------------------------------
+# the rtrace emitter
+# ---------------------------------------------------------------------------
+
+class _Sink:
+    def __init__(self):
+        self.records = []
+
+    def record(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+
+def _req(trace_id="t-1"):
+    return types.SimpleNamespace(rid="r0", trace_id=trace_id, trace_seq=0)
+
+
+def test_rtrace_noop_without_trace_id_or_sink():
+    sink = _Sink()
+    req = _req(trace_id=None)
+    tracing.rtrace(req, "submitted", sink=sink)
+    assert not sink.records and req.trace_seq == 0
+    req = _req()
+    # sink=None falls back to the thread-local installed() sink, so
+    # clear any sink an earlier test left behind (restored after).
+    prev = tracing.installed()
+    tracing.uninstall()
+    try:
+        tracing.rtrace(req, "submitted", sink=None)
+        assert req.trace_seq == 0
+    finally:
+        if prev is not None:
+            tracing.install(prev)
+
+
+def test_rtrace_increments_seq_and_carries_fields():
+    sink = _Sink()
+    req = _req()
+    tracing.rtrace(req, "submitted", sink=sink, prompt_tokens=5)
+    tracing.rtrace(req, "completed", sink=sink, replica="r1")
+    assert req.trace_seq == 2
+    assert [r["seq"] for r in sink.records] == [1, 2]
+    assert sink.records[0] == {"kind": "rtrace", "trace": "t-1", "seq": 1,
+                               "request": "r0", "event": "submitted",
+                               "prompt_tokens": 5}
+    assert sink.records[1]["replica"] == "r1"
+
+
+def test_new_trace_ids_are_process_unique():
+    ids = {tracing.new_trace_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(f"{os.getpid():x}-" in i for i in ids)
+
+
+# ---------------------------------------------------------------------------
+# the joiner on synthetic records (the three orphan shapes + hops)
+# ---------------------------------------------------------------------------
+
+def _ev(trace, seq, event, ts, **fields):
+    return {"kind": "rtrace", "trace": trace, "seq": seq, "request": "rq",
+            "event": event, "ts": ts, **fields}
+
+
+def test_joiner_flags_the_three_orphan_shapes():
+    recs = (
+        # complete: contiguous, one terminal
+        [_ev("ok", 1, "submitted", 1.0), _ev("ok", 2, "completed", 2.0)]
+        # seq gap (2 missing)
+        + [_ev("gap", 1, "submitted", 1.0), _ev("gap", 3, "completed", 3.0)]
+        # no terminal
+        + [_ev("open", 1, "submitted", 1.0), _ev("open", 2, "decode", 2.0)]
+        # two terminals
+        + [_ev("dup", 1, "submitted", 1.0), _ev("dup", 2, "shed", 2.0),
+           _ev("dup", 3, "completed", 3.0)])
+    traces = join_request_traces(recs)
+    assert not traces["ok"]["orphan"]
+    assert traces["ok"]["terminal"] == "completed"
+    assert traces["gap"]["orphan_reasons"] == ["seq-gap"]
+    assert traces["open"]["orphan_reasons"] == ["no-terminal"]
+    assert traces["dup"]["orphan_reasons"] == ["multiple-terminals"]
+
+
+def test_joiner_orders_by_seq_not_ts_and_links_hops():
+    """Migration splits a request across emitters with skewed clocks:
+    causal order is the per-request seq, and the export pairs with the
+    next import whose origin differs — even with the migration
+    re-route record in between."""
+    recs = [
+        _ev("m", 3, "export", 3.0, replica="r0"),
+        _ev("m", 1, "submitted", 1.0),
+        _ev("m", 5, "import", 2.5, replica="r1"),   # ts skew: before export
+        _ev("m", 2, "admitted", 1.5, replica="r0"),
+        _ev("m", 4, "route", 3.1, replica="r1"),
+        _ev("m", 6, "completed", 4.0, replica="r1"),
+    ]
+    tl = join_request_traces(recs)["m"]
+    assert [r["seq"] for r in tl["events"]] == [1, 2, 3, 4, 5, 6]
+    assert not tl["orphan"]
+    assert tl["hops"] == [{"seq": 3, "from": "r0", "to": "r1"}]
+
+
+def test_joiner_phases_partition_wall_time():
+    recs = [
+        _ev("p", 1, "submitted", 0.0),
+        _ev("p", 2, "admitted", 1.0),
+        _ev("p", 3, "prefill", 1.5),
+        _ev("p", 4, "decode", 1.7),
+        _ev("p", 5, "completed", 2.0),
+    ]
+    tl = join_request_traces(recs)["p"]
+    assert tl["wall_s"] == pytest.approx(2.0)
+    assert sum(tl["phases"].values()) == pytest.approx(tl["wall_s"])
+    assert tl["phases"]["queue"] == pytest.approx(1.0)
+    assert tl["phases"]["prefill"] == pytest.approx(0.5)
+    assert tl["phases"]["decode"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# engine + fleet end to end
+# ---------------------------------------------------------------------------
+
+def test_engine_run_reconstructs_complete_timelines(model, tmp_path):
+    """One complete causally ordered timeline per request, with decode
+    memory gauges riding on every decode record and the histogram
+    exemplars pointing back at real trace ids."""
+    cfg, params = model
+    stream = str(tmp_path / "serve.jsonl")
+    tel = TelemetryRun(stream, run="rtrace-test")
+    eng = Engine(params, cfg, _serve(), telemetry=tel)
+    reqs = [eng.submit(p, g) for p, g in zip(PROMPTS, GENS)]
+    eng.run()
+    tel.finish()
+    traces = join_request_traces(read_records(stream))
+    assert len(traces) == len(PROMPTS)
+    for tl in traces.values():
+        assert not tl["orphan"], tl["orphan_reasons"]
+        assert tl["terminal"] == "completed"
+        assert [r["seq"] for r in tl["events"]] == \
+            list(range(1, len(tl["events"]) + 1))
+        assert sum(tl["phases"].values()) == pytest.approx(tl["wall_s"])
+        decodes = [r for r in tl["events"] if r["event"] == "decode"]
+        assert decodes, "decode rounds must appear on the timeline"
+        for d in decodes:
+            for gauge in ("occupancy", "free_pages", "used_pages",
+                          "prefix_pages", "free_watermark"):
+                assert gauge in d, f"decode record missing {gauge}"
+    # ttft histogram exemplars label real trace ids (the process-global
+    # registry the engine records SLOs into; last-wins per bucket, so at
+    # least one of this run's requests must be an exemplar)
+    from distributed_model_parallel_tpu.utils.telemetry import registry
+
+    hist = registry().histogram("serve_ttft_s")
+    labels = {ex[0] for ex in hist.exemplars.values()}
+    assert labels & {r.trace_id for r in reqs}
+
+
+def test_shed_and_expired_requests_get_terminal_traces(model, tmp_path):
+    """A queue-full rejection terminates its trace as ``shed`` and a
+    deadline expiry as ``expired`` — nothing submitted goes untraced."""
+    cfg, params = model
+    stream = str(tmp_path / "shed.jsonl")
+    tel = TelemetryRun(stream, run="shed-test")
+    eng = Engine(params, cfg, _serve(max_queue=1, queue_budget_s=0.0),
+                 telemetry=tel)
+    first = eng.submit(PROMPTS[0], 4)
+    victims = [eng.submit(p, 4) for p in PROMPTS[1:]]
+    eng.run()
+    tel.finish()
+    traces = join_request_traces(read_records(stream))
+    by_rid = {tl["request"]: tl for tl in traces.values()}
+    assert len(by_rid) == len(PROMPTS)
+    for tl in traces.values():
+        assert not tl["orphan"], tl["orphan_reasons"]
+        assert tl["terminal"] in RTRACE_TERMINAL_EVENTS
+    assert by_rid[victims[-1].rid]["terminal"] == "shed"
+    _ = first
+
+
+@pytest.mark.chaos
+def test_fleet_kill_links_migration_hops(model, tmp_path):
+    """The ISSUE-16 acceptance drill in miniature: kill one of two
+    replicas mid-stream — every request still reconstructs a complete
+    timeline, and each drained-with-KV request's export/import pair
+    links as a hop from the dead replica to its peer."""
+    cfg, params = model
+    stream = str(tmp_path / "kill.jsonl")
+    tel = TelemetryRun(stream, run="kill-drill")
+    fleet = ServeFleet(params, cfg, _serve(), 2, telemetry=tel,
+                       router_seed=0, revive_after=3)
+    fleet.step_hook = (lambda rnd: fleet.kill_replica("r0")
+                       if rnd == 4 else None)
+    reqs = [fleet.submit(p, g, seed=i, rid=f"req-{i}")
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    summary = fleet.run()
+    tel.finish()
+    assert summary["requests_failed"] == 0
+    traces = join_request_traces(read_records(stream))
+    assert len(traces) == len(reqs)
+    hops = []
+    for tl in traces.values():
+        assert not tl["orphan"], (tl["request"], tl["orphan_reasons"])
+        assert tl["terminal"] == "completed"
+        hops.extend(tl["hops"])
+        exports = [r for r in tl["events"] if r["event"] == "export"]
+        assert len(exports) == len(tl["hops"])
+    assert summary["migrations"] > 0
+    assert hops, "the kill must produce at least one linked hop"
+    assert all(h["from"] == "r0" and h["to"] == "r1" for h in hops)
+
+
+def test_killed_engine_traces_terminate_as_failed(model, tmp_path):
+    cfg, params = model
+    stream = str(tmp_path / "killed.jsonl")
+    tel = TelemetryRun(stream, run="killed")
+    eng = Engine(params, cfg, _serve(), telemetry=tel,
+                 step_hook=lambda i: (_ for _ in ()).throw(
+                     RuntimeError("boom")) if i == 2 else None)
+    for p, g in zip(PROMPTS, GENS):
+        eng.submit(p, g)
+    with pytest.raises(Exception):
+        eng.run()
+    tel.finish()
+    traces = join_request_traces(read_records(stream))
+    assert traces
+    for tl in traces.values():
+        assert not tl["orphan"], tl["orphan_reasons"]
+        assert tl["terminal"] == "failed"
+
+
+# ---------------------------------------------------------------------------
+# the dmp_xray CLI over a real stream
+# ---------------------------------------------------------------------------
+
+def test_dmp_xray_cli_summary_worst_and_gate(model, tmp_path, capsys):
+    cfg, params = model
+    stream = str(tmp_path / "xray.jsonl")
+    tel = TelemetryRun(stream, run="xray-cli")
+    eng = Engine(params, cfg, _serve(), telemetry=tel)
+    reqs = [eng.submit(p, g) for p, g in zip(PROMPTS, GENS)]
+    eng.run()
+    tel.finish()
+    xray = _load_script("dmp_xray")
+
+    assert xray.main([stream, "--worst", "2", "--metric", "ttft",
+                      "--gate"]) == 0
+    out = capsys.readouterr().out
+    assert f"traces: {len(PROMPTS)}" in out
+    assert "orphans: 0" in out
+    assert "worst 2 by ttft" in out
+    assert "GATE OK" in out
+
+    assert xray.main([stream, "--request", reqs[0].rid]) == 0
+    out = capsys.readouterr().out
+    assert f"trace={reqs[0].trace_id}" in out
+    assert "completed" in out and "phases:" in out
+
+    # metric extraction agrees with the engine's own measurement
+    traces = xray.load_traces([stream])
+    tl = traces[reqs[0].trace_id]
+    measured = next(r["ttft_s"] for r in tl["events"]
+                    if r["event"] == "completed")
+    assert xray.metric_value(tl, "ttft") == pytest.approx(measured)
+    assert xray.metric_value(tl, "queue_wait") is not None
+    assert xray.metric_value(tl, "tbt") is not None
+
+
+def test_dmp_xray_gate_fails_on_doctored_orphan(model, tmp_path, capsys):
+    """Drop one request's terminal record from the stream: the gate must
+    exit non-zero and name the orphan."""
+    import json as json_mod
+
+    cfg, params = model
+    stream = str(tmp_path / "orphan.jsonl")
+    tel = TelemetryRun(stream, run="orphan")
+    eng = Engine(params, cfg, _serve(), telemetry=tel)
+    victim = eng.submit(PROMPTS[0], 4)
+    eng.run()
+    tel.finish()
+    doctored = str(tmp_path / "doctored.jsonl")
+    with open(stream) as src, open(doctored, "w") as dst:
+        for line in src:
+            r = json_mod.loads(line)
+            if (r.get("kind") == "rtrace"
+                    and r.get("event") == "completed"):
+                continue
+            dst.write(line)
+    xray = _load_script("dmp_xray")
+    assert xray.main([doctored, "--gate"]) == 1
+    out = capsys.readouterr().out
+    assert "GATE FAIL" in out and victim.trace_id in out

@@ -83,6 +83,73 @@ def test_metrics_prometheus_exposition_with_tenant_labels():
     assert 'statusz_test_hist_sum 0.25' in body
 
 
+def test_metrics_cumulative_buckets_and_exemplars():
+    """Histograms expose true cumulative ``_bucket{le=...}`` series
+    alongside the quantile summaries: counts are monotone
+    non-decreasing in ``le``, the ``+Inf`` bucket equals ``_count``,
+    and a bucket whose observation carried an exemplar gets the
+    OpenMetrics ``# {trace_id="..."} <value>`` suffix."""
+    s = statusz.maybe_serve(0)
+    h = registry().histogram("statusz_test_buckets",
+                             bounds=(0.1, 1.0, 10.0))
+    h.observe(0.05, exemplar="trace-a")
+    h.observe(0.5)
+    h.observe(0.6, exemplar="trace-b")
+    h.observe(99.0)                              # lands in +Inf overflow
+    code, body = _get(s.url + "/metrics")
+    assert code == 200
+    assert 'statusz_test_buckets_bucket{le="0.1"} 1' in body
+    assert 'statusz_test_buckets_bucket{le="1"} 3' in body
+    assert 'statusz_test_buckets_bucket{le="10"} 3' in body
+    assert 'statusz_test_buckets_bucket{le="+Inf"} 4' in body
+    assert ('statusz_test_buckets_bucket{le="0.1"} 1 '
+            '# {trace_id="trace-a"} 0.05') in body
+    assert '# {trace_id="trace-b"} 0.6' in body
+    # cumulative counts parse back monotone, ending at _count
+    counts = [int(line.rsplit(" ", 1)[-1].split(" #")[0])
+              for line in body.splitlines()
+              if line.startswith("statusz_test_buckets_bucket")
+              and " # " not in line] + [
+              int(line.split(" # ")[0].rsplit(" ", 1)[-1])
+              for line in body.splitlines()
+              if line.startswith("statusz_test_buckets_bucket")
+              and " # " in line]
+    assert max(counts) == 4
+
+
+def test_metrics_label_escaping_quotes_backslashes_newlines():
+    """Prometheus exposition escaping (``_esc``/``_labels``): label
+    values carrying quotes, backslashes and newlines must escape to
+    ``\\"``, ``\\\\`` and ``\\n`` — a raw newline would tear the
+    exposition line and a raw quote would end the label early."""
+    s = statusz.maybe_serve(0)
+    registry().counter("statusz_esc_ctr",
+                       path='he said "hi"\\there\nline2').inc()
+    h = registry().histogram("statusz_esc_hist", bounds=(1.0,))
+    h.observe(0.5, exemplar='tr"ace\\id\nx')
+    code, body = _get(s.url + "/metrics")
+    assert code == 200
+    assert ('statusz_esc_ctr{path="he said \\"hi\\"\\\\there\\nline2"} 1'
+            in body)
+    # the exemplar label escapes the same way
+    assert '# {trace_id="tr\\"ace\\\\id\\nx"} 0.5' in body
+    # a raw (unescaped) newline would tear the series line in two —
+    # the second half would surface as a physical line of its own
+    assert not any(line.startswith("line2") for line in body.splitlines())
+
+
+def test_esc_and_labels_unit():
+    assert statusz._esc('a"b') == 'a\\"b'
+    assert statusz._esc("a\\b") == "a\\\\b"
+    assert statusz._esc("a\nb") == "a\\nb"
+    assert statusz._esc(7) == "7"
+    # sorted keys, all values escaped
+    assert statusz._labels({"b": 'x"', "a": "y\n"}) == \
+        '{a="y\\n",b="x\\""}'
+    assert statusz._labels({}, tenant="t\\0") == '{tenant="t\\\\0"}'
+    assert statusz._labels({}) == ""
+
+
 # ---------------------------------------------------------------------------
 # /statusz
 # ---------------------------------------------------------------------------
