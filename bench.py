@@ -928,6 +928,9 @@ def bench_serve_fleet() -> None:
                                        "20"))
     ttft_factor = float(os.environ.get("DMP_BENCH_SERVE_FLEET_TTFT_FACTOR",
                                        "4.0"))
+    # Cell topology (serve/cells.py): 0 = flat fleet (the pre-cell
+    # drill shape, still the default so existing ledgers keep gating).
+    n_cells = int(os.environ.get("DMP_BENCH_SERVE_CELLS", "0"))
     # Absolute band floor: on an unsaturated fleet the pre-kill p99 is
     # just one prefill (~ms on CPU), and a purely multiplicative band
     # would flag the drill for sub-second re-admission waits that are
@@ -943,9 +946,10 @@ def bench_serve_fleet() -> None:
         max_seq_len=cfg.max_seq_len,
         prefill_chunk=int(os.environ.get("DMP_BENCH_SERVE_CHUNK", "32")))
     telemetry = _telemetry_run("serve", dict(
-        trace="fleet", n_replicas=n_replicas, n_requests=len(trace),
-        n_slots=n_slots, page_size=page, kill_round=kill_round,
-        d_model=cfg.d_model, n_layers=cfg.n_layers))
+        trace="fleet", n_replicas=n_replicas, n_cells=n_cells or None,
+        n_requests=len(trace), n_slots=n_slots, page_size=page,
+        kill_round=kill_round, d_model=cfg.d_model,
+        n_layers=cfg.n_layers))
     # One warmed engine compiles the programs every replica shares
     # (builders are memoized per geometry) — compile stays out of both
     # timed walls.
@@ -954,7 +958,7 @@ def bench_serve_fleet() -> None:
 
     def run(kill: bool):
         fleet = ServeFleet(params, cfg, serve, n_replicas,
-                           telemetry=telemetry,
+                           telemetry=telemetry, cells=n_cells or None,
                            revive_after=revive_rounds if kill else None)
         if kill:
             def hook(rnd):
@@ -1041,8 +1045,13 @@ def bench_serve_fleet() -> None:
             clean["token_latency_s"].get("p99", 0), 5),
         "page_occupancy_max": None,
         # The replicas run replicated on disjoint pool slices (no mesh
-        # axes — ROADMAP item 2's TP engine will change this).
-        "plan": plan_payload(MeshConfig(), "serve"),
+        # axes — ROADMAP item 2's TP engine will change this). The
+        # fleet SHAPE rides in the plan so BASELINE_LEDGER entries from
+        # different replica counts / cell layouts never gate each other.
+        "plan": {**plan_payload(MeshConfig(), "serve"),
+                 "n_replicas": n_replicas,
+                 "cells": (drill_fleet.cells.as_dict()
+                           if drill_fleet.cells is not None else None)},
     }
     clean_fleet.close()
     drill_fleet.close()

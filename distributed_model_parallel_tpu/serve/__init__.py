@@ -32,12 +32,22 @@ package turns them into a serving engine:
   the device-health sentinel — a degrading replica is quarantined and
   its in-flight requests migrate live to peers (KV pages exported by
   value, re-imported at the exact committed position), then the replica
-  grows back after probation.
+  grows back after probation;
+* :mod:`serve.cells` — cell topology: replicas grouped into named
+  cells that fail (``kill_cell`` / ``slow_cell`` / ``partition``,
+  utils/faults.py) and grow back as correlated units, with
+  deterministic home-cell routing + cross-cell failover;
+* :mod:`serve.traffic` — seeded production-traffic programs (diurnal,
+  flash crowd, adversarial flood, mixed tenants) and the virtual
+  :class:`~serve.traffic.SimClock` the chaos scenarios replay on.
 
-See docs/SERVING.md for the anatomy, the BENCH_serve recipe and the
-fleet kill-drill recipe.
+See docs/SERVING.md for the anatomy, the BENCH_serve recipe, the fleet
+kill-drill recipe and the scenario catalog.
 """
 
+from distributed_model_parallel_tpu.serve.cells import (  # noqa: F401
+    CellDirectory,
+)
 from distributed_model_parallel_tpu.serve.engine import (  # noqa: F401
     Engine,
     EngineKilled,
@@ -68,4 +78,12 @@ from distributed_model_parallel_tpu.serve.spec import (  # noqa: F401
 from distributed_model_parallel_tpu.serve.scheduler import (  # noqa: F401
     Request,
     Scheduler,
+)
+from distributed_model_parallel_tpu.serve.traffic import (  # noqa: F401
+    SimClock,
+    adversarial_flood,
+    diurnal,
+    flash_crowd,
+    merge_traces,
+    mixed_tenants,
 )

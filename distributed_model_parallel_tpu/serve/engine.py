@@ -136,7 +136,8 @@ class Engine:
 
     def __init__(self, params: dict, cfg: TransformerConfig,
                  serve: ServeConfig, *, telemetry=None, step_hook=None,
-                 slo_metrics: bool = True, replica: str | None = None):
+                 slo_metrics: bool = True, replica: str | None = None,
+                 clock=None):
         if cfg.moe_experts:
             raise ValueError(
                 "MoE decode routing is batch-coupled (expert-capacity "
@@ -170,6 +171,12 @@ class Engine:
         # stream stays attributable. None = standalone engine (PR 9
         # behavior, provider named serve-{policy}).
         self.replica = replica
+        # Pluggable clock: every timestamp the engine takes (run-loop
+        # now, TTFT, completion) comes from here. Default is the real
+        # monotonic clock; a SimClock (serve/traffic.py) makes the whole
+        # request lifecycle a deterministic function of the trace — the
+        # chaos-scenario replay contract.
+        self._clock = clock if clock is not None else time.monotonic
         # slo_metrics=False keeps this engine out of the process-wide
         # registry (serve_* counters/histograms/gauge) — warmup/probe
         # engines must not pollute the samples a telemetry stream's
@@ -551,7 +558,7 @@ class Engine:
         ``record_summary=False`` — multi-wave drivers like BENCH_serve's
         chat mode run() per wave and record ONE campaign summary at the
         end instead of one per wave)."""
-        t0 = time.monotonic()
+        t0 = self._clock()
         try:
             # Spans from the loop (prefill chunks, decode rounds,
             # admissions) go to this engine's own stream for the scope
@@ -563,17 +570,24 @@ class Engine:
                     if (max_iterations is not None
                             and self._iterations >= max_iterations):
                         break
-                    now = time.monotonic() - t0
+                    now = self._clock() - t0
                     made_progress = self.step_once(now, t0)
                     if not made_progress:
                         nxt = self.sched.next_arrival()
                         if nxt is not None:
                             # Open loop: nothing resident, next request
-                            # not arrived yet — sleep to its arrival.
-                            time.sleep(max(0.0, min(nxt - now, 0.05)))
+                            # not arrived yet — sleep to its arrival (a
+                            # virtual clock skips straight there).
+                            adv = getattr(self._clock, "advance_to",
+                                          None)
+                            if adv is not None:
+                                adv(t0 + nxt)
+                            else:
+                                time.sleep(max(0.0, min(nxt - now,
+                                                        0.05)))
         except BaseException as e:
             self._fail_inflight(f"{type(e).__name__}: {e}")
-            self._wall_s += time.monotonic() - t0
+            self._wall_s += self._clock() - t0
             if self.telemetry is not None:
                 self.telemetry.failure(
                     "engine-killed", detail=f"{type(e).__name__}: {e}",
@@ -595,7 +609,7 @@ class Engine:
                 f"in-flight requests marked failed") from e
         # Accumulate: a multi-turn driver (BENCH_serve chat mode) calls
         # run() per wave and reads one whole-campaign summary at the end.
-        self._wall_s += time.monotonic() - t0
+        self._wall_s += self._clock() - t0
         return self.summary(record=record_summary)
 
     def step_once(self, now: float, t0: float) -> bool:
@@ -739,7 +753,7 @@ class Engine:
             # generated token (position t0) — TTFT stops here.
             first = int(jax.device_get(tok)[0])
             req.generated.append(first)
-            req.t_first_token = time.monotonic() - t0
+            req.t_first_token = self._clock() - t0
             req.state = RequestState.DECODE
             self._record_ttft(req)
             self._rtrace(req, "prefill", cursor=req.prefill_cursor,
@@ -950,7 +964,7 @@ class Engine:
     # -- lifecycle ----------------------------------------------------------
 
     def _complete(self, req: Request, t0: float) -> None:
-        req.t_done = time.monotonic() - t0
+        req.t_done = self._clock() - t0
         req.state = RequestState.COMPLETED
         # Offer the whole committed sequence (prompt + generation) to the
         # prefix tree BEFORE eviction drops our page references — this is

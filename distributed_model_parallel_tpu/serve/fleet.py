@@ -46,6 +46,7 @@ from collections import deque
 
 import jax
 
+from distributed_model_parallel_tpu.serve.cells import CellDirectory
 from distributed_model_parallel_tpu.serve.engine import (
     Engine,
     EngineKilled,
@@ -83,6 +84,7 @@ class Replica:
     state: str = LIVE
     quarantined_round: int | None = None
     kills: int = 0                   # quarantine cycles survived
+    cell: str | None = None          # cell membership (serve/cells.py)
 
 
 class ServeFleet:
@@ -104,9 +106,14 @@ class ServeFleet:
                  affinity_slack: float = 2.0, revive_after: int | None = None,
                  step_hook=None, slo_metrics: bool = True,
                  breaker: CircuitBreaker | None = None,
-                 faults=(), fault_replica: str | None = None):
+                 faults=(), fault_replica: str | None = None,
+                 cells=None, fault_cell: str | None = None,
+                 cell_sick_threshold: float = 0.5, clock=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if not 0.0 < cell_sick_threshold <= 1.0:
+            raise ValueError(f"cell_sick_threshold must be in (0, 1], "
+                             f"got {cell_sick_threshold}")
         if serve.policy != "continuous":
             raise ValueError(
                 "the fleet runs continuous-batching replicas; the static "
@@ -130,16 +137,51 @@ class ServeFleet:
         self.revive_after = revive_after
         self.step_hook = step_hook
         self._slo_metrics = slo_metrics
+        # Pluggable clock (serve/traffic.SimClock for the deterministic
+        # chaos scenarios; the real monotonic clock otherwise). Virtual
+        # mode advances one fixed dt per fleet round and skips idle gaps
+        # to the next arrival, so every TTFT/deadline/goodput number is
+        # a pure function of the trace + seed.
+        self._virtual = clock is not None
+        self._clock = clock if clock is not None else time.monotonic
         self.replicas: list[Replica] = []
         for i in range(n_replicas):
             name = f"r{i}"
             devs = pool.assign(f"serve-{name}", per)
             eng = Engine(params, cfg, serve, telemetry=telemetry,
-                         slo_metrics=slo_metrics, replica=name)
+                         slo_metrics=slo_metrics, replica=name,
+                         clock=clock)
             self.replicas.append(Replica(
                 name=name, engine=eng,
                 device_ids=tuple(d.id for d in devs)))
-        self.router = Router(router_seed, affinity_slack=affinity_slack)
+        # Cell topology (serve/cells.py): an int partitions the replicas
+        # into that many contiguous cells; a dict gives explicit
+        # membership; a CellDirectory passes through; None keeps the
+        # flat PR 14 fleet. Contiguous blocks + the pool's
+        # lowest-ids-first assignment make each cell's device slice a
+        # contiguous id range.
+        if cells is None:
+            self.cells = None
+        elif isinstance(cells, CellDirectory):
+            self.cells = cells
+        elif isinstance(cells, int):
+            self.cells = CellDirectory.partition(
+                [r.name for r in self.replicas], cells)
+        else:
+            self.cells = CellDirectory(cells)
+        if self.cells is not None:
+            known = {r.name for r in self.replicas}
+            for c in self.cells.cells:
+                missing = [n for n in self.cells.members(c)
+                           if n not in known]
+                if missing:
+                    raise ValueError(f"cell {c!r} names unknown replicas "
+                                     f"{missing}")
+            for rep in self.replicas:
+                rep.cell = self.cells.cell_of(rep.name)
+        self.cell_sick_threshold = cell_sick_threshold
+        self.router = Router(router_seed, affinity_slack=affinity_slack,
+                             cells=self.cells)
         # Router-level admission circuit breaker (serve/overload.py):
         # repeated admission failures — a replica's bounded queue
         # staying full, or injected admission chaos — take the replica
@@ -152,15 +194,45 @@ class ServeFleet:
         # admissions for a bounded run of attempts.
         self.injector = FaultInjector(faults) if faults else None
         for spec in (self.injector.plan if self.injector else ()):
-            if spec.site not in ("serve", "admit"):
+            if spec.site not in ("serve", "admit", "cell"):
                 raise ValueError(
-                    f"fleet fault plans serve only the serve/admit sites; "
-                    f"{spec.kind!r} fires at {spec.site!r} (train-side "
-                    f"faults belong on trainer RecoveryConfig plans)")
+                    f"fleet fault plans serve only the serve/admit/cell "
+                    f"sites; {spec.kind!r} fires at {spec.site!r} "
+                    f"(train-side faults belong on trainer "
+                    f"RecoveryConfig plans)")
+            if spec.site == "cell" and self.cells is None:
+                raise ValueError(
+                    f"{spec.kind!r} targets a cell, but the fleet has "
+                    f"no cell topology (pass cells=)")
         self._fault_replica = fault_replica or self.replicas[-1].name
         if not any(r.name == self._fault_replica for r in self.replicas):
             raise ValueError(f"unknown fault_replica "
                              f"{self._fault_replica!r}")
+        # The correlated-fault victim cell (kill_cell / slow_cell /
+        # partition): default the LAST cell — disjoint from the c0
+        # home-heavy head of the hash range often enough to keep drills
+        # interesting, and symmetric with fault_replica's default.
+        if self.cells is not None:
+            self._fault_cell = fault_cell or self.cells.cells[-1]
+            if self._fault_cell not in self.cells:
+                raise ValueError(f"unknown fault_cell "
+                                 f"{self._fault_cell!r}; known: "
+                                 f"{list(self.cells.cells)}")
+        elif fault_cell is not None:
+            raise ValueError("fault_cell needs a cell topology "
+                             "(pass cells=)")
+        else:
+            self._fault_cell = None
+        # Correlated-fault runtime state: cells the router currently
+        # cannot reach (partition), the active slow_cell period, cells
+        # taken down whole (for the grow-back record), and the resident
+        # requests caught inside an active partition (the drain-on-heal
+        # accounting).
+        self._partitioned: set[str] = set()
+        self._slow_period: int | None = None
+        self._cells_down: set[str] = set()
+        self._partition_caught: list = []
+        self._cell_kills = 0
         # Bounded fleet admission: beyond max_queue * n_replicas the
         # fleet REJECTS (typed, reason queue-full) instead of growing an
         # unbounded host-side list — batch sheds first: an arriving
@@ -192,12 +264,27 @@ class ServeFleet:
     def _live(self) -> list[Replica]:
         return [r for r in self.replicas if r.state == LIVE]
 
+    def _cell_members(self, cell: str) -> list[Replica]:
+        return [r for r in self.replicas if r.cell == cell]
+
+    def _live_cells(self) -> list[str]:
+        """Cells with at least one live, reachable replica — the
+        router's actual dispatch surface."""
+        if self.cells is None:
+            return []
+        return [c for c in self.cells.cells
+                if c not in self._partitioned
+                and any(r.state == LIVE for r in self._cell_members(c))]
+
     def _holder(self, rep: Replica) -> str:
         return f"serve-{rep.name}"
 
     def _set_live_gauge(self) -> None:
         if self._slo_metrics:
             registry().gauge("serve_live_replicas").set(len(self._live()))
+            if self.cells is not None:
+                registry().gauge("serve_live_cells").set(
+                    len(self._live_cells()))
 
     def _set_engine_gauges(self) -> None:
         """The fleet owns the process-global engine gauges: replica
@@ -254,10 +341,12 @@ class ServeFleet:
             "migrations": self._migrations,
             "replica_kills": self._kills,
             "router": {"assignments": dict(self.router.assignments),
-                       "affinity_hits": self.router.affinity_hits},
+                       "affinity_hits": self.router.affinity_hits,
+                       "failovers": self.router.failovers},
             "replicas": {
                 r.name: {
                     "state": r.state,
+                    "cell": r.cell,
                     "devices": list(r.device_ids),
                     "queue_depth": len(r.engine.sched.queue),
                     "active_requests": len(r.engine.sched.active()),
@@ -268,8 +357,35 @@ class ServeFleet:
                                        if r.engine.brownout is not None
                                        else None),
                 } for r in self.replicas},
+            "cells": self._cell_status(),
             "healthy": bool(self._live()),
         }
+
+    def _cell_status(self) -> dict | None:
+        """Per-cell rollup for /statusz and the fleet summary: member
+        liveness, reachability, aggregated breaker state, and (when the
+        health sentinel is wired) the quarantined fraction of the
+        cell's device slice."""
+        if self.cells is None:
+            return None
+        out = {}
+        for c in self.cells.cells:
+            members = self._cell_members(c)
+            devices = [d for r in members for d in r.device_ids]
+            out[c] = {
+                "members": [r.name for r in members],
+                "live": [r.name for r in members if r.state == LIVE],
+                "partitioned": c in self._partitioned,
+                "breaker": self.breaker.group_state(
+                    [r.name for r in members]),
+                "assignments": sum(
+                    self.router.assignments.get(r.name, 0)
+                    for r in members),
+                **({"device_quarantined_fraction": round(
+                        self.health.quarantined_fraction(devices), 3)}
+                   if self.health is not None else {}),
+            }
+        return out
 
     def results(self) -> list[Request]:
         return list(self._requests)
@@ -420,17 +536,18 @@ class ServeFleet:
         ``max_rounds``). Same contract as ``Engine.run``: a death marks
         every live request failed (typed) before :class:`EngineKilled`
         propagates."""
-        t0 = time.monotonic()
+        t0 = self._clock()
         try:
             with tracing.sink_scope(self.telemetry):
                 while not self._idle():
                     if max_rounds is not None and self._rounds >= max_rounds:
                         break
-                    now = time.monotonic() - t0
+                    now = self._clock() - t0
                     self._now = now
                     if self.step_hook is not None:
                         self.step_hook(self._rounds)
                     self._rounds += 1
+                    self._poll_cell_faults()
                     self._expire_pending(now)
                     progress = self._dispatch(now)
                     # Queue-bound trim AFTER dispatch (work-conserving:
@@ -439,6 +556,15 @@ class ServeFleet:
                     self._bound_pending(now)
                     for rep in self.replicas:
                         if rep.state != LIVE:
+                            continue
+                        if (self._slow_period is not None
+                                and rep.cell == self._fault_cell
+                                and self._rounds % self._slow_period):
+                            # slow_cell: the victim cell's replicas run
+                            # an engine iteration only every period-th
+                            # round — lockstep cell-wide slowdown, no
+                            # wall-clock sleep (virtual replays stay
+                            # exact). Residents decode slower; SLOs sag.
                             continue
                         w0 = time.monotonic()
                         if (self.injector is not None
@@ -470,14 +596,23 @@ class ServeFleet:
                             "all replicas quarantined with no revive "
                             "path")
                         continue
-                    if not progress:
+                    if self._virtual:
+                        # One round = one dt of virtual time; an idle
+                        # fleet skips straight to the next arrival.
+                        self._clock.tick()
+                        if not progress:
+                            nxt = min((r.arrival_s for r in self._pending),
+                                      default=None)
+                            if nxt is not None:
+                                self._clock.advance_to(t0 + nxt)
+                    elif not progress:
                         nxt = min((r.arrival_s for r in self._pending),
                                   default=None)
                         if nxt is not None:
                             time.sleep(max(0.0, min(nxt - now, 0.05)))
         except BaseException as e:
             self._fail_fleet(f"{type(e).__name__}: {e}")
-            self._wall_s += time.monotonic() - t0
+            self._wall_s += self._clock() - t0
             if self.telemetry is not None:
                 self.telemetry.failure(
                     "fleet-killed", detail=f"{type(e).__name__}: {e}",
@@ -491,7 +626,7 @@ class ServeFleet:
             raise EngineKilled(
                 f"fleet died at round {self._rounds}; in-flight requests "
                 f"marked failed") from e
-        self._wall_s += time.monotonic() - t0
+        self._wall_s += self._clock() - t0
         return self.summary(record=record_summary)
 
     def _idle(self) -> bool:
@@ -552,10 +687,11 @@ class ServeFleet:
             if not live:
                 break                 # all quarantined: wait for grow-back
             candidates = [r for r in live
-                          if self.breaker.allows(r.name, self._rounds)]
+                          if r.cell not in self._partitioned
+                          and self.breaker.allows(r.name, self._rounds)]
             self._emit_breaker_records()   # half-open transitions
             if not candidates:
-                break                 # every breaker open: wait it out
+                break    # every breaker open / cell unreachable: wait
             placed = None
             while candidates:
                 rep, reason, loads = self.router.pick(
@@ -583,6 +719,49 @@ class ServeFleet:
                     loads={k: round(v, 3) for k, v in sorted(loads.items())})
             progress = True
         return progress
+
+    def _poll_cell_faults(self) -> None:
+        """Once-per-round poll of the ``cell`` fault site (utils/faults):
+        ``kill_cell`` fires the REAL quarantine→drain→migrate path for
+        every member of the victim cell at once; ``partition`` flips the
+        router's reachability for the victim cell (typed ``cell``
+        records on both edges, with the drain-on-heal accounting of the
+        residents caught inside); ``slow_cell`` sets the step-skip
+        period the round loop honors. No sleeps, no randomness — the
+        scenario replays bit-for-bit."""
+        if self.injector is None or self._fault_cell is None:
+            return
+        for spec in self.injector.poll("cell"):
+            if spec.kind == "kill_cell":
+                self.kill_cell(self._fault_cell)
+        self._slow_period = self.injector.cell_slow_period()
+        active = self.injector.partition_active()
+        if active and self._fault_cell not in self._partitioned:
+            self._partitioned.add(self._fault_cell)
+            # Residents caught inside the partition: they keep decoding
+            # (the cell is unreachable, not dead) and the heal record
+            # reports how many drained out in the meantime.
+            self._partition_caught = [
+                req for rep in self._cell_members(self._fault_cell)
+                if rep.state == LIVE
+                for req in rep.engine.sched.active()]
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "cell", event="partition", cell=self._fault_cell,
+                    round=self._rounds,
+                    residents=len(self._partition_caught))
+            self._set_live_gauge()
+        elif not active and self._fault_cell in self._partitioned:
+            self._partitioned.discard(self._fault_cell)
+            drained = sum(1 for r in self._partition_caught if r.done)
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "cell", event="heal", cell=self._fault_cell,
+                    round=self._rounds,
+                    residents=len(self._partition_caught),
+                    drained=drained)
+            self._partition_caught = []
+            self._set_live_gauge()
 
     def _observe(self, rep: Replica, seconds: float) -> None:
         """Feed the replica's round wall time to the health sentinel as
@@ -614,9 +793,12 @@ class ServeFleet:
                 reinstated += ev["devices"]
         if quarantined:
             bad = set(quarantined)
+            fresh = []
             for rep in self.replicas:
                 if rep.state == LIVE and bad & set(rep.device_ids):
                     self._quarantine_replica(rep, reason="device-degraded")
+                    fresh.append(rep)
+            self._cell_sweep(fresh)
         if reinstated:
             back = set(reinstated)
             still_bad = set(self.health.quarantined_ids)
@@ -654,10 +836,82 @@ class ServeFleet:
             if rep.name == name:
                 if rep.state != LIVE:
                     raise ValueError(f"replica {name!r} is {rep.state}")
-                return self._quarantine_replica(rep, reason=reason)
+                migrated = self._quarantine_replica(rep, reason=reason)
+                self._cell_sweep([rep])
+                return migrated
         raise KeyError(f"unknown replica {name!r}")
 
+    def kill_cell(self, cell: str, *, reason: str = "cell-killed") -> int:
+        """Correlated-failure entry point: quarantine + drain EVERY live
+        member of ``cell`` at once (a rack power event, a cell-wide
+        rollout gone bad). Every member is drained BEFORE anyone is
+        re-placed, so no request ever migrates onto a sibling that is
+        about to die in the same event — placements go cross-cell by
+        construction. Returns requests migrated."""
+        if self.cells is None:
+            raise ValueError("kill_cell needs a cell topology "
+                             "(pass cells=)")
+        if cell not in self.cells:
+            raise KeyError(f"unknown cell {cell!r}; known: "
+                           f"{list(self.cells.cells)}")
+        victims = [r for r in self._cell_members(cell) if r.state == LIVE]
+        if not victims:
+            raise ValueError(f"cell {cell!r} has no live replica to kill")
+        drained: list[tuple[Request, Replica]] = []
+        for rep in victims:
+            for req in self._drain_out(rep, reason=reason):
+                drained.append((req, rep))
+        self._cells_down.add(cell)
+        self._cell_kills += 1
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "cell", event="kill", cell=cell, round=self._rounds,
+                replicas=[r.name for r in victims], reason=reason,
+                requests_draining=len(drained))
+        migrated = 0
+        for req, rep in drained:
+            migrated += self._migrate(req, rep)
+        return migrated
+
+    def _cell_sweep(self, fresh: list[Replica]) -> None:
+        """Cell-sick aggregation: when MORE than ``cell_sick_threshold``
+        of a cell's members are quarantined, the stragglers are presumed
+        to share the correlated cause (rack power, bad rollout wave) and
+        are quarantined too — the cell fails as a unit, exactly as it
+        grows back as one. Only FRESH quarantines trigger the sweep, so
+        a cell growing back member-by-member is never re-condemned for
+        still being mostly down."""
+        if self.cells is None:
+            return
+        for cell in sorted({r.cell for r in fresh if r.cell is not None}):
+            members = self._cell_members(cell)
+            down = sum(1 for r in members if r.state == QUARANTINED)
+            if down / len(members) <= self.cell_sick_threshold:
+                continue
+            rest = [r for r in members if r.state == LIVE]
+            if not rest:
+                continue
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "cell", event="sick", cell=cell, round=self._rounds,
+                    quarantined=down, members=len(members),
+                    swept=[r.name for r in rest])
+            self._cells_down.add(cell)
+            for rep in rest:
+                if rep.state == LIVE:
+                    self._quarantine_replica(rep, reason="cell-sick")
+
     def _quarantine_replica(self, rep: Replica, *, reason: str) -> int:
+        migrated = 0
+        for req in self._drain_out(rep, reason=reason):
+            migrated += self._migrate(req, rep)
+        return migrated
+
+    def _drain_out(self, rep: Replica, *, reason: str) -> list[Request]:
+        """Take ``rep`` out of service and return its drained requests
+        (committed tokens + KV pages serialized by value) WITHOUT
+        re-placing them — ``kill_cell`` drains a whole cell before any
+        migration, single-replica paths migrate immediately."""
         drained = rep.engine.drain()
         rep.engine.clear_cache()     # raises if any page is still held
         rep.state = QUARANTINED
@@ -674,19 +928,21 @@ class ServeFleet:
                                  f"({reason}) devices {rep.device_ids} out "
                                  f"of service, {len(drained)} requests "
                                  f"draining")
-        migrated = 0
-        for req in drained:
-            migrated += self._migrate(req, rep)
-        return migrated
+        return drained
 
     def _migrate(self, req: Request, source: Replica) -> int:
-        live = self._live()
+        # A partitioned cell's replicas are unreachable for placements
+        # too: the router cannot hand existing load to a cell it cannot
+        # talk to (its residents keep decoding — they just get no new
+        # neighbors until the heal).
+        live = [r for r in self._live()
+                if r.cell not in self._partitioned]
         if not live:
             # Nowhere to drain to: the request fails typed, exactly like
             # an engine kill — never silently dropped.
             req.state = RequestState.FAILED
             req.error = (f"fleet-killed: replica {source.name} quarantined "
-                         f"with no live peer")
+                         f"with no reachable live peer")
             req.resume = None
             tracing.rtrace(req, "failed", sink=self.telemetry,
                            error="no-live-replica")
@@ -747,6 +1003,18 @@ class ServeFleet:
                 "event", message=f"fleet grow-back: replica {rep.name} "
                                  f"devices {rep.device_ids} back in "
                                  f"service")
+        if (rep.cell is not None and rep.cell in self._cells_down
+                and all(r.state == LIVE
+                        for r in self._cell_members(rep.cell))):
+            # The whole cell is back on its exact device slices: the
+            # correlated failure's grow-back edge, as a unit.
+            self._cells_down.discard(rep.cell)
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "cell", event="grow-back", cell=rep.cell,
+                    round=self._rounds,
+                    replicas=[r.name
+                              for r in self._cell_members(rep.cell)])
 
     def _fail_fleet(self, detail: str) -> None:
         for rep in self.replicas:
@@ -837,7 +1105,13 @@ class ServeFleet:
                              else None),
             "rounds": self._rounds,
             "router": {"assignments": dict(self.router.assignments),
-                       "affinity_hits": self.router.affinity_hits},
+                       "affinity_hits": self.router.affinity_hits,
+                       "failovers": self.router.failovers},
+            "cells": ({"layout": self.cells.as_dict(),
+                       "live": self._live_cells(),
+                       "cell_kills": self._cell_kills,
+                       "partitioned": sorted(self._partitioned)}
+                      if self.cells is not None else None),
             "ttft_s": summarize(ttft),
             "queue_wait_s": summarize(waits),
             "token_latency_s": summarize(token_lat),

@@ -244,6 +244,18 @@ class CircuitBreaker:
     def snapshot(self) -> dict[str, str]:
         return {name: c["state"] for name, c in sorted(self._cells.items())}
 
+    def group_state(self, names) -> str:
+        """Aggregated state over a group of replicas (a serving cell's
+        per-cell rollup, serve/fleet.py): ``open`` when EVERY member's
+        breaker is open (the whole group refuses traffic), ``degraded``
+        when any member is open or half-open, else ``closed``."""
+        states = [self.state(n) for n in names]
+        if states and all(s == OPEN for s in states):
+            return OPEN
+        if any(s in (OPEN, HALF_OPEN) for s in states):
+            return "degraded"
+        return CLOSED
+
     def _transition(self, name: str, state: str, rnd: int,
                     fails: int) -> None:
         self.transitions.append({"replica": name, "state": state,

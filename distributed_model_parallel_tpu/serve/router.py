@@ -16,11 +16,22 @@ gives near-best-of-all balancing at O(1) cost and — unlike
 least-loaded-of-all — does not herd every burst onto one replica between
 load refreshes (the classic Mitzenmacher result).
 
+Cell topology (serve/cells.py): on a celled fleet the key becomes
+**(cell, prefix, load)** — prefix affinity still wins outright (the KV
+pages live where they live), otherwise the prompt's deterministic home
+cell (a seeded hash over the FULL configured cell list, so a down cell
+never reshuffles other prompts' homes) confines p2c to the home cell's
+candidates (reason ``cell-local``); only when the home cell offers no
+admitting candidate — killed, partitioned, breakers open — does p2c
+widen to the remaining cells (reason ``failover``).
+
 Determinism: the rng is seeded, sampling order is submission order, and
 load is pure bookkeeping — the same trace through the same fleet yields
-the same assignment sequence (tests/test_fleet.py pins it). Migration
-re-admissions bypass p2c and go least-loaded: a drain dumps a burst of
-requests at once, and spreading them by load is the point.
+the same assignment sequence, before, during and after a
+quarantine→reinstate cycle (tests/test_fleet.py and tests/test_cells.py
+pin it). Migration re-admissions bypass p2c and go least-loaded: a
+drain dumps a burst of requests at once, and spreading them by load is
+the point.
 """
 
 from __future__ import annotations
@@ -42,16 +53,22 @@ class Router:
     """
 
     def __init__(self, seed: int = 0, *, affinity_slack: float = 2.0,
-                 affinity_min_tokens: int = 1):
+                 affinity_min_tokens: int = 1, cells=None):
         if affinity_slack < 0:
             raise ValueError(f"affinity_slack must be >= 0, got "
                              f"{affinity_slack}")
+        self._seed = int(seed)
         self._rng = random.Random(seed)
         self.affinity_slack = affinity_slack
         self.affinity_min_tokens = affinity_min_tokens
+        # Cell topology (serve/cells.py CellDirectory, or None for the
+        # flat PR 14 fleet): home-cell hashing + cell-local p2c with
+        # cross-cell failover.
+        self.cells = cells
         # name -> requests routed there (statusz + the fleet summary)
         self.assignments: dict[str, int] = {}
         self.affinity_hits = 0
+        self.failovers = 0
 
     @staticmethod
     def load(replica) -> float:
@@ -67,7 +84,9 @@ class Router:
              request=None, sink=None) -> tuple[object, str, dict]:
         """Choose a live replica for ``prompt``. Returns ``(replica,
         reason, loads)`` where reason is ``affinity`` (prefix-cache
-        match won), ``p2c`` (power-of-two-choices), ``only`` (one
+        match won), ``p2c`` (power-of-two-choices), ``cell-local``
+        (p2c confined to the prompt's home cell), ``failover`` (home
+        cell unreachable — p2c over the other cells), ``only`` (one
         candidate), or ``migrate`` (least-loaded drain placement).
         ``loads`` maps replica name -> load at decision time (the typed
         ``router`` record's payload). ``commit=False`` defers the
@@ -104,6 +123,8 @@ class Router:
         self.assignments[name] = self.assignments.get(name, 0) + 1
         if reason == "affinity":
             self.affinity_hits += 1
+        if reason == "failover":
+            self.failovers += 1
         if request is not None:
             fields = {"loads": loads} if loads is not None else {}
             tracing.rtrace(request, "route", sink=sink, replica=name,
@@ -119,12 +140,25 @@ class Router:
         if (aff_rep is not None and best_aff >= self.affinity_min_tokens
                 and loads[aff_rep.name] <= min_load + self.affinity_slack):
             return aff_rep, "affinity"
+        if self.cells is not None:
+            home = self.cells.home(prompt, self._seed)
+            local = [r for r in replicas
+                     if self.cells.cell_of(r.name) == home]
+            if local:
+                return self._p2c(local, loads), "cell-local"
+            # Home cell killed/partitioned/breaker-open: fail over
+            # across whatever the other cells offer.
+            return self._p2c(replicas, loads), "failover"
+        return self._p2c(replicas, loads), "p2c"
+
+    def _p2c(self, replicas, loads):
         # Power-of-two-choices: two distinct seeded samples, less loaded
         # wins. Exact ties go to the FIRST sampled — the sample order is
         # itself seeded-random, so idle replicas share ties instead of
         # herding onto a fixed favorite (a (load, name) tie-break would
         # send a lightly-loaded fleet's whole trace to one replica).
+        if len(replicas) == 1:
+            return replicas[0]
         a, b = self._rng.sample(range(len(replicas)), 2)
         ra, rb = replicas[a], replicas[b]
-        chosen = ra if loads[ra.name] <= loads[rb.name] else rb
-        return chosen, "p2c"
+        return ra if loads[ra.name] <= loads[rb.name] else rb

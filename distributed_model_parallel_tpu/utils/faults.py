@@ -62,6 +62,25 @@ kind           site    effect when fired
                        whose submission path is broken while its residents
                        keep decoding; the router's circuit breaker is the
                        intended detector (serve/overload.py)
+``kill_cell``  cell    quarantine + drain EVERY replica of the serving
+                       fleet's victim cell at once (the regional-failure
+                       shape: a rack power event, a cell-wide rollout
+                       gone bad) — each member walks the REAL
+                       quarantine→drain→migrate path and the cell grows
+                       back as a unit (serve/fleet.py ``kill_cell``)
+``slow_cell``  cell    PERSISTENT degradation: from the firing cell-site
+                       poll on, the victim cell's replicas run engine
+                       iterations only every ``param``-th fleet round
+                       (default 4, must be >= 2) — a whole cell slowed
+                       in lockstep (thermal event, antagonist job), so
+                       its residents decode slower and its SLOs sag
+                       while the rest of the fleet is untouched
+``partition``  cell    PERSISTENT (bounded): for ``param`` cell-site
+                       polls after firing (default 8) the router cannot
+                       reach the victim cell — no new dispatches, no
+                       migration placements land there — while its
+                       residents keep decoding and drain out on heal
+                       (serve/fleet.py queries ``partition_active``)
 =============  ======  =====================================================
 
 Sites are consulted by the trainers (``step``), ``GuardRunner.watch``
@@ -116,6 +135,9 @@ FAULT_SITES = {
     "flaky_sync": "sync",
     "slow_replica": "serve",
     "admission_fail": "admit",
+    "kill_cell": "cell",
+    "slow_cell": "cell",
+    "partition": "cell",
 }
 
 # Faults that silently corrupt ONE data-parallel replica's state (served by
@@ -129,7 +151,8 @@ CORRUPTION_KINDS = frozenset({"bitflip", "desync", "grad_skew"})
 # FaultInjector.poll itself (the injector owns the ramp state), detected by
 # the device-health sentinel (utils/health.py), not by the guards.
 DEGRADATION_KINDS = frozenset({"slow_device", "flaky_sync",
-                               "slow_replica", "admission_fail"})
+                               "slow_replica", "admission_fail",
+                               "slow_cell", "partition"})
 
 # slow_device ramp: delay = param * min(polls_since_firing, cap) — linear
 # decline toward a bounded worst case, so a soak stays finite.
@@ -140,6 +163,14 @@ FLAKY_SYNC_PERIOD = 2
 # after firing (param overrides) — bounded, so the breaker's half-open
 # probe eventually lands and the cycle closes.
 ADMISSION_FAIL_POLLS = 6
+# slow_cell cadence: the victim cell's replicas run an engine iteration
+# only every PERIOD-th fleet round while the degradation is active
+# (param overrides; must be >= 2 or nothing is slowed).
+SLOW_CELL_PERIOD = 4
+# partition duration: the victim cell is router-unreachable for this
+# many cell-site polls after firing (param overrides) — bounded, so the
+# cell always heals and its residents drain out.
+PARTITION_POLLS = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -264,6 +295,9 @@ class FaultInjector:
                 # the health sentinel's serve signal sees the outlier.
                 time.sleep(s.param if s.param is not None else 0.05)
             # admission_fail: no sleep — queried via admission_blocked().
+            # slow_cell / partition: no sleep — queried by the fleet via
+            # cell_slow_period() / partition_active(); a wall-clock
+            # sleep would break the virtual-clock scenario replays.
 
     def admission_blocked(self) -> bool:
         """True while an ``admission_fail`` degradation is active: it
@@ -278,6 +312,40 @@ class FaultInjector:
                 continue
             dur = (int(s.param) if s.param is not None
                    else ADMISSION_FAIL_POLLS)
+            if n <= dur:
+                return True
+        return False
+
+    def cell_slow_period(self) -> int | None:
+        """The active ``slow_cell`` degradation's iteration period, or
+        ``None`` when no slow_cell is live: while active, the victim
+        cell's replicas run an engine iteration only every period-th
+        fleet round (serve/fleet.py) — lockstep cell-wide slowdown with
+        no wall-clock sleep, so virtual-clock replays stay exact."""
+        for s in self._degradations:
+            if s.kind != "slow_cell":
+                continue
+            period = (int(s.param) if s.param is not None
+                      else SLOW_CELL_PERIOD)
+            if period < 2:
+                raise ValueError(
+                    f"slow_cell period must be >= 2 (a period of "
+                    f"{period} slows nothing)")
+            return period
+        return None
+
+    def partition_active(self) -> bool:
+        """True while a ``partition`` degradation is active: it fired,
+        and fewer than its duration (``param`` cell-site polls, default
+        PARTITION_POLLS) have elapsed since. The serving fleet consults
+        this once per round — an active partition removes the victim
+        cell from the routing AND migration candidate sets while its
+        residents keep decoding (serve/fleet.py)."""
+        for s, n in self._degradations.items():
+            if s.kind != "partition":
+                continue
+            dur = (int(s.param) if s.param is not None
+                   else PARTITION_POLLS)
             if n <= dur:
                 return True
         return False

@@ -166,6 +166,16 @@ class DeviceHealthMonitor:
             return tuple(sorted(i for i, s in self._state.items()
                                 if s == QUARANTINED))
 
+    def quarantined_fraction(self, ids) -> float:
+        """Fraction of ``ids`` currently quarantined (0.0 for an empty
+        group) — the serving fleet's per-cell device-health rollup
+        (serve/fleet.py ``_cell_status``)."""
+        ids = list(ids)
+        if not ids:
+            return 0.0
+        bad = set(self.quarantined_ids)
+        return sum(1 for i in ids if i in bad) / len(ids)
+
     def snapshot(self) -> dict:
         """JSON-ready view of the sentinel's state — the statusz
         exporter's ``/statusz`` health block and the flight recorder's

@@ -360,8 +360,9 @@ def _serving_section(lines: list[str], by_kind: dict) -> None:
 def _fleet_serving_section(lines: list[str], by_kind: dict) -> None:
     """Multi-replica fleet serving (serve/fleet.py): router assignment
     counts from the typed ``router`` records, live migrations from the
-    ``migration`` records, and the fleet summary's replica table — the
-    post-mortem view of a replica-kill drill."""
+    ``migration`` records, cell lifecycle events (typed ``cell``
+    records, serve/cells.py) and the fleet summary's replica + per-cell
+    tables — the post-mortem view of a replica- or cell-kill drill."""
     routed = by_kind.get("router") or []
     migs = by_kind.get("migration") or []
     fleet_sums = [r for r in by_kind.get("serve") or []
@@ -391,6 +392,13 @@ def _fleet_serving_section(lines: list[str], by_kind: dict) -> None:
             f"round {m.get('round')})")
     if len(migs) > len(shown):
         lines.append(f"  ... and {len(migs) - len(shown)} more migrations")
+    cell_recs = by_kind.get("cell") or []
+    if cell_recs:
+        ev: dict[str, int] = {}
+        for c in cell_recs:
+            ev[str(c.get("event"))] = ev.get(str(c.get("event")), 0) + 1
+        lines.append("cell events: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(ev.items())))
     for s in fleet_sums:
         reps = s.get("replicas") or {}
         states = "  ".join(
@@ -402,6 +410,20 @@ def _fleet_serving_section(lines: list[str], by_kind: dict) -> None:
             f"replicas live, {s.get('requests_migrated', 0)} requests "
             f"migrated over {s.get('migrations', 0)} moves, "
             f"{s.get('replica_kills', 0)} kills   {states}")
+        cb = s.get("cells") or {}
+        if cb:
+            layout = cb.get("layout") or {}
+            live = cb.get("live") or []
+            extra = ""
+            if cb.get("cell_kills"):
+                extra += f", {cb['cell_kills']} cell kills"
+            if cb.get("partitioned"):
+                extra += f", partitioned {','.join(cb['partitioned'])}"
+            lines.append(
+                f"  cells: {len(live)}/{len(layout)} live ("
+                + "  ".join(f"{c}[{len(m)}]"
+                            for c, m in sorted(layout.items()))
+                + ")" + extra)
 
 
 def _rtrace_summary(by_kind: dict) -> dict | None:

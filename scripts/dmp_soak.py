@@ -25,13 +25,25 @@ Modes:
   campaign is the fast campaign's shape scaled by ``--tenants`` /
   ``--epochs``.
 
-Scenarios (``--scenario``): ``chaos`` (the campaign above) or
+Scenarios (``--scenario``): ``chaos`` (the campaign above);
 ``degradation`` — the device-health drill (utils/health.py): an
 injected ``slow_device`` ramp must get its slice quarantined, its
 tenant proactively migrated through the preempt-checkpoint path
 (dp4 -> dp2), and grown back to the requested dp at the exact global
 step after probation, with a sub-threshold ``flaky_sync`` bystander as
-the false-positive control (see ``run_degradation_campaign``).
+the false-positive control (see ``run_degradation_campaign``);
+``overload`` and ``xray`` — the serving-fleet overload and
+request-tracing drills; and the FLEET scenarios ``failover`` /
+``flashcrowd`` / ``flood`` / ``diurnal`` — seeded production traffic
+(serve/traffic.py) replayed on a virtual clock through an N-replica
+multi-cell serving fleet (``--replicas`` / ``--cells``) while a
+cell-scale correlated fault (``kill_cell`` / ``slow_cell`` /
+``partition``, utils/faults.py) hits one cell, gated on zero lost
+requests, bitwise token parity, complete rtrace timelines, goodput
+within ``--goodput-band`` of the clean run, and exact-slice cell
+grow-back (see ``run_fleet_scenario``). Any scenario's gate violation
+dumps a flight-recorder postmortem bundle and prints its path before
+the nonzero exit.
 
 Every campaign gates on the same four invariants and exits non-zero when
 any fails:
@@ -86,7 +98,8 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mode", default="fast", choices=["fast", "long"])
     p.add_argument("--scenario", default="chaos",
-                   choices=["chaos", "degradation", "overload", "xray"],
+                   choices=["chaos", "degradation", "overload", "xray",
+                            "failover", "flashcrowd", "flood", "diurnal"],
                    help="chaos: the heterogeneous fault campaign; "
                         "degradation: the device-health drill — an "
                         "injected slow_device straggler must be "
@@ -105,10 +118,28 @@ def parse_args(argv=None):
                         "complete causally ordered rtrace timeline for "
                         "every admitted request, with migration hops "
                         "linked across the source/destination streams "
-                        "and zero orphan spans (scripts/dmp_xray.py)")
+                        "and zero orphan spans (scripts/dmp_xray.py); "
+                        "failover / flashcrowd / flood / diurnal: the "
+                        "cell-scale correlated-failure drills — seeded "
+                        "production traffic (serve/traffic.py) replayed "
+                        "on a virtual clock through an N-replica, "
+                        "multi-cell serving fleet while a correlated "
+                        "fault (kill_cell / slow_cell / partition — "
+                        "utils/faults.py) hits one cell, gated on zero "
+                        "lost requests, bitwise token parity, complete "
+                        "rtrace timelines, goodput >= --goodput-band of "
+                        "the clean run and (failover) exact-slice cell "
+                        "grow-back (see run_fleet_scenario)")
     p.add_argument("--goodput-band", default=0.8, type=float,
-                   help="overload scenario: goodput under 2x load must "
-                        "stay >= this fraction of clean-run capacity")
+                   help="overload/fleet scenarios: goodput under the "
+                        "event must stay >= this fraction of clean-run "
+                        "capacity")
+    p.add_argument("--replicas", default=16, type=int,
+                   help="fleet scenarios: serving replicas (>= --cells; "
+                        "the headline drill runs 16)")
+    p.add_argument("--cells", default=4, type=int,
+                   help="fleet scenarios: cells the replicas partition "
+                        "into (>= 2 — failover needs a surviving cell)")
     p.add_argument("--seed", default=0, type=int,
                    help="campaign seed: fault kinds/sites, priorities and "
                         "event rounds all derive from it — same seed, "
@@ -901,15 +932,377 @@ def run_xray_campaign(args, workdir: str, seed: int) -> tuple[dict, bool]:
     return out, ok
 
 
+# ---------------------------------------------------------------------------
+# the fleet scenarios: production traffic + cell-scale correlated failures
+# ---------------------------------------------------------------------------
+
+FLEET_SCENARIOS = ("failover", "flashcrowd", "flood", "diurnal")
+
+
+class _FakeDev:
+    """Pool bookkeeping device: replicas run replicated on CPU; the ids
+    are the quarantine/grow-back accounting the drill gates on (the same
+    stand-in tests/test_fleet.py uses for DevicePool)."""
+
+    def __init__(self, i: int):
+        self.id = i
+
+
+def _schedule_digest(records: list[dict]) -> dict:
+    """Normalized fleet event schedule + its hash: router assignments,
+    migration hops, typed sheds, breaker transitions and cell events in
+    stream order, with timestamps and load snapshots stripped — the
+    replay-determinism contract is about WHAT happened to WHOM in WHICH
+    round, not microsecond jitter (tests/test_soak.py replays a scenario
+    twice and compares digests)."""
+    import hashlib
+
+    keys = []
+    for r in records:
+        k = r.get("kind")
+        if k == "router":
+            keys.append(["router", r.get("request"), r.get("replica"),
+                         r.get("reason"), r.get("round")])
+        elif k == "migration":
+            keys.append(["migration", r.get("request"),
+                         r.get("from_replica"), r.get("to_replica"),
+                         r.get("round")])
+        elif k == "shed":
+            keys.append(["shed", r.get("request"), r.get("reason"),
+                         r.get("state")])
+        elif k == "breaker":
+            keys.append(["breaker", r.get("replica"), r.get("state"),
+                         r.get("round")])
+        elif k == "cell":
+            keys.append(["cell", r.get("event"), r.get("cell"),
+                         r.get("round")])
+    blob = json.dumps(keys, separators=(",", ":")).encode()
+    return {"events": len(keys),
+            "sha256": hashlib.sha256(blob).hexdigest()}
+
+
+def run_fleet_scenario(args, workdir: str, seed: int,
+                       scenario: str) -> tuple[dict, bool]:
+    """One production-traffic + correlated-failure drill on a celled
+    serving fleet (docs/SERVING.md "Scenario catalog").
+
+    Three deterministic runs on a virtual clock (serve/traffic.SimClock
+    — no wall-clock sleeps, so the event schedule is a pure function of
+    the seed):
+
+    * **reference** — every request of the trace on one clean engine,
+      closed loop, no deadlines: the bitwise per-request token
+      references;
+    * **clean** — the scenario's traffic through the SAME fleet shape
+      with no fault armed: the goodput baseline (for ``flood`` the
+      clean trace is the background WITHOUT the flood burst — the gate
+      is that the flood must not starve the background class);
+    * **chaos** — the same traffic with the scenario's correlated fault
+      riding the cell site (utils/faults.py).
+
+    Scenario -> traffic x fault:
+
+    ==============  ==========================  =========================
+    scenario        traffic (serve/traffic.py)  correlated fault
+    ==============  ==========================  =========================
+    ``failover``    mixed tenants (per-tenant   ``kill_cell`` mid-trace +
+                    SLO classes)                exact-slice grow-back
+    ``flashcrowd``  diurnal base + rectangular  ``slow_cell`` through the
+                    arrival spike               spike (brownout armed)
+    ``flood``       interactive background +    none — the flood IS the
+                    long-prompt batch flood     event (overload plane)
+    ``diurnal``     one compressed diurnal      ``partition`` across the
+                    cycle                       peak, heal + drain-out
+    ==============  ==========================  =========================
+
+    Gates (non-zero exit when any fails):
+
+    1. zero lost requests — every submitted request either completes or
+       lands on a typed shed record; zero real failures;
+    2. bitwise token parity — every completed request's tokens match
+       its reference (brownout-clamped requests: the bitwise prefix);
+    3. complete rtrace timelines — one joined timeline per submitted
+       request, zero orphan spans;
+    4. goodput — in-deadline completed tokens per virtual second >=
+       ``--goodput-band`` of the clean run's rate (``flood``: over the
+       background population on both sides);
+    5. the scenario's event provably happened (cell kill + grow-back
+       records, slow_cell fired, flood burst present, partition + heal
+       records) — a drill whose fault never fired proves nothing;
+    6. ``failover`` only: EXACT grow-back — every replica live again on
+       exactly its original device slice.
+
+    The normalized event schedule (``_schedule_digest``) rides the
+    summary: same seed => same digest, the replay-determinism property
+    tests/test_soak.py pins.
+    """
+    import jax
+
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.orchestrator.scheduler import (
+        DevicePool,
+    )
+    from distributed_model_parallel_tpu.serve import (
+        Engine,
+        ServeConfig,
+        ServeFleet,
+        SimClock,
+        adversarial_flood,
+        diurnal,
+        flash_crowd,
+        mixed_tenants,
+    )
+    from distributed_model_parallel_tpu.serve.scheduler import RequestState
+    from distributed_model_parallel_tpu.utils.telemetry import (
+        TelemetryRun,
+        join_request_traces,
+        read_records,
+    )
+    from scripts.dmp_report import build_report
+
+    n_replicas, n_cells = args.replicas, args.cells
+    if n_cells < 2:
+        raise SystemExit("fleet scenarios need --cells >= 2 (failover "
+                         "needs a surviving cell to fail over to)")
+    if n_replicas < n_cells:
+        raise SystemExit(f"--replicas {n_replicas} < --cells {n_cells}: "
+                         f"every cell needs at least one replica")
+
+    dt = 0.02
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots, page, max_len = 2, 8, 64
+    base = dict(n_slots=n_slots, page_size=page,
+                n_pages=(n_slots + 1) * (-(-max_len // page)),
+                max_seq_len=max_len, prefill_chunk=4)
+
+    # Scenario -> (chaos trace, clean trace, fault plan, serve config,
+    # revive_after). Rates are requests per VIRTUAL second; one fleet
+    # round advances dt, so fault `at` indexes (cell-site polls == fleet
+    # rounds) map to virtual time as at * dt.
+    overload_kw = dict(queue_budget_s=1.2, deadline_s=3.0,
+                       max_queue=2 * n_slots, brownout=True,
+                       brownout_ttft_target_s=0.3, brownout_budget=0.25,
+                       brownout_window_s=0.2, brownout_max_new=8,
+                       brownout_hold_iters=4)
+    revive_after = None
+    if scenario == "failover":
+        trace = mixed_tenants(seed, horizon_s=3.0, tenants={
+            # ~44 req/s against 16x2 slots: enough standing load that
+            # the kill provably catches residents mid-decode (the
+            # migration path is the thing under drill).
+            "web": {"rate": 22.0, "priority": "interactive"},
+            "mobile": {"rate": 12.0, "priority": "interactive"},
+            "etl": {"rate": 10.0, "priority": "batch",
+                    "gen": (14, 22)},
+        })
+        clean_trace = trace
+        faults = ("kill_cell@50",)      # ~1.0 virtual s: mid-trace, busy
+        serve = ServeConfig(**base)     # no deadlines: everything lands
+        revive_after = 45
+    elif scenario == "flashcrowd":
+        # Spike sized PAST the fleet's decode capacity (~150 req/s at
+        # 16x2 slots) so the brownout/shed machinery actually engages.
+        trace = flash_crowd(seed, horizon_s=3.0, base_rate=8.0,
+                            spike_at_s=1.0, spike_s=0.5, spike_rate=160.0)
+        clean_trace = trace
+        faults = ("slow_cell@45:2",)    # the cell slows INTO the spike
+        serve = ServeConfig(**base, **overload_kw)
+    elif scenario == "flood":
+        # 48 outsized batch requests landing at once: more than the
+        # fleet's 16x2 slots and most of its bounded queue — the
+        # priority shed order must keep the interactive background
+        # whole while the flood tenant eats the typed sheds.
+        kw = dict(horizon_s=3.0, base_rate=8.0, flood_at_s=1.0)
+        trace = adversarial_flood(seed, flood_n=48, **kw)
+        # Same seed, no burst: the background stream is drawn FIRST from
+        # the rng, so it is bit-identical with and without the flood.
+        clean_trace = adversarial_flood(seed, flood_n=0, **kw)
+        faults = ()                     # the traffic IS the event
+        # Tighter queue budget than the other overload scenarios: the
+        # flood's second wave must provably hit the typed shed path,
+        # not merely queue politely behind the first.
+        serve = ServeConfig(**base, **{**overload_kw,
+                                       "queue_budget_s": 0.5,
+                                       "deadline_s": 2.5})
+    elif scenario == "diurnal":
+        trace = diurnal(seed, horizon_s=4.0, base_rate=4.0,
+                        peak_rate=18.0)
+        clean_trace = trace
+        faults = ("partition@90:30",)   # unreachable across the peak
+        serve = ServeConfig(**base, queue_budget_s=1.5, deadline_s=3.5,
+                            max_queue=2 * n_slots)
+    else:
+        raise SystemExit(f"unknown fleet scenario {scenario!r}")
+
+    os.makedirs(workdir, exist_ok=True)
+    t0 = time.monotonic()
+
+    # -- reference: bitwise per-request tokens, one clean engine
+    ref_eng = Engine(params, cfg, ServeConfig(**base), slo_metrics=False)
+    ref_eng.warmup()
+    ref_reqs = [ref_eng.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                               seed=r["seed"]) for r in trace]
+    ref_eng.run()
+    bad_ref = [q.rid for q in ref_reqs
+               if q.state is not RequestState.COMPLETED]
+    if bad_ref:
+        raise RuntimeError(f"reference run failed requests: {bad_ref}")
+    reference = {q.rid: list(q.generated) for q in ref_reqs}
+
+    def run_fleet(trace_, faults_, stream, label):
+        tel = TelemetryRun(stream, run=label)
+        fleet = ServeFleet(
+            params, cfg, serve, n_replicas,
+            pool=DevicePool([_FakeDev(i) for i in range(n_replicas)]),
+            telemetry=tel, cells=n_cells, router_seed=seed,
+            clock=SimClock(dt), faults=faults_,
+            revive_after=revive_after)
+        slices = {r.name: r.device_ids for r in fleet.replicas}
+        for r in trace_:
+            fleet.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                         arrival_s=r["arrival_s"], seed=r["seed"],
+                         priority=r["priority"])
+        s = fleet.run(max_rounds=20000)
+        tel.finish()
+        fleet.close()
+        return fleet, s, slices
+
+    def goodput_rate(fleet, s, rids=None):
+        eng0 = fleet.replicas[0].engine
+        toks = sum(len(q.generated) for q in fleet.results()
+                   if q.state is RequestState.COMPLETED
+                   and (rids is None or q.rid in rids)
+                   and eng0._in_deadline(q))
+        return toks / max(s["wall_s"], 1e-9)
+
+    # -- clean: the goodput baseline for the same fleet shape
+    clean_stream = os.path.join(workdir, f"{scenario}_clean.jsonl")
+    clean_fleet, clean_sum, _ = run_fleet(clean_trace, (), clean_stream,
+                                          f"{scenario}-clean")
+    band_rids = ({r["rid"] for r in clean_trace}
+                 if scenario == "flood" else None)
+    clean_rate = goodput_rate(clean_fleet, clean_sum, band_rids)
+
+    # -- chaos: the same traffic with the correlated fault armed
+    stream = os.path.join(workdir, f"{scenario}.jsonl")
+    fleet, chaos, slices = run_fleet(trace, faults, stream,
+                                     f"{scenario}-chaos")
+    chaos_rate = goodput_rate(fleet, chaos, band_rids)
+    recs = read_records(stream)
+    print(build_report(recs))
+
+    results = {q.rid: q for q in fleet.results()}
+    # Gate 2: bitwise parity (brownout-clamped: the bitwise prefix).
+    mismatched = []
+    for q in results.values():
+        if q.state is not RequestState.COMPLETED:
+            continue
+        ref = reference[q.rid]
+        ok_tokens = (q.generated == ref[:len(q.generated)]
+                     if q.max_new_requested is not None
+                     else q.generated == ref)
+        if not ok_tokens:
+            mismatched.append(q.rid)
+    # Gate 1: zero lost — typed shed record for every non-completion.
+    shed_recorded = {r.get("request") for r in recs
+                     if r.get("kind") == "shed"}
+    unaccounted = [q.rid for q in results.values()
+                   if q.state is not RequestState.COMPLETED
+                   and (q.shed_reason is None
+                        or q.rid not in shed_recorded)]
+    # Gate 3: one complete rtrace timeline per request, zero orphans.
+    traces = join_request_traces(recs)
+    trace_orphans = sorted(t["trace"] for t in traces.values()
+                           if t["orphan"])
+    # Gate 5: the scenario's event provably happened.
+    cell_recs = [r for r in recs if r.get("kind") == "cell"]
+    cell_events = sorted({r.get("event") for r in cell_recs})
+    if scenario == "failover":
+        event_seen = ("kill" in cell_events
+                      and "grow-back" in cell_events
+                      and chaos["migrations"] >= 1)
+    elif scenario == "flashcrowd":
+        event_seen = any(s_.kind == "slow_cell"
+                         for s_ in fleet.injector.fired)
+    elif scenario == "flood":
+        flood_rids = {r["rid"] for r in trace} - {r["rid"]
+                                                  for r in clean_trace}
+        event_seen = (bool(flood_rids)
+                      and chaos["requests_shed"] >= 1
+                      and all(
+                          results[rid].state is RequestState.COMPLETED
+                          or results[rid].shed_reason is not None
+                          for rid in flood_rids))
+    else:                                              # diurnal
+        event_seen = ("partition" in cell_events
+                      and "heal" in cell_events)
+    # Gate 6 (failover): exact-slice grow-back — every replica live on
+    # its original devices, re-held in the pool under its own tenant.
+    grow_back_exact = all(
+        r.state == "live"
+        and fleet.pool.assigned_ids(f"serve-{r.name}") == slices[r.name]
+        for r in fleet.replicas) if scenario == "failover" else None
+
+    artifact = os.path.join(workdir, f"{scenario}_timelines.json")
+    with open(artifact, "w") as f:
+        json.dump({"scenario": scenario, "seed": seed,
+                   "traces": list(traces.values())}, f, default=str)
+
+    goodput_fraction = (chaos_rate / clean_rate if clean_rate else None)
+    out = {
+        "soak": "fleet-scenario-campaign",
+        "scenario": scenario,
+        "seed": seed,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "replicas": n_replicas,
+        "cells": chaos["cells"]["layout"] if chaos.get("cells") else None,
+        "requests": len(trace),
+        "completed": chaos["requests_completed"],
+        "failed": chaos["requests_failed"],
+        "shed_by_reason": chaos["shed_by_reason"],
+        "unaccounted": unaccounted,
+        "token_mismatches": mismatched,
+        "clamped": sorted(q.rid for q in results.values()
+                          if q.state is RequestState.COMPLETED
+                          and q.max_new_requested is not None),
+        "migrations": chaos["migrations"],
+        "cell_kills": (chaos["cells"] or {}).get("cell_kills"),
+        "cell_events": cell_events,
+        "router_failovers": chaos["router"]["failovers"],
+        "event_seen": event_seen,
+        "grow_back_exact": grow_back_exact,
+        "clean_goodput_tokens_per_vs": round(clean_rate, 1),
+        "chaos_goodput_tokens_per_vs": round(chaos_rate, 1),
+        "goodput_fraction": (round(goodput_fraction, 3)
+                            if goodput_fraction is not None else None),
+        "goodput_band": args.goodput_band,
+        "rtrace_timelines": len(traces),
+        "rtrace_orphans": trace_orphans,
+        "schedule_digest": _schedule_digest(recs),
+        "artifact": artifact,
+        "telemetry": [stream, clean_stream],
+    }
+    ok = (not unaccounted
+          and chaos["requests_failed"] == 0
+          and not mismatched
+          and len(traces) == len(trace)
+          and not trace_orphans
+          and event_seen
+          and (grow_back_exact is None or grow_back_exact)
+          and goodput_fraction is not None
+          and goodput_fraction >= args.goodput_band)
+    return out, ok
+
+
 def run_long(args, workdir: str) -> tuple[dict, bool]:
     """Long mode: campaign after campaign with derived seeds until the
     wall-clock budget is spent; one failure fails the soak. At least one
     campaign always runs (a small ``--duration-s`` is the CI-bounded
     smoke of this very loop)."""
-    campaign = {"degradation": run_degradation_campaign,
-                "overload": run_overload_campaign,
-                "xray": run_xray_campaign,
-                "chaos": run_campaign}[args.scenario]
+    campaign = _campaign_fn(args.scenario)
     t0 = time.monotonic()
     campaigns, all_ok = [], True
     i = 0
@@ -930,20 +1323,48 @@ def run_long(args, workdir: str) -> tuple[dict, bool]:
              "all_ok": all_ok}, all_ok)
 
 
+def _campaign_fn(scenario: str):
+    if scenario in FLEET_SCENARIOS:
+        return lambda args, wd, seed: run_fleet_scenario(args, wd, seed,
+                                                         scenario)
+    return {"degradation": run_degradation_campaign,
+            "overload": run_overload_campaign,
+            "xray": run_xray_campaign,
+            "chaos": run_campaign}[scenario]
+
+
+def _gate_postmortem(args, workdir: str, summary: dict) -> None:
+    """Flight-recorder drop on any scenario gate violation: dump one
+    postmortem bundle (utils/flightrec.py — merged telemetry records,
+    thread stacks, live spans, memory + health snapshots) under the
+    campaign workdir and print its path, so a red soak in CI leaves the
+    full forensic state behind, not just a JSON verdict line."""
+    from distributed_model_parallel_tpu.utils import flightrec
+    from distributed_model_parallel_tpu.utils.telemetry import read_records
+
+    records = []
+    for p in summary.get("telemetry", []) or []:
+        try:
+            records.extend(read_records(p))
+        except Exception:
+            pass
+    path = flightrec.dump_postmortem(
+        workdir, f"soak-gate-{args.scenario}", records=records)
+    if path:
+        print(f"postmortem bundle: {path}", flush=True)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
     workdir = args.workdir or tempfile.mkdtemp(prefix="dmp_soak_")
     if args.mode == "fast":
-        campaign = {"degradation": run_degradation_campaign,
-                    "overload": run_overload_campaign,
-                    "xray": run_xray_campaign,
-                    "chaos": run_campaign}[args.scenario]
-        summary, ok = campaign(args, workdir, args.seed)
-        print(json.dumps(summary), flush=True)
-        return 0 if ok else 1
-    summary, all_ok = run_long(args, workdir)
+        summary, ok = _campaign_fn(args.scenario)(args, workdir, args.seed)
+    else:
+        summary, ok = run_long(args, workdir)
+    if not ok:
+        _gate_postmortem(args, workdir, summary)
     print(json.dumps(summary), flush=True)
-    return 0 if all_ok else 1
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

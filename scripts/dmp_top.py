@@ -264,6 +264,20 @@ class FleetState:
                             f"  kills={prov.get('replica_kills')}"
                             + (f"  shed={prov.get('requests_shed')}"
                                if prov.get("requests_shed") else ""))
+                        # Per-cell rollup (serve/cells.py): liveness,
+                        # reachability, aggregated breaker state.
+                        for cname, cell in sorted(
+                                (prov.get("cells") or {}).items()):
+                            lines.append(
+                                f"  cell {cname}  "
+                                f"{len(cell.get('live') or [])}"
+                                f"/{len(cell.get('members') or [])} live"
+                                f"  routed={cell.get('assignments')}"
+                                + ("  PARTITIONED"
+                                   if cell.get("partitioned") else "")
+                                + (f"  brk={cell.get('breaker')}"
+                                   if cell.get("breaker") not in
+                                   (None, "closed") else ""))
                         for rname, rep in sorted(
                                 (prov.get("replicas") or {}).items()):
                             occ = rep.get("page_occupancy")

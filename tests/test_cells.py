@@ -1,0 +1,445 @@
+"""Cell topology + seeded traffic programs (serve/cells.py,
+serve/traffic.py) and the correlated-failure drills they exist for.
+
+The load-bearing properties (docs/SERVING.md "Cell topology",
+docs/RESILIENCE.md "Fault taxonomy"):
+
+* ``CellDirectory`` partitions replicas into contiguous named blocks and
+  the ``home_cell`` hash is a pure function of (prompt, FULL cell list,
+  seed) — a down cell never reshuffles other prompts' homes;
+* the router's (cell, prefix, load) policy is seed-deterministic across
+  a quarantine→reinstate cycle: same trace + seed ⇒ identical
+  assignment sequence before, during and after the replica-set change
+  (the ISSUE 17 regression pin);
+* every traffic program is replay-deterministic — same seed, same knobs
+  ⇒ bit-identical request lists;
+* ``kill_cell`` drives the REAL quarantine→drain→migrate→grow-back path
+  for every member at once (typed ``cell`` kill/grow-back records, zero
+  lost requests, bitwise token parity vs an unkilled engine);
+* ``partition`` isolates a cell from the router while residents keep
+  decoding and drain on heal (typed partition/heal records);
+* a cell most of whose members were independently quarantined is swept
+  as a unit (reason ``cell-sick``).
+"""
+
+import jax
+import pytest
+
+from distributed_model_parallel_tpu.models import transformer as tfm
+from distributed_model_parallel_tpu.orchestrator.scheduler import DevicePool
+from distributed_model_parallel_tpu.serve import (
+    CellDirectory,
+    Engine,
+    Router,
+    ServeConfig,
+    ServeFleet,
+    SimClock,
+    adversarial_flood,
+    diurnal,
+    flash_crowd,
+    merge_traces,
+    mixed_tenants,
+)
+from distributed_model_parallel_tpu.serve.cells import home_cell
+from distributed_model_parallel_tpu.serve.scheduler import RequestState
+from distributed_model_parallel_tpu.serve.traffic import poisson_arrivals
+from distributed_model_parallel_tpu.utils.telemetry import (
+    TelemetryRun,
+    read_records,
+)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    return cfg, tfm.init_params(jax.random.key(0), cfg)
+
+
+class _Dev:
+    """Pool entry for CPU-scaled fleets (the drills need more replicas
+    than the host has JAX devices; replicas only read ``.id``)."""
+
+    def __init__(self, i):
+        self.id = i
+
+
+def _serve(**kw):
+    base = dict(n_slots=2, page_size=8, n_pages=32, max_seq_len=64,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _fleet(model, n, cells, telemetry=None, **kw):
+    cfg, params = model
+    return ServeFleet(params, cfg, _serve(), n,
+                      pool=DevicePool([_Dev(i) for i in range(n)]),
+                      telemetry=telemetry, cells=cells,
+                      clock=SimClock(0.02), **kw)
+
+
+# ---------------------------------------------------------------------------
+# CellDirectory + home_cell
+# ---------------------------------------------------------------------------
+
+def test_partition_contiguous_blocks_remainder_first():
+    d = CellDirectory.partition([f"r{i}" for i in range(7)], 3)
+    assert d.as_dict() == {"c0": ["r0", "r1", "r2"],
+                           "c1": ["r3", "r4"], "c2": ["r5", "r6"]}
+    assert d.cells == ("c0", "c1", "c2")
+    assert d.cell_of("r4") == "c1"
+    assert d.members("c2") == ("r5", "r6")
+    assert "c1" in d and "c9" not in d and len(d) == 3
+
+
+def test_directory_rejects_bad_membership():
+    with pytest.raises(ValueError, match="at least one cell"):
+        CellDirectory({})
+    with pytest.raises(ValueError, match="no members"):
+        CellDirectory({"c0": []})
+    with pytest.raises(ValueError, match="both"):
+        CellDirectory({"c0": ["r0"], "c1": ["r0"]})
+    with pytest.raises(ValueError, match=">= 1 replica"):
+        CellDirectory.partition(["r0"], 2)
+    with pytest.raises(KeyError):
+        CellDirectory({"c0": ["r0"]}).cell_of("r9")
+    with pytest.raises(KeyError):
+        CellDirectory({"c0": ["r0"]}).members("c9")
+
+
+def test_home_cell_deterministic_and_full_list_stable():
+    """The home hash is a pure function of (prompt, seed, FULL cell
+    list): determinism plus the no-reshuffle property — dropping a cell
+    from the candidate set must not move any other prompt's home."""
+    cells = ("c0", "c1", "c2", "c3")
+    prompts = [[i, i + 1, i * 3 % 64] for i in range(50)]
+    homes = [home_cell(p, cells, seed=7) for p in prompts]
+    assert homes == [home_cell(p, cells, seed=7) for p in prompts]
+    assert len(set(homes)) > 1          # the hash actually spreads
+    assert set(homes) <= set(cells)
+    # A different seed is a different (deterministic) shuffle.
+    assert homes != [home_cell(p, cells, seed=8) for p in prompts]
+    with pytest.raises(ValueError):
+        home_cell([1, 2], ())
+
+
+def test_sim_clock_monotonic():
+    clk = SimClock(0.5)
+    assert clk() == 0.0
+    assert clk.tick() == 0.5
+    assert clk.tick(0.25) == 0.75
+    assert clk.advance_to(2.0) == 2.0
+    assert clk.advance_to(1.0) == 2.0   # never backwards
+    with pytest.raises(ValueError):
+        SimClock(0.0)
+
+
+# ---------------------------------------------------------------------------
+# traffic programs
+# ---------------------------------------------------------------------------
+
+def test_traffic_programs_replay_deterministic():
+    """Every program is a pure function of (seed, knobs): same seed ⇒
+    bit-identical request lists; different seed ⇒ a different trace."""
+    import random
+
+    def make(seed):
+        return {
+            "diurnal": diurnal(seed, horizon_s=2.0, base_rate=4.0,
+                               peak_rate=20.0),
+            "flash": flash_crowd(seed, horizon_s=2.0, base_rate=5.0,
+                                 spike_at_s=1.0, spike_s=0.3,
+                                 spike_rate=60.0),
+            "flood": adversarial_flood(seed, horizon_s=2.0, base_rate=5.0,
+                                       flood_at_s=1.0, flood_n=6),
+            "tenants": mixed_tenants(seed, horizon_s=2.0, tenants={
+                "web": {"rate": 8.0, "priority": "interactive"},
+                "etl": {"rate": 3.0, "priority": "batch"},
+            }),
+        }
+
+    a, b, c = make(11), make(11), make(12)
+    for name in a:
+        assert a[name] == b[name], name
+        assert a[name] != c[name], name
+        assert a[name], name
+        # arrival-ordered, unique rids, schema complete
+        arr = [r["arrival_s"] for r in a[name]]
+        assert arr == sorted(arr)
+        assert len({r["rid"] for r in a[name]}) == len(a[name])
+        for r in a[name]:
+            assert r["priority"] in ("interactive", "batch")
+            assert r["prompt"] and r["max_new"] >= 1
+    # thinning degenerates correctly
+    assert poisson_arrivals(random.Random(0), lambda t: 1.0, 1.0, 0) == []
+
+
+def test_traffic_program_shapes():
+    """Program-specific shape: the flood burst is batch-class long
+    prompts under its own tenant; mixed tenants carry per-tenant SLO
+    classes; merged traces reject colliding rids."""
+    flood = adversarial_flood(3, horizon_s=2.0, base_rate=5.0,
+                              flood_at_s=1.0, flood_n=5)
+    burst = [r for r in flood if r["tenant"] == "flood"]
+    assert len(burst) == 5
+    assert all(r["priority"] == "batch" and len(r["prompt"]) >= 24
+               and r["arrival_s"] == 1.0 for r in burst)
+    tn = mixed_tenants(3, horizon_s=2.0, tenants={
+        "web": {"rate": 8.0, "priority": "interactive"},
+        "etl": {"rate": 3.0, "priority": "batch", "deadline_s": 9.0},
+    })
+    assert {r["tenant"] for r in tn} == {"web", "etl"}
+    assert all(r["priority"] == "batch" and r["deadline_s"] == 9.0
+               for r in tn if r["tenant"] == "etl")
+    with pytest.raises(ValueError, match="duplicate rids"):
+        merge_traces(flood, flood)
+
+
+# ---------------------------------------------------------------------------
+# the (cell, prefix, load) router
+# ---------------------------------------------------------------------------
+
+class _FakeCache:
+    def __init__(self):
+        self.occupancy = 0.0
+
+    def cached_prefix_tokens(self, prompt):
+        return 0
+
+
+class _FakeSched:
+    def __init__(self):
+        self.queue, self.slots = [], [None, None]
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.sched, self.cache = _FakeSched(), _FakeCache()
+
+
+class _FakeReplica:
+    def __init__(self, name):
+        self.name, self.engine = name, _FakeEngine()
+
+
+def _route_trace(seed, reps, cells, down_cell):
+    """Assignment sequence over a synthetic trace with the ``down_cell``
+    members removed from the candidate set for the middle third
+    (quarantine) and restored after (reinstate)."""
+    router = Router(seed, cells=cells)
+    out = []
+    prompts = [[(7 * i + j) % 64 for j in range(6)] for i in range(60)]
+    for i, p in enumerate(prompts):
+        cands = (reps if not 20 <= i < 40 else
+                 [r for r in reps if cells.cell_of(r.name) != down_cell])
+        rep, reason, _ = router.pick(p, cands)
+        out.append((rep.name, reason))
+    return out, router
+
+
+def test_router_deterministic_across_quarantine_reinstate():
+    """ISSUE 17 regression pin: same trace + seed ⇒ identical assignment
+    sequence before, during and after the replica-set change — and the
+    policy is visibly cell-aware (cell-local at steady state, failover
+    while the home cell is away, cell-local again after reinstate)."""
+    cells = CellDirectory.partition([f"r{i}" for i in range(6)], 3)
+    down = "c1"
+    runs = []
+    for _ in range(2):
+        reps = [_FakeReplica(f"r{i}") for i in range(6)]
+        runs.append(_route_trace(5, reps, cells, down))
+    (seq_a, router_a), (seq_b, _) = runs
+    assert seq_a == seq_b
+    assert all(reason == "cell-local" for _, reason in seq_a[:20])
+    during = seq_a[20:40]
+    assert any(reason == "failover" for _, reason in during)
+    assert not any(cells.cell_of(name) == down for name, _ in during)
+    assert all(reason == "cell-local" for _, reason in seq_a[40:])
+    # failed-over homes return once the cell is back
+    assert any(cells.cell_of(name) == down for name, _ in seq_a[40:])
+    assert router_a.failovers == sum(
+        1 for _, reason in seq_a if reason == "failover")
+
+
+def test_router_home_cell_confines_p2c():
+    """At steady state every non-affinity pick lands IN the prompt's
+    home cell (reason ``cell-local``) — the p2c sample never crosses
+    cells unprovoked."""
+    cells = CellDirectory.partition([f"r{i}" for i in range(8)], 4)
+    reps = [_FakeReplica(f"r{i}") for i in range(8)]
+    router = Router(0, cells=cells)
+    for i in range(40):
+        p = [(3 * i + j) % 64 for j in range(5)]
+        rep, reason, _ = router.pick(p, reps)
+        assert reason == "cell-local"
+        assert cells.cell_of(rep.name) == cells.home(p, 0)
+    assert router.failovers == 0
+
+
+# ---------------------------------------------------------------------------
+# correlated-failure drills (the real fleet path)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_kill_cell_drill_drains_migrates_grows_back(model, tmp_path):
+    """Killing a whole cell mid-stream drives every member through the
+    real quarantine→drain→migrate path at once: typed ``cell`` kill and
+    grow-back records, zero lost requests, and bitwise token parity with
+    an unkilled single-engine run."""
+    cfg, params = model
+    trace = mixed_tenants(9, horizon_s=1.2, tenants={
+        "web": {"rate": 24.0, "priority": "interactive"},
+        "etl": {"rate": 10.0, "priority": "batch"},
+    })
+    ref_eng = Engine(params, cfg, _serve())
+    for r in trace:
+        ref_eng.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                       seed=r["seed"])
+    ref_eng.run()
+    refs = {q.rid: q.generated for q in ref_eng.results()}
+
+    run = TelemetryRun(str(tmp_path / "fleet.jsonl"), run="killcell")
+    fleet = _fleet(model, 6, 3, telemetry=run,
+                   faults=["kill_cell@12"], fault_cell="c1",
+                   revive_after=30)
+    reqs = [fleet.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                         arrival_s=r["arrival_s"], seed=r["seed"],
+                         priority=r["priority"]) for r in trace]
+    s = fleet.run()
+    fleet.close()
+    assert s["requests_failed"] == 0
+    assert [q.rid for q in reqs
+            if q.state is not RequestState.COMPLETED
+            and not q.shed_reason] == []
+    assert all(q.generated == refs[q.rid] for q in reqs
+               if q.state is RequestState.COMPLETED)
+    assert s["cells"]["cell_kills"] == 1
+    cell_recs = [r for r in read_records(run.path)
+                 if r.get("kind") == "cell"]
+    kill = next(r for r in cell_recs if r["event"] == "kill")
+    assert kill["cell"] == "c1"
+    assert sorted(kill["replicas"]) == ["r2", "r3"]
+    grow = [r for r in cell_recs if r["event"] == "grow-back"]
+    assert grow and grow[0]["cell"] == "c1"
+    assert all(rep.state == "live" for rep in fleet.replicas)
+    assert s["cells"]["live"] == ["c0", "c1", "c2"]
+
+
+@pytest.mark.chaos
+def test_partition_drill_residents_drain_on_heal(model, tmp_path):
+    """A partitioned cell takes no new work (router + migration both
+    route around it) while residents keep decoding; heal emits the typed
+    record with the drained-resident count and nothing is lost."""
+    cfg, params = model
+    trace = mixed_tenants(4, horizon_s=1.5, tenants={
+        "web": {"rate": 26.0, "priority": "interactive"},
+    })
+    run = TelemetryRun(str(tmp_path / "fleet.jsonl"), run="partition")
+    fleet = _fleet(model, 4, 2, telemetry=run,
+                   faults=["partition@8:10"], fault_cell="c1")
+    reqs = [fleet.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                         arrival_s=r["arrival_s"], seed=r["seed"])
+            for r in trace]
+    s = fleet.run()
+    fleet.close()
+    assert s["requests_failed"] == 0
+    assert [q.rid for q in reqs
+            if q.state is not RequestState.COMPLETED
+            and not q.shed_reason] == []
+    recs = read_records(run.path)
+    part = [r for r in recs if r.get("kind") == "cell"
+            and r["event"] == "partition"]
+    heal = [r for r in recs if r.get("kind") == "cell"
+            and r["event"] == "heal"]
+    assert len(part) == 1 and part[0]["cell"] == "c1"
+    assert len(heal) == 1 and heal[0]["cell"] == "c1"
+    # no NEW work routed into the cell while unreachable
+    lo, hi = part[0]["round"], heal[0]["round"]
+    routed_in = [r for r in recs if r.get("kind") == "router"
+                 and lo <= r.get("round", -1) < hi
+                 and r.get("replica") in ("r2", "r3")]
+    assert routed_in == []
+    assert s["cells"]["partitioned"] == []   # healed by the end
+
+
+@pytest.mark.chaos
+def test_cell_sick_sweep_quarantines_remainder(model, tmp_path):
+    """When most of a cell is independently quarantined the remainder is
+    swept as a unit (typed ``sick`` record, reason ``cell-sick``) — a
+    rack losing replicas one by one becomes a cell-level event."""
+    run = TelemetryRun(str(tmp_path / "fleet.jsonl"), run="sick")
+    fleet = _fleet(model, 6, 2, telemetry=run)
+    for i in range(8):
+        fleet.submit([1 + i, 2, 3, 4], 8, arrival_s=0.0, seed=i)
+
+    fired = []
+
+    def hook(rnd):
+        if rnd == 6:
+            fleet.kill_replica("r0")
+            fleet.kill_replica("r1")
+            fired.append(rnd)
+
+    fleet.step_hook = hook
+    s = fleet.run()
+    fleet.close()
+    assert fired
+    recs = read_records(run.path)
+    sick = [r for r in recs if r.get("kind") == "cell"
+            and r["event"] == "sick"]
+    assert len(sick) == 1 and sick[0]["cell"] == "c0"
+    assert sick[0]["swept"] == ["r2"]
+    assert {rep.name: rep.state for rep in fleet.replicas}["r2"] \
+        == "quarantined"
+    assert any(r.get("kind") == "event"
+               and "replica r2 (cell-sick)" in r.get("message", "")
+               for r in recs)
+    assert s["requests_failed"] == 0
+
+
+@pytest.mark.chaos
+def test_fleet_summary_and_statusz_cell_rollup(model):
+    """The summary's ``cells`` block and the statusz per-cell rollup
+    agree with the directory: layout, liveness, kill counts."""
+    fleet = _fleet(model, 4, {"east": ["r0", "r1"], "west": ["r2", "r3"]})
+    fleet.submit([1, 2, 3], 6, seed=0)
+    s = fleet.run()
+    assert s["cells"]["layout"] == {"east": ["r0", "r1"],
+                                    "west": ["r2", "r3"]}
+    assert s["cells"]["live"] == ["east", "west"]
+    assert s["cells"]["cell_kills"] == 0
+    st = fleet._status()
+    assert set(st["cells"]) == {"east", "west"}
+    assert all(len(c["live"]) == 2 and len(c["members"]) == 2
+               and c["breaker"] == "closed" and not c["partitioned"]
+               for c in st["cells"].values())
+    fleet.kill_cell("west")
+    st = fleet._status()
+    assert st["cells"]["west"]["live"] == []
+    assert fleet.summary(record=False)["cells"]["live"] == ["east"]
+    fleet.close()
+
+
+def test_fleet_rejects_bad_cell_config(model):
+    cfg, params = model
+    pool = DevicePool([_Dev(i) for i in range(4)])
+    with pytest.raises(ValueError, match="unknown replicas"):
+        ServeFleet(params, cfg, _serve(), 4, pool=pool,
+                   cells={"c0": ["r0", "r9"], "c1": ["r1", "r2", "r3"]})
+    with pytest.raises(ValueError, match="unknown fault_cell"):
+        ServeFleet(params, cfg, _serve(), 4,
+                   pool=DevicePool([_Dev(i) for i in range(4)]),
+                   cells=2, fault_cell="nope")
+    with pytest.raises(ValueError, match="no cell topology"):
+        ServeFleet(params, cfg, _serve(), 4,
+                   pool=DevicePool([_Dev(i) for i in range(4)]),
+                   faults=["kill_cell@5"])
+    f = ServeFleet(params, cfg, _serve(), 4,
+                   pool=DevicePool([_Dev(i) for i in range(4)]), cells=2)
+    with pytest.raises(KeyError, match="unknown cell"):
+        f.kill_cell("c9")
+    f.close()

@@ -67,8 +67,12 @@ def test_every_emitted_record_kind_is_documented():
     # kinds (alert: utils/alerts.py firing/resolved transitions;
     # postmortem: utils/flightrec.py bundle pointers) are pinned here so
     # a refactor that stops emitting them fails loudly too.
+    # (cell: serve/fleet.py correlated-failure lifecycle — kill / sick /
+    # partition / heal / grow-back — the ISSUE-17 scenario gates replay
+    # these, so silently losing the kind would blind the soak runner.)
     assert {"run_start", "step", "failure", "recovery", "tenant",
-            "alert", "postmortem"} <= emitted
+            "alert", "postmortem", "cell", "router", "migration",
+            "shed"} <= emitted
     missing = sorted(emitted - _documented_kinds())
     assert not missing, (
         f"telemetry record kinds emitted but missing from the "

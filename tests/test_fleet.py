@@ -489,7 +489,7 @@ def test_kill_with_no_peer_fails_typed(model):
     assert not any(r.slot is not None for r in live)
     assert failed, "the double kill caught requests in flight"
     for r in failed:
-        assert r.error and "no live peer" in r.error
+        assert r.error and "no reachable live peer" in r.error
 
 
 @pytest.mark.chaos

@@ -273,11 +273,16 @@ def test_degradation_kinds_parse_and_sites():
     assert FAULT_SITES["slow_device"] == "step"
     assert FAULT_SITES["flaky_sync"] == "sync"
     # PR 15 adds the serve-side degradations (slow_replica /
-    # admission_fail, served through the fleet — serve/fleet.py).
+    # admission_fail) and PR 17 the correlated cell kinds (slow_cell /
+    # partition), all served through the fleet — serve/fleet.py.
     assert DEGRADATION_KINDS == {"slow_device", "flaky_sync",
-                                 "slow_replica", "admission_fail"}
+                                 "slow_replica", "admission_fail",
+                                 "slow_cell", "partition"}
     assert FAULT_SITES["slow_replica"] == "serve"
     assert FAULT_SITES["admission_fail"] == "admit"
+    assert FAULT_SITES["slow_cell"] == "cell"
+    assert FAULT_SITES["partition"] == "cell"
+    assert FAULT_SITES["kill_cell"] == "cell"
 
 
 def test_slow_device_ramps_and_flaky_sync_is_intermittent(monkeypatch):
