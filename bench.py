@@ -907,6 +907,11 @@ def bench_serve_fleet() -> None:
     clean run (migrated ones included — the determinism contract), and
     post-kill admission p99 TTFT within
     ``DMP_BENCH_SERVE_FLEET_TTFT_FACTOR`` (default 4x) of pre-kill.
+
+    A third pass runs the crash drill: the same trace with a
+    write-ahead journal (serve/journal.py) and ``r1`` HARD-crashed (no
+    drain) at the kill round — zero lost requests, bitwise token parity
+    again, and ``recovery_time_s`` emitted for the baseline gate.
     """
     from distributed_model_parallel_tpu.config import MeshConfig
     from distributed_model_parallel_tpu.models import transformer as tfm
@@ -1015,6 +1020,52 @@ def bench_serve_fleet() -> None:
                if x is not None], default=None)
     post_ok = (post.get("p99") is None or ref is None
                or post["p99"] <= max(ref * ttft_factor, ttft_floor))
+    # Crash drill (serve/journal.py): the same trace with a write-ahead
+    # journal and replica r1 HARD-crashed (no drain, no export) at the
+    # kill round — every lost request is re-admitted from the journal
+    # and replayed bitwise. recovery_time_s is the gated headline
+    # (utils/baseline.py GATE_METRICS, lower-better).
+    import tempfile
+
+    from distributed_model_parallel_tpu.serve.journal import RequestJournal
+
+    with tempfile.TemporaryDirectory(prefix="dmp-bench-journal-") as jdir:
+        journal = RequestJournal(os.path.join(jdir, "journal.jsonl"))
+        crash_fleet = ServeFleet(params, cfg, serve, n_replicas,
+                                 telemetry=telemetry,
+                                 cells=n_cells or None,
+                                 revive_after=revive_rounds,
+                                 journal=journal)
+
+        def crash_hook(rnd):
+            if rnd == kill_round:
+                n = crash_fleet.crash_replica("r1")
+                _log(f"serve-fleet: hard-crashed r1 at round {rnd}, "
+                     f"{n} requests re-admitted from the journal")
+        crash_fleet.step_hook = crash_hook
+        for r in trace:
+            crash_fleet.submit(r["prompt"], r["max_new_tokens"],
+                               arrival_s=r["arrival_s"], seed=r["seed"])
+        crash = crash_fleet.run()
+        if "r1" not in crash_fleet.kill_times:
+            raise RuntimeError(
+                f"crash drill never fired: the trace drained before "
+                f"round {kill_round}")
+        if crash["requests_failed"]:
+            raise RuntimeError(
+                f"crash drill lost {crash['requests_failed']} requests "
+                f"— the journal recovery path dropped accepted work")
+        for r in crash_fleet.results():
+            if r.generated != clean_toks[r.rid]:
+                raise RuntimeError(
+                    f"request {r.rid} decoded different tokens after "
+                    f"the hard crash — journal replay broke the "
+                    f"determinism contract")
+        _log(f"serve-fleet[crash-drill]: {crash['crash_recovered']} "
+             f"recovered from the journal in "
+             f"{crash['recovery_time_s']:.4f}s, tokens bitwise "
+             f"identical")
+        crash_fleet.close()
     tok_s = (clean["tokens_per_s"] or 0.0) / n_chips
     drill_tok_s = (drill["tokens_per_s"] or 0.0) / n_chips
     out = {
@@ -1041,6 +1092,10 @@ def bench_serve_fleet() -> None:
                                  if post.get("p99") is not None else None),
         "post_kill_ttft_factor": ttft_factor,
         "post_kill_ttft_ok": bool(post_ok),
+        "replica_crashes": crash["replica_crashes"],
+        "crash_recovered": crash["crash_recovered"],
+        "recovery_time_s": round(crash["recovery_time_s"], 6),
+        "tokens_identical_after_crash": True,
         "token_latency_p99_s": round(
             clean["token_latency_s"].get("p99", 0), 5),
         "page_occupancy_max": None,

@@ -137,7 +137,7 @@ class Engine:
     def __init__(self, params: dict, cfg: TransformerConfig,
                  serve: ServeConfig, *, telemetry=None, step_hook=None,
                  slo_metrics: bool = True, replica: str | None = None,
-                 clock=None):
+                 clock=None, journal=None):
         if cfg.moe_experts:
             raise ValueError(
                 "MoE decode routing is batch-coupled (expert-capacity "
@@ -166,6 +166,10 @@ class Engine:
         self.serve = serve
         self.telemetry = telemetry
         self.step_hook = step_hook
+        # Write-ahead request journal (serve/journal.py): committed-token
+        # watermarks from the decode loop, exactly one terminal per
+        # accepted request. None = journal off, zero behavior change.
+        self.journal = journal
         # Fleet membership (serve/fleet.py): the replica name tags this
         # engine's serve records and statusz provider so a multi-replica
         # stream stays attributable. None = standalone engine (PR 9
@@ -268,6 +272,10 @@ class Engine:
         self._decode_tokens = 0       # useful tokens out of decode steps
         self._occupancy: list[float] = []
         self._wall_s = 0.0            # accumulates across run() calls
+        # Real (monotonic) per-iteration wall samples, independent of
+        # the pluggable clock — the crashrecovery scenario gates journal
+        # overhead against their p50 even under a SimClock.
+        self._iter_s: list[float] = []
         # prefix-cache + speculative-decoding accounting
         self._prompt_tokens = 0       # prompt tokens of admitted requests
         self._cached_tokens = 0       # of those, served from the tree
@@ -451,6 +459,11 @@ class Engine:
         req.state = RequestState.FAILED
         req.shed_reason = reason
         req.error = f"rejected: {reason}"
+        if self.journal is not None:
+            # A rejected request usually predates its intent (the
+            # journal drops unknown rids); a fleet-accepted one whose
+            # re-dispatch bounced still owes its single terminal.
+            self.journal.terminal(req.rid, "shed")
         self._rtrace(req, "shed", reason=reason, state="queued")
         self._requests.append(req)
         self._rejected += 1
@@ -548,6 +561,28 @@ class Engine:
                 f"pages still held after drain + prefix drop")
         return freed
 
+    # -- hard crash (serve/journal.py crash recovery) -----------------------
+
+    def kill(self, reason: str = "injected-crash") -> None:
+        """Hard-crash this engine: NO drain, no per-request terminals —
+        engine object, page pool and prefix tree are simply abandoned
+        (``ServeFleet.crash_replica`` discards them). The exhaust is a
+        typed failure record carrying the journal position (the exact
+        replay point) and a flight-recorder bundle, so the postmortem is
+        self-contained; re-serving the lost requests is the journal's
+        job, not this method's."""
+        if self.telemetry is not None:
+            self.telemetry.failure(
+                "replica-crashed", detail=reason,
+                iteration=self._iterations,
+                **({"replica": self.replica}
+                   if self.replica is not None else {}),
+                **({"journal": self.journal.position()}
+                   if self.journal is not None else {}))
+        from distributed_model_parallel_tpu.utils import flightrec
+
+        flightrec.dump("replica-crashed", telemetry_run=self.telemetry)
+
     # -- the loop -----------------------------------------------------------
 
     def run(self, *, max_iterations: int | None = None,
@@ -591,7 +626,9 @@ class Engine:
             if self.telemetry is not None:
                 self.telemetry.failure(
                     "engine-killed", detail=f"{type(e).__name__}: {e}",
-                    iteration=self._iterations)
+                    iteration=self._iterations,
+                    **({"journal": self.journal.position()}
+                       if self.journal is not None else {}))
             # Crash flight recorder (utils/flightrec.py): capture the
             # state at the moment of death — ring records, thread
             # stacks, span stacks, page-pool state. No-op when no
@@ -622,7 +659,11 @@ class Engine:
             self.step_hook(self._iterations)
         self._iterations += 1
         self._now = now
-        return self._iterate(now, t0)
+        w0 = time.monotonic()
+        try:
+            return self._iterate(now, t0)
+        finally:
+            self._iter_s.append(time.monotonic() - w0)
 
     def _iterate(self, now: float, t0: float) -> bool:
         progress = False
@@ -673,7 +714,11 @@ class Engine:
             if self.serve.spec_k:
                 prop = NGramProposer(self.serve.spec_k,
                                      max_order=self.serve.spec_ngram)
-                prop.extend(req.prompt)
+                # Journal replays seed the proposer with the whole
+                # replayed prefix (prompt + committed tokens minus the
+                # re-sampled last); the final prefill chunk extends the
+                # last one, so the stream carries every committed token.
+                prop.extend(req.prefill_tokens)
                 self._proposers[req.rid] = prop
             if self._slo_metrics and req.cached_prompt_tokens:
                 registry().counter("serve_prefill_tokens_saved").inc(
@@ -735,33 +780,59 @@ class Engine:
 
     def _prefill_chunk_inner(self, req: Request, t0: float) -> None:
         chunk = self.serve.prefill_chunk
+        # A journal-replay request prefills prompt + committed tokens
+        # (minus the last, re-sampled below) — the crash-recovery path
+        # (serve/journal.py); everyone else prefills just the prompt.
+        seq = req.prefill_tokens
+        replaying = req.replay and bool(req.generated)
         lo = req.prefill_cursor
-        n_valid = min(chunk, req.prompt_len - lo)
+        n_valid = min(chunk, len(seq) - lo)
         toks = np.zeros((1, chunk), np.int32)
-        toks[0, :n_valid] = req.prompt[lo:lo + n_valid]
+        toks[0, :n_valid] = seq[lo:lo + n_valid]
         table = jnp.asarray(self._tables_np[req.slot])
         key = jax.random.key(req.seed)
         self.cache.ck, self.cache.cv, tok = self._prefill(
             self.params, self.cache.ck, self.cache.cv, jnp.asarray(toks),
             jnp.int32(lo), jnp.int32(n_valid), table, key)
         req.prefill_cursor = lo + n_valid
-        if req.prefill_cursor < req.prompt_len:
+        if req.prefill_cursor < len(seq):
             self._rtrace(req, "prefill", cursor=req.prefill_cursor,
                          tokens=n_valid)
         else:
             # Final chunk: its sampled token is the request's first
-            # generated token (position t0) — TTFT stops here.
+            # generated token (position t0) — TTFT stops here. On a
+            # replay it is the LAST journaled token, re-sampled: the
+            # determinism contract (tokens = f(prompt, seed)) makes it
+            # bitwise-identical, and we assert that rather than trust it.
             first = int(jax.device_get(tok)[0])
-            req.generated.append(first)
-            req.t_first_token = self._clock() - t0
+            if replaying:
+                want = req.generated[-1]
+                if first != want:
+                    raise AssertionError(
+                        f"journal replay diverged for {req.rid!r}: "
+                        f"re-sampled token {first} != journaled {want} "
+                        f"at position {len(seq)} — the determinism "
+                        f"contract (tokens = f(prompt, seed)) is broken")
+            else:
+                req.generated.append(first)
+                if self.journal is not None:
+                    self.journal.commit(req.rid, (first,))
+            req.replay = False
+            if req.t_first_token is None:
+                req.t_first_token = self._clock() - t0
+                self._record_ttft(req)
             req.state = RequestState.DECODE
-            self._record_ttft(req)
             self._rtrace(req, "prefill", cursor=req.prefill_cursor,
-                         tokens=n_valid, ttft_s=self._ttft(req))
-            # Every prompt position's KV is now written — offer the full
-            # prompt pages to the prefix tree so the next request with
-            # this prefix (the multi-turn case) admits warm.
-            self.cache.insert_prefix(req.rid, req.prompt)
+                         tokens=n_valid, ttft_s=self._ttft(req),
+                         **({"replayed": len(req.generated)}
+                            if replaying else {}))
+            # Every prefilled position's KV is now written — offer the
+            # pages to the prefix tree so the next request with this
+            # prefix (the multi-turn case) admits warm. ``seq`` is the
+            # prompt, or on replay the prompt + committed tokens minus
+            # the re-sampled last — the same verified-written trim
+            # boundary ``_complete`` uses.
+            self.cache.insert_prefix(req.rid, seq)
             # The proposer's stream must carry EVERY committed token —
             # skipping the first generated one would shift its whole
             # index around the prompt/generation boundary.
@@ -816,6 +887,11 @@ class Engine:
         for req in decoding:
             tok = int(nxt[req.slot])
             req.generated.append(tok)
+            if self.journal is not None:
+                # Watermark the journal at the exact commit point — a
+                # token enters ``generated`` iff the model chose it, so
+                # the journal never sees a rejected draft.
+                self.journal.commit(req.rid, (tok,))
             if gauges is not None:
                 self._rtrace(req, "decode", new_tokens=1, **gauges)
             if self._finished(req, tok):
@@ -912,6 +988,12 @@ class Engine:
                         and tok == self.serve.eos_id):
                     break
             req.generated.extend(emitted)
+            if self.journal is not None:
+                # Only the model-verified prefix reaches ``generated``
+                # (the loop above breaks at the first rejected draft),
+                # so the watermark can never advance past a speculative
+                # tail the model didn't commit.
+                self.journal.commit(req.rid, emitted)
             self._decode_tokens += len(emitted)
             # Accept accounting over REAL proposals only (window padding
             # that happens to match is decode luck, not drafting).
@@ -966,6 +1048,12 @@ class Engine:
     def _complete(self, req: Request, t0: float) -> None:
         req.t_done = self._clock() - t0
         req.state = RequestState.COMPLETED
+        if self.journal is not None:
+            # Durable terminal BEFORE the engine forgets the request —
+            # dedup'd by rid, so a recovered request re-completing after
+            # a crash that already journaled its terminal is a no-op
+            # (exactly-once accounting).
+            self.journal.terminal(req.rid, "completed")
         # Offer the whole committed sequence (prompt + generation) to the
         # prefix tree BEFORE eviction drops our page references — this is
         # what makes a multi-turn follow-up (prior turns re-sent as the
@@ -1025,6 +1113,8 @@ class Engine:
         req.state = RequestState.FAILED
         req.shed_reason = reason
         req.error = f"shed: {reason}"
+        if self.journal is not None:
+            self.journal.terminal(req.rid, "shed")
         self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
         if reason == "queue-full":
             self._rejected += 1
@@ -1065,6 +1155,13 @@ class Engine:
             self._spec_live.pop(req.rid, None)
             req.state = RequestState.FAILED
             req.error = f"engine-killed: {detail}"
+            if self.journal is not None:
+                # A typed failure is REPORTED to the client, so it is a
+                # real terminal: journal it and recovery never re-serves
+                # the request. Hard crashes (Engine.kill) never run this
+                # path — their requests stay non-terminal and the
+                # journal replays them.
+                self.journal.terminal(req.rid, "failed")
             self._rtrace(req, "failed", error="engine-killed")
             if self._slo_metrics:
                 registry().counter("serve_requests_failed").inc()
@@ -1193,6 +1290,10 @@ class Engine:
                  if w is not None]),
             "token_latency_s": summarize(token_lat),
             "page_occupancy": summarize(self._occupancy),
+            # REAL per-iteration wall time (monotonic even under a
+            # SimClock) — the denominator of the crashrecovery
+            # scenario's journal-overhead gate (< 3% of p50).
+            "iteration_s": summarize(self._iter_s),
         }
         if record and self.telemetry is not None:
             self.telemetry.record("serve", event="summary", **out)

@@ -85,6 +85,7 @@ class Replica:
     quarantined_round: int | None = None
     kills: int = 0                   # quarantine cycles survived
     cell: str | None = None          # cell membership (serve/cells.py)
+    crashes: int = 0                 # hard crashes (no-drain) survived
 
 
 class ServeFleet:
@@ -108,7 +109,8 @@ class ServeFleet:
                  breaker: CircuitBreaker | None = None,
                  faults=(), fault_replica: str | None = None,
                  cells=None, fault_cell: str | None = None,
-                 cell_sick_threshold: float = 0.5, clock=None):
+                 cell_sick_threshold: float = 0.5, clock=None,
+                 journal=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if not 0.0 < cell_sick_threshold <= 1.0:
@@ -144,13 +146,26 @@ class ServeFleet:
         # a pure function of the trace + seed.
         self._virtual = clock is not None
         self._clock = clock if clock is not None else time.monotonic
+        self._engine_clock = clock       # fresh post-crash engines reuse it
+        # Write-ahead request journal (serve/journal.py): intent at
+        # acceptance, committed-token watermarks from the engines,
+        # exactly one terminal per trace. None = journal off — byte-
+        # identical scheduling to a journal-less fleet. install() makes
+        # it visible to the crash flight recorder's bundle.
+        self.journal = journal
+        if journal is not None:
+            from distributed_model_parallel_tpu.serve import (
+                journal as journal_mod,
+            )
+
+            journal_mod.install(journal)
         self.replicas: list[Replica] = []
         for i in range(n_replicas):
             name = f"r{i}"
             devs = pool.assign(f"serve-{name}", per)
             eng = Engine(params, cfg, serve, telemetry=telemetry,
                          slo_metrics=slo_metrics, replica=name,
-                         clock=clock)
+                         clock=clock, journal=journal)
             self.replicas.append(Replica(
                 name=name, engine=eng,
                 device_ids=tuple(d.id for d in devs)))
@@ -250,6 +265,14 @@ class ServeFleet:
         self._wall_s = 0.0
         self._migrations = 0
         self._kills = 0
+        # Hard-crash accounting (serve/journal.py crash recovery):
+        # crashes fired, requests re-admitted from the journal, and the
+        # cumulative monotonic recovery-pass duration — the
+        # ``recovery_time_s`` BENCH_serve crash drills gate on
+        # (utils/baseline.py GATE_METRICS, lower-better).
+        self._crashes = 0
+        self._crash_recovered = 0
+        self.recovery_time_s = 0.0
         self.kill_times: dict[str, float] = {}
         self.revive_times: dict[str, float] = {}
         if slo_metrics:
@@ -404,6 +427,16 @@ class ServeFleet:
             statusz.unregister("serve-fleet")
             for rep in self.replicas:
                 statusz.unregister(rep.engine._provider)
+        if self.journal is not None:
+            from distributed_model_parallel_tpu.serve import (
+                journal as journal_mod,
+            )
+
+            # Un-install only OUR journal: a crashed-and-recovered
+            # successor fleet may have installed its own by now, and a
+            # discarded fleet must not blind the flight recorder to it.
+            if journal_mod.installed() is self.journal:
+                journal_mod.install(None)
 
     # -- submission ----------------------------------------------------------
 
@@ -449,6 +482,12 @@ class ServeFleet:
                            prompt_tokens=req.prompt_len,
                            max_new_tokens=req.max_new_tokens,
                            priority=req.priority)
+        # Write-ahead intent (serve/journal.py): durable BEFORE any
+        # engine touches the request, so an accepted request survives
+        # any later crash. Every terminal path journals its matching
+        # single terminal — including the queue-full shed just below.
+        if self.journal is not None:
+            self.journal.intent(req)
         # The bound rejects ALREADY-ARRIVED submissions against the live
         # arrived backlog (the runaway-client case); future-dated
         # open-loop trace entries enqueue and the per-round trim
@@ -499,6 +538,8 @@ class ServeFleet:
         req.state = RequestState.FAILED
         req.shed_reason = reason
         req.error = f"shed: {reason}"
+        if self.journal is not None:
+            self.journal.terminal(req.rid, "shed")
         tracing.rtrace(req,
                        "expired" if reason in ("total-deadline",
                                                "queue-deadline")
@@ -571,8 +612,14 @@ class ServeFleet:
                                 and rep.name == self._fault_replica):
                             # slow_replica sleeps HERE, inside the timed
                             # window, so the health sentinel's serve
-                            # signal observes it like a real throttle.
-                            self.injector.poll("serve")
+                            # signal observes it like a real throttle;
+                            # crash_replica fires the hard-crash path on
+                            # the same victim.
+                            for spec in self.injector.poll("serve"):
+                                if spec.kind == "crash_replica":
+                                    self.crash_replica(rep.name)
+                            if rep.state != LIVE:
+                                continue     # crashed this round
                         stepped = rep.engine.step_once(now, t0)
                         if stepped:
                             # Only WORKING rounds feed the sentinel: an
@@ -841,6 +888,137 @@ class ServeFleet:
                 return migrated
         raise KeyError(f"unknown replica {name!r}")
 
+    def crash_replica(self, name: str, *,
+                      reason: str = "injected-crash") -> int:
+        """Hard-crash drill entry point (serve/journal.py): replica
+        ``name``'s engine object, page pool and prefix tree are
+        DISCARDED with no drain — nothing is exported, exactly what a
+        process death leaves behind. A recovery pass then reconstructs
+        every journaled non-terminal request the dead replica held from
+        the write-ahead journal and re-admits it on a live peer at its
+        disk watermark; the destination's replay prefill re-derives the
+        committed prefix bitwise (the determinism contract) and asserts
+        it against the journal. Returns requests re-admitted."""
+        if self.journal is None:
+            raise ValueError(
+                "crash_replica needs a write-ahead journal (pass "
+                "journal=RequestJournal(...)); without one a hard crash "
+                "can only lose requests — kill_replica is the graceful "
+                "drain path")
+        rep = next((r for r in self.replicas if r.name == name), None)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        if rep.state != LIVE:
+            raise ValueError(f"replica {name!r} is {rep.state}")
+        t0 = time.monotonic()
+        lost = [r for r in rep.engine._requests if not r.done]
+        params, cfg = rep.engine.params, rep.engine.cfg
+        rep.engine.kill(reason=reason)
+        # The crash: the old engine (scheduler, page pool, prefix tree)
+        # is dropped on the floor — no drain, no clear_cache invariant
+        # to satisfy, its pages die with it. A FRESH engine takes the
+        # slot so the standard grow-back path revives the replica cold,
+        # like a restarted process; its statusz provider re-registers
+        # under the same name, replacing the dead engine's entry.
+        rep.engine = Engine(params, cfg, self.serve,
+                            telemetry=self.telemetry,
+                            slo_metrics=self._slo_metrics,
+                            replica=rep.name, clock=self._engine_clock,
+                            journal=self.journal)
+        rep.state = QUARANTINED
+        rep.quarantined_round = self._rounds
+        rep.kills += 1
+        rep.crashes += 1
+        self._kills += 1
+        self._crashes += 1
+        self.kill_times[rep.name] = self._now
+        self.pool.quarantine(rep.device_ids)
+        self.pool.release(self._holder(rep))
+        self._set_live_gauge()
+        if self.telemetry is not None:
+            self.telemetry.record(
+                "event", message=f"fleet crash: replica {rep.name} "
+                                 f"({reason}) devices {rep.device_ids} "
+                                 f"hard-crashed, {len(lost)} requests to "
+                                 f"recover from the journal")
+        self._cell_sweep([rep])
+        recovered = self._recover_lost(lost, rep)
+        self.recovery_time_s += time.monotonic() - t0
+        return recovered
+
+    def _recover_lost(self, lost: list[Request], rep: Replica) -> int:
+        """Journal-driven replay re-admission after a hard crash: every
+        non-terminal request the dead replica held is reset to its DISK
+        watermark (buffered watermarks died with the process) and
+        re-admitted on a live peer, exactly-once by terminal dedup."""
+        st = self.journal.state()
+        recovered = 0
+        for req in lost:
+            if self.journal.is_terminal(req.rid):
+                continue
+            toks = st.tokens.get(req.rid, [])
+            self.journal.discard_pending(req.rid)
+            # Reset to the journaled state: committed prefix from the
+            # disk watermark, every runtime-local field (slot, cursors,
+            # resume payload) cleared — the peer admits it cold and the
+            # replay prefill rebuilds the KV from token values.
+            req.generated = list(toks)
+            req.state = RequestState.QUEUED
+            req.slot = None
+            req.prefill_cursor = 0
+            req.cached_prompt_tokens = 0
+            req.resume = None
+            req.mem_stalled = False
+            req.replay = bool(toks)
+            tracing.rtrace(req, "recovered", sink=self.telemetry,
+                           from_replica=rep.name, committed=len(toks))
+            live = [r for r in self._live()
+                    if r.cell not in self._partitioned]
+            if not live:
+                # Same contract as _migrate's dead end: typed failure,
+                # never a silent drop — and a journaled terminal, so a
+                # later fleet restart does not resurrect it.
+                req.state = RequestState.FAILED
+                req.error = (f"fleet-killed: replica {rep.name} crashed "
+                             f"with no reachable live peer")
+                self.journal.terminal(req.rid, "failed")
+                tracing.rtrace(req, "failed", sink=self.telemetry,
+                               error="no-live-replica")
+                if self._slo_metrics:
+                    registry().counter("serve_requests_failed").inc()
+                if self.telemetry is not None:
+                    self.telemetry.record(
+                        "serve", event="failed", request=req.rid,
+                        policy="fleet", error="no-live-replica",
+                        detail=req.error, prompt_tokens=req.prompt_len,
+                        new_tokens=len(req.generated))
+                continue
+            candidates = [r for r in live
+                          if self.breaker.allows(r.name, self._rounds)
+                          ] or live
+            self._emit_breaker_records()
+            target, reason, loads = self.router.pick(
+                req.prompt, candidates, migrate=True, request=req,
+                sink=self.telemetry)
+            target.engine.enqueue(req, force=True)
+            recovered += 1
+            self._crash_recovered += 1
+            if self._slo_metrics:
+                registry().counter("serve_router_assignments").inc()
+            if self.telemetry is not None:
+                self.telemetry.record(
+                    "router", request=req.rid, replica=target.name,
+                    reason=reason, round=self._rounds,
+                    loads={k: round(v, 3)
+                           for k, v in sorted(loads.items())})
+                # The recovery ledger entry pairing the kill's failure
+                # record — dmp_report folds these like migrations.
+                self.telemetry.record(
+                    "recovery", action="replay-readmit", request=req.rid,
+                    from_replica=rep.name, to_replica=target.name,
+                    committed=len(toks), round=self._rounds)
+        return recovered
+
     def kill_cell(self, cell: str, *, reason: str = "cell-killed") -> int:
         """Correlated-failure entry point: quarantine + drain EVERY live
         member of ``cell`` at once (a rack power event, a cell-wide
@@ -944,6 +1122,8 @@ class ServeFleet:
             req.error = (f"fleet-killed: replica {source.name} quarantined "
                          f"with no reachable live peer")
             req.resume = None
+            if self.journal is not None:
+                self.journal.terminal(req.rid, "failed")
             tracing.rtrace(req, "failed", sink=self.telemetry,
                            error="no-live-replica")
             if self._slo_metrics:
@@ -1016,6 +1196,55 @@ class ServeFleet:
                     replicas=[r.name
                               for r in self._cell_members(rep.cell)])
 
+    # -- full fleet restart (serve/journal.py) -------------------------------
+
+    @classmethod
+    def recover(cls, params: dict, cfg, serve: ServeConfig,
+                n_replicas: int, *, journal, telemetry=None, clock=None,
+                **kw) -> "ServeFleet":
+        """Restart a crashed fleet from its write-ahead journal: build a
+        fresh fleet (same geometry, fresh engines, empty caches), then
+        re-queue every journaled ACCEPTED request without a terminal at
+        its disk watermark — replay prefill re-derives each committed
+        prefix bitwise, terminals journaled before the crash are never
+        re-served (exactly-once by rid dedup). Requests bypass
+        :meth:`submit`: they are rescued load, not new demand — no
+        re-stamp (the journaled trace id survives the restart), no
+        queue bound, and ``journal.intent`` dedups their rids anyway.
+        Torn trailing journal lines (a crash mid-write) are skipped by
+        the fold; recovery proceeds on the surviving prefix."""
+        t0 = time.monotonic()
+        fleet = cls(params, cfg, serve, n_replicas, telemetry=telemetry,
+                    clock=clock, journal=journal, **kw)
+        st = journal.state()
+        for rid in st.pending():
+            rec = st.intents[rid]
+            toks = st.tokens.get(rid, [])
+            journal.discard_pending(rid)   # stale if the object survived
+            req = Request(
+                rid=rid,
+                prompt=[int(t) for t in rec.get("prompt", ())],
+                max_new_tokens=int(rec.get("max_new_tokens", 1)),
+                arrival_s=float(rec.get("arrival_s", 0.0)),
+                seed=int(rec.get("seed", 0)),
+                priority=rec.get("priority", "interactive"),
+                queue_budget_s=rec.get("queue_budget_s"),
+                deadline_s=rec.get("deadline_s"))
+            req.trace_id = rec.get("trace")
+            req.generated = list(toks)
+            req.replay = bool(toks)
+            fleet._ids.add(rid)
+            fleet._requests.append(req)
+            # seq restarts at 1 in the new process: the joiner treats
+            # the seq drop as an epoch boundary and links the restart
+            # hop through this ``recovered`` event.
+            tracing.rtrace(req, "recovered", sink=fleet.telemetry,
+                           committed=len(toks), restart=True)
+            fleet._pending.append(req)
+        fleet._crash_recovered += len(fleet._pending)
+        fleet.recovery_time_s += time.monotonic() - t0
+        return fleet
+
     def _fail_fleet(self, detail: str) -> None:
         for rep in self.replicas:
             rep.engine._fail_inflight(detail)
@@ -1026,6 +1255,8 @@ class ServeFleet:
             req = self._pending.popleft()
             req.state = RequestState.FAILED
             req.error = f"fleet-killed: {detail}"
+            if self.journal is not None:
+                self.journal.terminal(req.rid, "failed")
             tracing.rtrace(req, "failed", sink=self.telemetry,
                            error="fleet-killed")
             if self._slo_metrics:
@@ -1078,7 +1309,8 @@ class ServeFleet:
             "live_replicas": len(self._live()),
             "replicas": {r.name: {"state": r.state,
                                   "devices": list(r.device_ids),
-                                  "kills": r.kills}
+                                  "kills": r.kills,
+                                  "crashes": r.crashes}
                          for r in self.replicas},
             "requests_completed": len(completed),
             "requests_failed": len(failed),
@@ -1099,6 +1331,11 @@ class ServeFleet:
                                      if r.migrations > 0),
             "migrations": self._migrations,
             "replica_kills": self._kills,
+            "replica_crashes": self._crashes,
+            "crash_recovered": self._crash_recovered,
+            "recovery_time_s": round(self.recovery_time_s, 6),
+            "journal": (self.journal.summary()
+                        if self.journal is not None else None),
             "tokens_generated": tokens,
             "wall_s": self._wall_s,
             "tokens_per_s": (tokens / self._wall_s if self._wall_s > 0

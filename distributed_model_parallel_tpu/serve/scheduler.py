@@ -164,6 +164,22 @@ class Request:                     # objects in slots/queues, not values
     # head-of-line page-pressure block, cleared when the request finally
     # admits — one event per stall episode, not one per iteration.
     mem_stalled: bool = False
+    # Crash recovery (serve/journal.py): a replayed request re-prefills
+    # prompt + journaled committed tokens instead of just the prompt —
+    # the final prefill chunk re-samples the last committed token and
+    # the engine asserts it bitwise against the journal (the same
+    # determinism contract migration relies on), then clears the flag.
+    replay: bool = False
+
+    @property
+    def prefill_tokens(self) -> list[int]:
+        """What prefill must process before decode (re)starts: the
+        prompt — plus, for a journal-replay request, every committed
+        token except the last (re-sampled and asserted by the final
+        prefill chunk)."""
+        if self.replay and self.generated:
+            return self.prompt + self.generated[:-1]
+        return self.prompt
 
     @property
     def prompt_len(self) -> int:
@@ -373,7 +389,10 @@ class Scheduler:
                     # queues exactly when its full reservation exceeds
                     # free + evictable (tests/test_prefix_cache.py pins
                     # the regression).
-                    got = self.cache.try_admit(req.rid, req.prompt,
+                    # (A journal-replay request admits over prompt +
+                    # committed tokens — prefill_tokens — so its pages
+                    # cover the whole replayed prefix.)
+                    got = self.cache.try_admit(req.rid, req.prefill_tokens,
                                                req.total_capacity)
                     if got is None:
                         self._note_memory_stall(req)

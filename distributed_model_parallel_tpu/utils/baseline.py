@@ -91,6 +91,12 @@ GATE_METRICS: dict[str, bool] = {
     # goodput holds.
     "goodput_tokens_per_s": True,
     "shed_fraction": False,
+    # Crash drill (BENCH_serve fleet mode with a journal): cumulative
+    # journal recovery-pass seconds — the time accepted requests sat
+    # unservable between a hard crash and their replay re-admission.
+    # Lower-better: a creeping recovery pass is exactly the regression
+    # the write-ahead journal exists to bound.
+    "recovery_time_s": False,
 }
 
 DEFAULT_K = 3.0
@@ -182,7 +188,8 @@ def ingest_artifact(path: str) -> list[dict]:
                      ("post_kill_ttft_p99_s", "post_kill_ttft_p99_s"),
                      ("migrations", "migrations"),
                      ("goodput_tokens_per_s", "goodput_tokens_per_s"),
-                     ("shed_fraction", "shed_fraction")):
+                     ("shed_fraction", "shed_fraction"),
+                     ("recovery_time_s", "recovery_time_s")):
         v = parsed.get(src)
         if isinstance(v, (int, float)):
             metrics[dst] = float(v)
@@ -272,7 +279,8 @@ def extract_points(records: list[dict]) -> list[dict]:
         for k in ("mfu", "ttft_p99_s", "token_latency_p99_s",
                   "cache_hit_rate", "draft_accept_rate",
                   "post_kill_ttft_p99_s", "migrations",
-                  "goodput_tokens_per_s", "shed_fraction"):
+                  "goodput_tokens_per_s", "shed_fraction",
+                  "recovery_time_s"):
             if isinstance(b.get(k), (int, float)):
                 metrics[k] = float(b[k])
         if step_p50 is not None:

@@ -56,6 +56,14 @@ kind           site    effect when fired
                        sentinel's ``serve`` signal sees it. The serving
                        fleet polls this site inside the victim replica's
                        timed engine round (serve/fleet.py)
+``crash_replica`` serve HARD-crash the victim replica at the firing
+                       serve-site poll: engine object, page pool and
+                       prefix tree discarded with NO drain — nothing
+                       exported, exactly what a process death leaves
+                       behind; the write-ahead journal re-admits every
+                       accepted non-terminal request on a live peer at
+                       its committed watermark
+                       (serve/fleet.py ``crash_replica``)
 ``admission_fail`` admit PERSISTENT (bounded): from the firing admit-site
                        poll on, the next ``param`` admission attempts
                        (default 6) to the victim replica FAIL — a replica
@@ -134,6 +142,7 @@ FAULT_SITES = {
     "slow_device": "step",
     "flaky_sync": "sync",
     "slow_replica": "serve",
+    "crash_replica": "serve",
     "admission_fail": "admit",
     "kill_cell": "cell",
     "slow_cell": "cell",

@@ -29,6 +29,11 @@ it:
   ``health.json``         the device-health sentinel's scores/quarantine
                           (:func:`~.health.installed`), when one is
                           installed
+  ``journal.json``        the installed write-ahead request journal's
+                          position + last-N raw lines
+                          (``serve.journal.installed``), so a serving
+                          crash bundle is self-contained for replay
+                          debugging — null when no journal is installed
   ======================= =================================================
 
   and emits one typed ``postmortem`` telemetry record pointing at the
@@ -210,6 +215,21 @@ def dump_postmortem(dir: str, reason: str, *,                # noqa: A002
         monitor = _health.installed()
         _write("health.json", json.dumps(
             monitor.snapshot() if monitor is not None else None, indent=2))
+        # Serving journal tail (serve/journal.py): imported lazily and
+        # defensively — flightrec is wired into train-only processes
+        # where the serve package may never load.
+        try:
+            from distributed_model_parallel_tpu.serve import (
+                journal as _journal,
+            )
+
+            jr = _journal.installed()
+        except Exception:
+            jr = None
+        _write("journal.json", json.dumps(
+            {"path": jr.path, "position": jr.position(),
+             "tail": jr.tail()} if jr is not None else None,
+            indent=2, default=str))
         _write("manifest.json", json.dumps({
             "reason": reason,
             "ts": time.time(),
@@ -218,7 +238,8 @@ def dump_postmortem(dir: str, reason: str, *,                # noqa: A002
                       if error is not None else None),
             "n_records": len(records),
             "files": ["manifest.json", "records.jsonl", "stacks.txt",
-                      "spans.json", "memory.json", "health.json"],
+                      "spans.json", "memory.json", "health.json",
+                      "journal.json"],
         }, indent=2))
         telemetry.registry().counter("postmortem_dumps").inc()
         if rec is not None:

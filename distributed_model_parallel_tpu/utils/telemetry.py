@@ -644,7 +644,11 @@ def _rtrace_phase(prev: dict, nxt: dict, clamped: bool,
     events to one phase. The rules partition a trace's whole ts span, so
     per-phase seconds sum exactly to the timeline's wall time."""
     pe, ne = prev.get("event"), nxt.get("event")
-    if pe == "export" or ne == "import":
+    if pe == "export" or ne in ("import", "recovered"):
+        # The interval INTO a ``recovered`` event is crash downtime —
+        # the request sat in a dead replica's abandoned state (or a
+        # downed fleet's journal) until recovery re-admitted it; same
+        # bucket as a graceful migration's pause.
         return "migration-pause"
     if pe == "memory_stall":
         return "memory-stall"
@@ -667,20 +671,28 @@ def join_request_traces(records: Iterable[dict]) -> dict[str, dict]:
     stamps) and events split across replica streams by a migration still
     reconstruct in their true causal order. Each timeline carries:
 
-    * ``events`` — the records, seq-ordered;
+    * ``events`` — the records, causally ordered by (epoch, seq): a
+      full fleet restart resets a request's seq counter to 1, so a seq
+      DROP in record order starts a new epoch — the restart's
+      ``recovered`` event must open it, or the trace is an orphan;
     * ``terminal`` — the single terminal event name (completed / shed /
       expired / failed), or None;
     * ``hops`` — migration hops, linked wherever an ``export`` is
       followed (by seq; the migration re-route record may intervene)
-      by an ``import`` whose emitting replica/stream differs:
-      ``{seq, from, to}``;
-    * ``orphan`` / ``orphan_reasons`` — a seq gap (a lost span), zero
-      terminals (a silently dropped request) or more than one (a
-      double-accounted one);
+      by an ``import`` whose emitting replica/stream differs, PLUS one
+      export-less hop per ``recovered`` event (a crash moves the
+      request with no export — the journal is the carrier):
+      ``{seq, from, to}`` (``recovered: True`` on crash hops);
+    * ``orphan`` / ``orphan_reasons`` — a seq gap (a lost span, or a
+      restart that skipped the ``recovered`` wiring — its duplicate
+      seqs collapse into one), zero terminals (a silently dropped
+      request) or more than one (a double-accounted one);
     * ``phases`` — seconds per phase (queue / prefill / decode /
       brownout-clamp / migration-pause / memory-stall / other) from an
       interval partition of the event timestamps: phases sum exactly to
-      ``wall_s`` (= last ts - first ts) by construction.
+      ``wall_s`` (= last ts - first ts) by construction. Crash downtime
+      (the interval into a ``recovered`` event) lands in
+      ``migration-pause``.
     """
     by_trace: dict[str, list[dict]] = {}
     for r in records:
@@ -688,12 +700,36 @@ def join_request_traces(records: Iterable[dict]) -> dict[str, dict]:
             continue
         by_trace.setdefault(str(r["trace"]), []).append(r)
     out: dict[str, dict] = {}
-    for trace, evs in by_trace.items():
-        evs.sort(key=lambda r: (r.get("seq") or 0))
-        seqs = [int(r.get("seq") or 0) for r in evs]
+    for trace, raw in by_trace.items():
+        # Epoch split FIRST, in record order: a request's seq counter
+        # restarts at 1 when a fleet restart rebuilds the Request object
+        # from the journal, and the restart's ``recovered`` event is the
+        # first record the new process emits for it — so a non-
+        # increasing seq ON a ``recovered`` event marks the process
+        # boundary. A seq drop WITHOUT one (interleaved multi-stream
+        # input) stays in the same epoch, where the per-epoch sort
+        # recovers causal order — and a restart that skipped the
+        # ``recovered`` wiring collapses into duplicate seqs, flagged as
+        # a seq-gap orphan below (an unlinked restart is an orphan, not
+        # a hop).
+        epochs: list[list[dict]] = [[]]
+        last_seq = None
+        for r in raw:
+            s = int(r.get("seq") or 0)
+            if (last_seq is not None and s <= last_seq
+                    and r.get("event") == "recovered"):
+                epochs.append([])
+            epochs[-1].append(r)
+            last_seq = s
+        for ep in epochs:
+            ep.sort(key=lambda r: (r.get("seq") or 0))
+        evs = [r for ep in epochs for r in ep]
         reasons: list[str] = []
-        if seqs != list(range(1, len(evs) + 1)):
-            reasons.append("seq-gap")
+        for ep in epochs:
+            seqs = [int(r.get("seq") or 0) for r in ep]
+            if seqs != list(range(1, len(ep) + 1)):
+                reasons.append("seq-gap")
+                break
         terminals = [r for r in evs
                      if r.get("event") in RTRACE_TERMINAL_EVENTS]
         if not terminals:
@@ -702,10 +738,13 @@ def join_request_traces(records: Iterable[dict]) -> dict[str, dict]:
             reasons.append("multiple-terminals")
         # Pair each export with the NEXT import (the migration re-route
         # emits a ``route`` record between them, so strict adjacency
-        # would miss the hop).
+        # would miss the hop). A ``recovered`` event is an export-LESS
+        # hop: the source died without draining, the journal carried
+        # the request — ``from`` is the dead replica, ``to`` the next
+        # event's origin (the post-recovery route decision).
         hops = []
         pending_export = None
-        for r in evs:
+        for j, r in enumerate(evs):
             if r.get("event") == "export":
                 pending_export = r
             elif r.get("event") == "import" and pending_export is not None:
@@ -714,6 +753,15 @@ def join_request_traces(records: Iterable[dict]) -> dict[str, dict]:
                                  "from": _rtrace_origin(pending_export),
                                  "to": _rtrace_origin(r)})
                 pending_export = None
+            elif r.get("event") == "recovered":
+                src = r.get("from_replica")
+                if src is None and j > 0:
+                    src = _rtrace_origin(evs[j - 1])
+                dst = (_rtrace_origin(evs[j + 1]) if j + 1 < len(evs)
+                       else _rtrace_origin(r))
+                hops.append({"seq": r.get("seq"),
+                             "from": str(src) if src is not None else "",
+                             "to": dst, "recovered": True})
         phases: dict[str, float] = {}
         clamped = prefilled = False
         for a, b in zip(evs, evs[1:]):

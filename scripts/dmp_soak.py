@@ -41,7 +41,13 @@ cell-scale correlated fault (``kill_cell`` / ``slow_cell`` /
 ``partition``, utils/faults.py) hits one cell, gated on zero lost
 requests, bitwise token parity, complete rtrace timelines, goodput
 within ``--goodput-band`` of the clean run, and exact-slice cell
-grow-back (see ``run_fleet_scenario``). Any scenario's gate violation
+grow-back (see ``run_fleet_scenario``); and ``crashrecovery`` — the
+write-ahead-journal crash-consistency drill: a hard replica crash (no
+drain) and a full fleet restart (torn journal tail included) must both
+recover every accepted request bitwise at its committed-token watermark
+with exactly-once terminal accounting, a replay-deterministic schedule
+digest, < 3% journal overhead and zero journal-off behavior change
+(see ``run_crashrecovery_scenario``). Any scenario's gate violation
 dumps a flight-recorder postmortem bundle and prints its path before
 the nonzero exit.
 
@@ -99,7 +105,8 @@ def parse_args(argv=None):
     p.add_argument("--mode", default="fast", choices=["fast", "long"])
     p.add_argument("--scenario", default="chaos",
                    choices=["chaos", "degradation", "overload", "xray",
-                            "failover", "flashcrowd", "flood", "diurnal"],
+                            "failover", "flashcrowd", "flood", "diurnal",
+                            "crashrecovery"],
                    help="chaos: the heterogeneous fault campaign; "
                         "degradation: the device-health drill — an "
                         "injected slow_device straggler must be "
@@ -129,7 +136,19 @@ def parse_args(argv=None):
                         "lost requests, bitwise token parity, complete "
                         "rtrace timelines, goodput >= --goodput-band of "
                         "the clean run and (failover) exact-slice cell "
-                        "grow-back (see run_fleet_scenario)")
+                        "grow-back (see run_fleet_scenario); "
+                        "crashrecovery: the crash-consistency drill — "
+                        "the write-ahead request journal "
+                        "(serve/journal.py) must recover BOTH a hard "
+                        "replica crash (engine discarded, no drain) and "
+                        "a full fleet restart (a torn journal tail "
+                        "included) with bitwise token parity vs an "
+                        "uninterrupted reference, exactly one terminal "
+                        "per trace, a replay-deterministic schedule "
+                        "digest, < 3%% journal write overhead and a "
+                        "journal-off run whose schedule digest is "
+                        "byte-identical to the journal-on run "
+                        "(see run_crashrecovery_scenario)")
     p.add_argument("--goodput-band", default=0.8, type=float,
                    help="overload/fleet scenarios: goodput under the "
                         "event must stay >= this fraction of clean-run "
@@ -1297,6 +1316,313 @@ def run_fleet_scenario(args, workdir: str, seed: int,
     return out, ok
 
 
+def run_crashrecovery_scenario(args, workdir: str,
+                               seed: int) -> tuple[dict, bool]:
+    """Crash-consistency drill: the write-ahead request journal
+    (serve/journal.py) under both hard-crash paths, on a virtual clock
+    (docs/SERVING.md "Crash recovery").
+
+    Six deterministic runs, one traffic trace (mixed tenants, no
+    deadlines — every accepted request is owed a completion):
+
+    * **reference** — the whole trace on one clean engine: bitwise
+      per-request token references;
+    * **journal-off clean** — the fleet with no journal: the schedule
+      digest the journal must not perturb;
+    * **journal-on clean** — same fleet + journal, no fault: gates the
+      digest BYTE-IDENTICAL to journal-off (zero behavior change) and
+      the journal's SERVE-LOOP write time (watermarks + terminals; the
+      fsync'd intent is admission-path latency charged to submit(),
+      reported separately) < 3% of summed engine iteration wall time;
+    * **crash drill** (x2, same seed) — ``crash_replica`` fired
+      mid-trace on the victim replica: engine, page pool and prefix
+      tree discarded with no drain; every journaled non-terminal
+      request must be re-admitted on a peer and finish bitwise against
+      the reference, with a complete joined rtrace per request (the
+      crash hop linked via the ``recovered`` event, zero orphans) —
+      and the second run's schedule digest must equal the first's
+      (replay-deterministic recovery);
+    * **restart drill** — the fleet is ABANDONED mid-trace (no drain,
+      no close-time flush: buffered watermarks die like a process), a
+      torn partial line is appended to the journal (a crash mid-write
+      at the fsync boundary), and ``ServeFleet.recover`` resumes from
+      the journal alone on a second telemetry stream: the torn tail
+      must be skipped (counted on ``telemetry_torn_lines``), every
+      accepted request must complete bitwise exactly once (journal
+      fold: zero pending, one terminal per intent), and the two
+      streams must join into one complete timeline per request across
+      the restart epoch.
+
+    Gates (non-zero exit when any fails): zero accepted-and-lost and
+    zero failures in every run; bitwise parity everywhere; journal-off
+    digest == journal-on digest; journal overhead < 3%; crash + restart
+    drills each provably fired (crash count, in-flight count at
+    abandonment, torn-line count); exactly one terminal per trace;
+    replay-deterministic crash digest; zero rtrace orphans with >= 1
+    linked ``recovered`` hop per drill.
+    """
+    import jax
+
+    from distributed_model_parallel_tpu.models import transformer as tfm
+    from distributed_model_parallel_tpu.orchestrator.scheduler import (
+        DevicePool,
+    )
+    from distributed_model_parallel_tpu.serve import (
+        Engine,
+        ServeConfig,
+        ServeFleet,
+        SimClock,
+        mixed_tenants,
+    )
+    from distributed_model_parallel_tpu.serve.journal import RequestJournal
+    from distributed_model_parallel_tpu.serve.scheduler import RequestState
+    from distributed_model_parallel_tpu.utils.telemetry import (
+        TelemetryRun,
+        join_request_traces,
+        read_records,
+        registry,
+    )
+    from scripts.dmp_report import build_report
+
+    n_replicas, n_cells = args.replicas, args.cells
+    if n_cells < 2:
+        raise SystemExit("crashrecovery needs --cells >= 2 (the crashed "
+                         "replica's requests re-admit on live peers)")
+    if n_replicas < n_cells:
+        raise SystemExit(f"--replicas {n_replicas} < --cells {n_cells}: "
+                         f"every cell needs at least one replica")
+
+    dt = 0.02
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_slots, page, max_len = 2, 8, 64
+    base = dict(n_slots=n_slots, page_size=page,
+                n_pages=(n_slots + 1) * (-(-max_len // page)),
+                max_seq_len=max_len, prefill_chunk=4)
+    serve = ServeConfig(**base)         # no deadlines: everything lands
+    trace = mixed_tenants(seed, horizon_s=3.0, tenants={
+        # Same standing load as failover, with LONGER generations: the
+        # crash at round 60 (1.2 virtual s) provably catches residents
+        # mid-decode, and the journal's one-terminal-fsync-per-request
+        # cost amortizes over a production-shaped decode length (the
+        # overhead gate measures fsyncs against real decode work, not
+        # the traffic module's few-token toy defaults).
+        "web": {"rate": 22.0, "priority": "interactive",
+                "gen": (18, 30)},
+        "mobile": {"rate": 12.0, "priority": "interactive",
+                   "gen": (18, 30)},
+        "etl": {"rate": 10.0, "priority": "batch", "gen": (24, 36)},
+    })
+    all_rids = {r["rid"] for r in trace}
+
+    os.makedirs(workdir, exist_ok=True)
+    t0 = time.monotonic()
+
+    # -- reference: bitwise per-request tokens, one clean engine
+    ref_eng = Engine(params, cfg, ServeConfig(**base), slo_metrics=False)
+    ref_eng.warmup()
+    ref_reqs = [ref_eng.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                               seed=r["seed"]) for r in trace]
+    ref_eng.run()
+    bad_ref = [q.rid for q in ref_reqs
+               if q.state is not RequestState.COMPLETED]
+    if bad_ref:
+        raise RuntimeError(f"reference run failed requests: {bad_ref}")
+    reference = {q.rid: list(q.generated) for q in ref_reqs}
+
+    def run_fleet(stream, label, *, journal=None, faults_=(),
+                  revive=None, max_rounds=20000):
+        tel = TelemetryRun(stream, run=label)
+        fleet = ServeFleet(
+            params, cfg, serve, n_replicas,
+            pool=DevicePool([_FakeDev(i) for i in range(n_replicas)]),
+            telemetry=tel, cells=n_cells, router_seed=seed,
+            clock=SimClock(dt), faults=faults_, revive_after=revive,
+            journal=journal)
+        for r in trace:
+            fleet.submit(r["prompt"], r["max_new"], rid=r["rid"],
+                         arrival_s=r["arrival_s"], seed=r["seed"],
+                         priority=r["priority"])
+        # Intent records are written inside submit() — admission-path
+        # latency, not serve-loop overhead. Snapshot the split so the
+        # overhead gate charges the serve loop only for what rides it
+        # (watermarks + terminals).
+        admit_write_s = journal.write_s if journal is not None else 0.0
+        s = fleet.run(max_rounds=max_rounds)
+        tel.finish()
+        fleet.close()
+        return fleet, s, admit_write_s
+
+    def parity_bad(fleets):
+        """Rids not completed bitwise-identical to the reference across
+        the given fleets (a rid counts once it completes anywhere)."""
+        done = {}
+        for fl in fleets:
+            for q in fl.results():
+                if q.state is RequestState.COMPLETED:
+                    done.setdefault(q.rid, q)
+        missing = sorted(all_rids - set(done))
+        wrong = sorted(r for r, q in done.items()
+                       if q.generated != reference[r])
+        return missing + wrong
+
+    def recovered_hops(traces):
+        return sum(1 for t in traces.values()
+                   for h in t["hops"] if h.get("recovered"))
+
+    # -- journal-off vs journal-on: zero behavior change + overhead
+    off_stream = os.path.join(workdir, "crashrecovery_off.jsonl")
+    off_fleet, off_sum, _ = run_fleet(off_stream, "crashrecovery-off")
+    off_digest = _schedule_digest(read_records(off_stream))
+
+    on_stream = os.path.join(workdir, "crashrecovery_on.jsonl")
+    j_on = RequestJournal(os.path.join(workdir, "journal_on.jsonl"))
+    on_fleet, on_sum, admit_write_s = run_fleet(
+        on_stream, "crashrecovery-on", journal=j_on)
+    on_digest = _schedule_digest(read_records(on_stream))
+    iter_wall = sum(sum(rep.engine._iter_s) for rep in on_fleet.replicas)
+    serve_write_s = j_on.write_s - admit_write_s
+    overhead_fraction = serve_write_s / max(iter_wall, 1e-9)
+    clean_bad = parity_bad([off_fleet]) + parity_bad([on_fleet])
+    st_on = j_on.state()
+
+    # -- crash drill, twice at the same seed (digest determinism)
+    def crash_drill(tag):
+        j = RequestJournal(os.path.join(workdir,
+                                        f"journal_crash_{tag}.jsonl"))
+        stream = os.path.join(workdir, f"crashrecovery_crash_{tag}.jsonl")
+        fleet, s, _ = run_fleet(stream, f"crashrecovery-crash-{tag}",
+                                journal=j,
+                                faults_=("crash_replica@60",), revive=45)
+        return fleet, s, j, stream
+
+    fleet_a, sum_a, j_a, stream_a = crash_drill("a")
+    fleet_b, sum_b, _, stream_b = crash_drill("b")
+    recs_a = read_records(stream_a)
+    print(build_report(recs_a))
+    digest_a = _schedule_digest(recs_a)
+    digest_b = _schedule_digest(read_records(stream_b))
+    crash_bad = parity_bad([fleet_a])
+    crash_traces = join_request_traces(recs_a)
+    crash_orphans = sorted(t["trace"] for t in crash_traces.values()
+                           if t["orphan"])
+    st_a = j_a.state()
+
+    # -- restart drill: abandon mid-trace, torn tail, recover from disk
+    rst_journal = os.path.join(workdir, "journal_restart.jsonl")
+    rst_stream1 = os.path.join(workdir, "crashrecovery_restart_a.jsonl")
+    rst_stream2 = os.path.join(workdir, "crashrecovery_restart_b.jsonl")
+    j1 = RequestJournal(rst_journal)
+    fleet1, _, _ = run_fleet(rst_stream1, "crashrecovery-restart-pre",
+                             journal=j1, max_rounds=60)
+    in_flight = sorted(q.rid for q in fleet1.results()
+                       if q.state is not RequestState.COMPLETED)
+    # The abandonment: fleet1 and j1 are dropped on the floor — no
+    # drain, no watermark flush (j1's buffered tokens die with "the
+    # process") — and the journal's live file gets a torn partial line,
+    # exactly what a crash inside an append leaves behind.
+    with open(rst_journal, "a") as f:
+        f.write('{"ts": 0, "kind": "watermark", "rid": "torn-tail", "to')
+    torn0 = registry().counter("telemetry_torn_lines").value
+    j2 = RequestJournal(rst_journal)    # reopen folds disk, skips tear
+    torn_counted = (registry().counter("telemetry_torn_lines").value
+                    > torn0)
+    tel2 = TelemetryRun(rst_stream2, run="crashrecovery-restart-post")
+    fleet2 = ServeFleet.recover(
+        params, cfg, serve, n_replicas, journal=j2, telemetry=tel2,
+        pool=DevicePool([_FakeDev(i) for i in range(n_replicas)]),
+        cells=n_cells, router_seed=seed, clock=SimClock(dt))
+    rst_sum = fleet2.run(max_rounds=20000)
+    tel2.finish()
+    fleet2.close()
+    rst_bad = parity_bad([fleet1, fleet2])
+    st_rst = j2.state()
+    rst_traces = join_request_traces(read_records(rst_stream1)
+                                     + read_records(rst_stream2))
+    rst_orphans = sorted(t["trace"] for t in rst_traces.values()
+                         if t["orphan"])
+
+    out = {
+        "soak": "crashrecovery-campaign",
+        "scenario": "crashrecovery",
+        "seed": seed,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "replicas": n_replicas,
+        "requests": len(trace),
+        "digest_off": off_digest,
+        "digest_on": on_digest,
+        "journal_transparent": off_digest["sha256"] == on_digest["sha256"],
+        "journal_write_s": round(j_on.write_s, 4),
+        "journal_admission_write_s": round(admit_write_s, 4),
+        "journal_serve_write_s": round(serve_write_s, 4),
+        "engine_iteration_s": round(iter_wall, 4),
+        "journal_overhead_fraction": round(overhead_fraction, 5),
+        "clean_parity_bad": clean_bad,
+        "crash_fired": sum_a["replica_crashes"],
+        "crash_recovered": sum_a["crash_recovered"],
+        "crash_failed": sum_a["requests_failed"],
+        "crash_parity_bad": crash_bad,
+        "crash_rtrace_timelines": len(crash_traces),
+        "crash_rtrace_orphans": crash_orphans,
+        "crash_recovered_hops": recovered_hops(crash_traces),
+        "crash_terminals": len(st_a.terminals),
+        "crash_pending_after": st_a.pending(),
+        "replay_deterministic": digest_a["sha256"] == digest_b["sha256"],
+        "recovery_time_s": sum_a["recovery_time_s"],
+        "restart_in_flight": len(in_flight),
+        "restart_torn_line_counted": torn_counted,
+        "restart_recovered": rst_sum["crash_recovered"],
+        "restart_failed": rst_sum["requests_failed"],
+        "restart_parity_bad": rst_bad,
+        "restart_rtrace_timelines": len(rst_traces),
+        "restart_rtrace_orphans": rst_orphans,
+        "restart_recovered_hops": recovered_hops(rst_traces),
+        "restart_terminals": len(st_rst.terminals),
+        "restart_pending_after": st_rst.pending(),
+        "telemetry": [off_stream, on_stream, stream_a, stream_b,
+                      rst_stream1, rst_stream2],
+    }
+    ok = (
+        # zero behavior change: journal on/off schedules byte-identical
+        out["journal_transparent"]
+        # journal overhead < 3% of serve iteration time
+        and overhead_fraction < 0.03
+        and not clean_bad
+        and off_sum["requests_failed"] == 0
+        and on_sum["requests_failed"] == 0
+        and len(st_on.terminals) == len(trace)
+        # the crash provably fired and every request recovered bitwise
+        and sum_a["replica_crashes"] >= 1
+        and sum_a["crash_recovered"] >= 1
+        and sum_a["requests_failed"] == 0
+        and sum_b["requests_failed"] == 0
+        and not crash_bad
+        # exactly one terminal per trace, none pending
+        and len(st_a.terminals) == len(trace)
+        and not st_a.pending()
+        # the crash hop is a LINKED hop in a complete timeline
+        and len(crash_traces) == len(trace)
+        and not crash_orphans
+        and recovered_hops(crash_traces) >= 1
+        # same seed, same recovery schedule
+        and out["replay_deterministic"]
+        # the restart provably had work to recover, tolerated the torn
+        # tail, and finished every accepted request exactly once
+        and len(in_flight) >= 1
+        and torn_counted
+        and rst_sum["crash_recovered"] >= 1
+        and rst_sum["requests_failed"] == 0
+        and not rst_bad
+        and len(st_rst.terminals) == len(trace)
+        and not st_rst.pending()
+        and len(rst_traces) == len(trace)
+        and not rst_orphans
+        and recovered_hops(rst_traces) >= 1)
+    return out, ok
+
+
 def run_long(args, workdir: str) -> tuple[dict, bool]:
     """Long mode: campaign after campaign with derived seeds until the
     wall-clock budget is spent; one failure fails the soak. At least one
@@ -1330,6 +1656,7 @@ def _campaign_fn(scenario: str):
     return {"degradation": run_degradation_campaign,
             "overload": run_overload_campaign,
             "xray": run_xray_campaign,
+            "crashrecovery": run_crashrecovery_scenario,
             "chaos": run_campaign}[scenario]
 
 

@@ -4,7 +4,8 @@
 Reconstructs causally ordered per-request timelines from the ``rtrace``
 records the serving tier emits (router admission, queue wait, brownout
 clamps, prefill chunks, decode rounds with memory gauges, migration
-export/import hops, terminal events) and renders them three ways:
+export/import hops, crash-recovery ``recovered`` hops, terminal events)
+and renders them three ways:
 
 * fleet summary (default) — trace counts, completion/orphan rates,
   terminal-event breakdown, migration hops;
@@ -122,7 +123,7 @@ def phase_gate_error(tl: dict) -> float:
 
 def summarize(traces: dict[str, dict]) -> dict:
     terminals: dict[str, int] = {}
-    orphans = hops = 0
+    orphans = hops = crash_hops = 0
     phase_totals = {p: 0.0 for p in PHASES}
     for tl in traces.values():
         if tl["orphan"]:
@@ -130,6 +131,7 @@ def summarize(traces: dict[str, dict]) -> dict:
         if tl["terminal"]:
             terminals[tl["terminal"]] = terminals.get(tl["terminal"], 0) + 1
         hops += len(tl["hops"])
+        crash_hops += sum(1 for h in tl["hops"] if h.get("recovered"))
         for p, s in tl["phases"].items():
             phase_totals[p] = phase_totals.get(p, 0.0) + s
     n = len(traces)
@@ -139,6 +141,7 @@ def summarize(traces: dict[str, dict]) -> dict:
         "orphans": orphans,
         "terminals": dict(sorted(terminals.items())),
         "migration_hops": hops,
+        "recovered_hops": crash_hops,
         "phase_seconds": {p: round(s, 4)
                          for p, s in phase_totals.items() if s > 0},
     }
@@ -154,7 +157,8 @@ def render_summary(traces: dict[str, dict], out) -> None:
     print("== fleet x-ray ==", file=out)
     print(f"traces: {s['traces']}  complete: {s['complete']}  "
           f"orphans: {s['orphans']}  migration hops: "
-          f"{s['migration_hops']}", file=out)
+          f"{s['migration_hops']}  recovered hops: "
+          f"{s['recovered_hops']}", file=out)
     if s["terminals"]:
         terms = "  ".join(f"{k}={v}" for k, v in s["terminals"].items())
         print(f"terminals: {terms}", file=out)
@@ -196,8 +200,9 @@ def render_waterfall(tl: dict, out) -> None:
         print(f"  [{r.get('seq'):>3}] {rel_s} {dt_s:>12} "
               f"{r.get('event'):<13} @{origin:<8} {detail}", file=out)
     for hop in tl["hops"]:
+        tag = " (crash recovery)" if hop.get("recovered") else ""
         print(f"  hop @seq {hop['seq']}: {hop['from'] or '?'} -> "
-              f"{hop['to'] or '?'}", file=out)
+              f"{hop['to'] or '?'}{tag}", file=out)
     print(f"  phases: {_fmt_phases(tl['phases'])}", file=out)
 
 

@@ -69,10 +69,13 @@ def test_every_emitted_record_kind_is_documented():
     # a refactor that stops emitting them fails loudly too.
     # (cell: serve/fleet.py correlated-failure lifecycle — kill / sick /
     # partition / heal / grow-back — the ISSUE-17 scenario gates replay
-    # these, so silently losing the kind would blind the soak runner.)
+    # these, so silently losing the kind would blind the soak runner.
+    # intent / watermark / terminal: serve/journal.py write-ahead
+    # journal records — the ISSUE-18 crash-recovery paths replay from
+    # them, so losing a kind would silently break crash consistency.)
     assert {"run_start", "step", "failure", "recovery", "tenant",
             "alert", "postmortem", "cell", "router", "migration",
-            "shed"} <= emitted
+            "shed", "intent", "watermark", "terminal"} <= emitted
     missing = sorted(emitted - _documented_kinds())
     assert not missing, (
         f"telemetry record kinds emitted but missing from the "

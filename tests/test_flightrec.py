@@ -68,7 +68,11 @@ def test_dump_postmortem_bundle_contents(tmp_path):
     assert path is not None and os.path.isdir(path)
     files = _bundle(path)
     assert set(files) == {"manifest.json", "records.jsonl", "stacks.txt",
-                          "spans.json", "memory.json", "health.json"}
+                          "spans.json", "memory.json", "health.json",
+                          "journal.json"}
+    # No journal installed in this test: the tail is an explicit null,
+    # so replay debugging can tell "no journal" from "file missing".
+    assert json.loads(files["journal.json"]) is None
     manifest = json.loads(files["manifest.json"])
     assert manifest["reason"] == "test-crash"
     assert "ValueError: the failing thing" in manifest["error"]

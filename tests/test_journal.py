@@ -1,0 +1,369 @@
+"""Write-ahead request journal: crash-consistent serving recovery.
+
+The load-bearing properties (docs/SERVING.md "Crash recovery"):
+
+* the journal folds back to exactly what was accepted: intent /
+  watermark / terminal round-trip through :func:`journal.fold`, with
+  exactly-once terminal accounting (dedup by rid, unknown rids
+  dropped);
+* a torn trailing line — a crash mid-append at the fsync boundary —
+  is skipped by the fold, counted on ``telemetry_torn_lines``, and
+  truncated on reopen so post-recovery appends start on a record
+  boundary;
+* rotation parts fold in order and reopening resumes dedup state;
+* the committed-token watermark NEVER advances past what the model
+  committed — pinned with speculative decoding ON, where a rejected
+  draft tail is exactly the thing that must not leak;
+* ``ServeFleet.crash_replica`` discards a replica's engine with no
+  drain and replays every journaled non-terminal request bitwise on a
+  peer (chaos tier);
+* ``ServeFleet.recover`` restarts a whole fleet from the journal alone
+  and finishes every accepted request bitwise, exactly once (chaos
+  tier);
+* the flight recorder's postmortem bundle carries the installed
+  journal's position + tail (``journal.json``).
+"""
+
+import json
+
+import jax
+import pytest
+
+from distributed_model_parallel_tpu.models import transformer as tfm
+from distributed_model_parallel_tpu.serve import (
+    Engine,
+    ServeConfig,
+    ServeFleet,
+)
+from distributed_model_parallel_tpu.serve import journal as journal_mod
+from distributed_model_parallel_tpu.serve.journal import (
+    RequestJournal,
+    fold,
+)
+from distributed_model_parallel_tpu.serve.scheduler import RequestState
+from distributed_model_parallel_tpu.utils.telemetry import (
+    TelemetryRun,
+    read_records,
+    registry,
+)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    return cfg, tfm.init_params(jax.random.key(0), cfg)
+
+
+def _serve(**kw):
+    base = dict(n_slots=2, page_size=8, n_pages=32, max_seq_len=64,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16],
+           [3, 3, 3]]
+GENS = [12, 18, 7, 10]
+
+
+def _solo_reference(cfg, params, serve_kw=None):
+    eng = Engine(params, cfg, _serve(**(serve_kw or {})))
+    reqs = [eng.submit(p, g, seed=i, rid=f"req-{i}")
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    eng.run()
+    return {r.rid: r.generated for r in reqs}
+
+
+class _Req:
+    """Minimal intent-shaped stand-in (the journal copies
+    ``_INTENT_FIELDS`` + rid + trace_id verbatim)."""
+
+    def __init__(self, rid, prompt=(1, 2, 3), seed=0):
+        self.rid = rid
+        self.trace_id = f"t-{rid}"
+        self.prompt = list(prompt)
+        self.seed = seed
+        self.max_new_tokens = 8
+        self.priority = "interactive"
+        self.queue_budget_s = None
+        self.deadline_s = None
+        self.arrival_s = 0.0
+
+
+# ---------------------------------------------------------------------------
+# record round-trip + exactly-once accounting
+# ---------------------------------------------------------------------------
+
+def test_intent_watermark_terminal_roundtrip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, watermark_every=4)
+    assert j.intent(_Req("a", prompt=[5, 6], seed=7))
+    assert j.intent(_Req("b"))
+    assert not j.intent(_Req("a")), "intent must dedup by rid"
+    j.commit("a", [1, 2, 3])           # below watermark_every: buffered
+    st = j.state()
+    assert st.tokens["a"] == [], "buffered tokens are not yet journaled"
+    j.commit("a", [4])                 # 4th token: watermark written
+    assert j.state().tokens["a"] == [1, 2, 3, 4]
+    j.commit("a", [9, 9])
+    assert j.terminal("a", "completed"), \
+        "terminal must flush the buffered tail first"
+    st = fold(path)
+    assert st.tokens["a"] == [1, 2, 3, 4, 9, 9]
+    assert st.intents["a"]["prompt"] == [5, 6]
+    assert st.intents["a"]["seed"] == 7
+    assert st.intents["a"]["trace"] == "t-a"
+    assert st.terminals == {"a": "completed"}
+    assert st.pending() == ["b"], "acceptance order, terminals excluded"
+
+
+def test_terminal_exactly_once_and_unknown_rid(tmp_path):
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    j.intent(_Req("a"))
+    assert j.terminal("a", "completed")
+    assert not j.terminal("a", "failed"), "one terminal per rid, ever"
+    assert not j.terminal("ghost", "completed"), \
+        "never-accepted rids owe no terminal"
+    assert j.is_terminal("a") and not j.is_terminal("ghost")
+    with pytest.raises(ValueError):
+        j.terminal("a", "evaporated")
+    assert fold(j.path).terminals == {"a": "completed"}
+    j.commit("a", [1, 2, 3, 4, 5, 6, 7, 8, 9])
+    assert fold(j.path).tokens["a"] == [], \
+        "a terminaled request accepts no further watermarks"
+
+
+# ---------------------------------------------------------------------------
+# torn tail: crash mid-append at the fsync boundary
+# ---------------------------------------------------------------------------
+
+def test_torn_tail_skipped_counted_and_truncated(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, watermark_every=2)
+    j.intent(_Req("a"))
+    j.commit("a", [1, 2])
+    j.terminal("a", "completed")
+    j.intent(_Req("b"))
+    j.close()
+    # The crash: the NEXT record (b's terminal) tears mid-write, right
+    # at the fsync boundary — keep roughly half its bytes, no newline.
+    whole = json.dumps({"ts": 0.0, "kind": "terminal", "rid": "b",
+                        "outcome": "completed"})
+    with open(path, "a") as f:
+        f.write(whole[:len(whole) // 2])
+    before = registry().counter("telemetry_torn_lines").value
+    j2 = RequestJournal(path)          # reopen: fold + truncate
+    assert registry().counter("telemetry_torn_lines").value > before, \
+        "the torn line must be counted, not silently eaten"
+    st = j2.state()
+    assert st.tokens["a"] == [1, 2]
+    assert st.terminals == {"a": "completed"}
+    assert st.pending() == ["b"], \
+        "the torn terminal never became durable: b is still owed"
+    # The reopen truncated the tear, so the next append parses cleanly.
+    assert j2.terminal("b", "failed")
+    assert fold(path).terminals == {"a": "completed", "b": "failed"}
+    with open(path) as f:
+        for line in f:
+            json.loads(line)           # every line whole again
+
+
+def test_rotation_folds_across_parts_and_reopen_resumes(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, watermark_every=1, max_bytes=256)
+    rids = [f"r{i}" for i in range(8)]
+    for i, rid in enumerate(rids):
+        j.intent(_Req(rid, seed=i))
+        j.commit(rid, [i, i + 1])
+    for rid in rids[:4]:
+        j.terminal(rid, "completed")
+    assert j.position()["parts"] > 1, "max_bytes must have rotated"
+    st = fold(path)
+    assert set(st.intents) == set(rids)
+    assert all(st.tokens[r] == [i, i + 1]
+               for i, r in enumerate(rids))
+    assert st.pending() == rids[4:]
+    j2 = RequestJournal(path)          # reopen resumes dedup state
+    assert not j2.intent(_Req("r0")), "reopen must remember intents"
+    assert not j2.terminal("r0", "failed"), \
+        "reopen must remember terminals"
+    assert j2.terminal("r5", "shed")
+    assert fold(path).terminals["r5"] == "shed"
+
+
+# ---------------------------------------------------------------------------
+# watermark semantics: only model-committed tokens, spec decoding ON
+# ---------------------------------------------------------------------------
+
+def test_watermark_never_passes_committed_with_spec_decoding(model,
+                                                             tmp_path):
+    """With the n-gram proposer drafting ahead, every journaled
+    watermark must be a bitwise PREFIX of what the model finally
+    committed — a rejected draft tail reaching the journal would show
+    up as a diverging prefix here."""
+    cfg, params = model
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path, watermark_every=2)
+    eng = Engine(params, cfg, _serve(spec_k=3), journal=j)
+    # Repetitive prompts make the self-drafting proposer fire for real.
+    reqs = [eng.submit([1, 2, 3] * 4, 24, seed=0, rid="loop"),
+            eng.submit([7, 7, 7, 7, 7, 7], 20, seed=1, rid="flat")]
+    for r in reqs:
+        j.intent(r)
+    eng.run()
+    assert all(r.state is RequestState.COMPLETED for r in reqs)
+    assert eng._draft_proposed > 0, \
+        "no drafts proposed — the spec path never engaged"
+    final = {r.rid: list(r.generated) for r in reqs}
+    seen: dict[str, list] = {r.rid: [] for r in reqs}
+    n_watermarks = 0
+    for rec in read_records(path):
+        if rec["kind"] != "watermark":
+            continue
+        n_watermarks += 1
+        cum = seen[rec["rid"]]
+        cum.extend(rec["tokens"])
+        assert rec["committed"] == len(cum)
+        assert cum == final[rec["rid"]][:len(cum)], (
+            f"watermark for {rec['rid']} diverged from the committed "
+            f"sequence — a speculative tail leaked into the journal")
+    assert n_watermarks > 0
+    assert fold(path).tokens == final, \
+        "the terminal must flush each request's full committed tail"
+
+
+# ---------------------------------------------------------------------------
+# chaos: hard replica crash + full fleet restart
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_crash_replica_replays_bitwise_on_peer(model, tmp_path):
+    """Hard-crash one of two replicas mid-stream: the engine is
+    discarded with NO drain, and every journaled non-terminal request
+    re-admits on the peer and finishes bitwise against the unkilled
+    reference; the fresh engine grows back and takes traffic."""
+    cfg, params = model
+    refs = _solo_reference(cfg, params)
+    stream = str(tmp_path / "drill.jsonl")
+    tel = TelemetryRun(stream, run="crash-drill")
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    fleet = ServeFleet(params, cfg, _serve(), 2, telemetry=tel,
+                       router_seed=0, revive_after=3, journal=j)
+    recovered_at_crash = {}
+
+    def hook(rnd):
+        if rnd == 4:
+            recovered_at_crash["n"] = fleet.crash_replica("r0")
+
+    fleet.step_hook = hook
+    reqs = [fleet.submit(p, g, seed=i, rid=f"req-{i}")
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    summary = fleet.run()
+    tel.finish()
+    assert recovered_at_crash["n"] > 0, \
+        "the crash must catch live requests"
+    assert summary["replica_crashes"] == 1
+    assert summary["crash_recovered"] == recovered_at_crash["n"]
+    assert summary["requests_failed"] == 0
+    assert summary["recovery_time_s"] > 0
+    for r in reqs:
+        assert r.state is RequestState.COMPLETED
+        assert r.generated == refs[r.rid], (
+            f"{r.rid} diverged after the hard crash")
+    st = j.state()
+    assert not st.pending(), "every accepted request owes ONE terminal"
+    assert len(st.terminals) == len(PROMPTS)
+    assert all(o == "completed" for o in st.terminals.values())
+    r0 = fleet.replicas[0]
+    assert r0.state == "live", "the crashed replica must grow back"
+    assert r0.crashes == 1
+    recs = read_records(stream)
+    recovered = [r for r in recs if r.get("kind") == "rtrace"
+                 and r.get("event") == "recovered"]
+    assert len(recovered) == recovered_at_crash["n"]
+    assert all(r.get("from_replica") == "r0" for r in recovered)
+    assert [r for r in recs if r.get("kind") == "recovery"
+            and r.get("action") == "replay-readmit"]
+    # Crash-path failure record names the journal replay point.
+    [killed] = [r for r in recs if r.get("kind") == "failure"
+                and r.get("error") == "replica-crashed"]
+    assert killed["journal"]["records"] > 0
+
+
+@pytest.mark.chaos
+def test_crash_replica_without_journal_raises(model):
+    cfg, params = model
+    fleet = ServeFleet(params, cfg, _serve(), 2, router_seed=0)
+    with pytest.raises(ValueError, match="journal"):
+        fleet.crash_replica("r0")
+    fleet.close()
+
+
+@pytest.mark.chaos
+def test_fleet_recover_restarts_from_journal(model, tmp_path):
+    """Abandon a journaled fleet mid-stream (no drain, no flush) and
+    restart from the journal alone: every accepted request finishes
+    bitwise with exactly-once terminal accounting."""
+    cfg, params = model
+    refs = _solo_reference(cfg, params)
+    path = str(tmp_path / "j.jsonl")
+    j1 = RequestJournal(path)
+    fleet1 = ServeFleet(params, cfg, _serve(), 2, router_seed=0,
+                        journal=j1)
+    for i, (p, g) in enumerate(zip(PROMPTS, GENS)):
+        fleet1.submit(p, g, seed=i, rid=f"req-{i}")
+    fleet1.run(max_rounds=4)           # mid-stream…
+    fleet1.close()                     # …and the "process" dies here
+    in_flight = [q.rid for q in fleet1.results()
+                 if q.state is not RequestState.COMPLETED]
+    assert in_flight, "the restart must have work to recover"
+    j2 = RequestJournal(path)          # a fresh process folds the disk
+    fleet2 = ServeFleet.recover(params, cfg, _serve(), 2, journal=j2,
+                                router_seed=0)
+    summary = fleet2.run()
+    fleet2.close()
+    assert summary["requests_failed"] == 0
+    done = {q.rid: q for q in fleet1.results()
+            if q.state is RequestState.COMPLETED}
+    for q in fleet2.results():
+        assert q.state is RequestState.COMPLETED
+        assert q.rid not in done, \
+            "recover() must never re-serve a terminaled rid"
+        done[q.rid] = q
+    assert set(done) == set(refs)
+    for rid, q in done.items():
+        assert q.generated == refs[rid], (
+            f"{rid} diverged across the restart")
+    st = j2.state()
+    assert not st.pending()
+    assert len(st.terminals) == len(PROMPTS)
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder integration
+# ---------------------------------------------------------------------------
+
+def test_postmortem_bundle_carries_journal_tail(tmp_path):
+    from distributed_model_parallel_tpu.utils import flightrec
+
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    j.intent(_Req("a"))
+    j.terminal("a", "completed")
+    journal_mod.install(j)
+    try:
+        bundle = flightrec.dump_postmortem(str(tmp_path / "pm"),
+                                           "drill", records=[])
+        with open(f"{bundle}/journal.json") as f:
+            payload = json.load(f)
+        assert payload["path"] == j.path
+        assert payload["position"]["records"] == 2
+        assert len(payload["tail"]) == 2
+        assert json.loads(payload["tail"][-1])["kind"] == "terminal"
+    finally:
+        journal_mod.install(None)
+    with open(f"{bundle}/manifest.json") as f:
+        assert "journal.json" in json.load(f)["files"]

@@ -122,6 +122,42 @@ def test_soak_failover_scenario(tmp_path):
 
 
 @pytest.mark.chaos
+def test_soak_crashrecovery_scenario(tmp_path):
+    """The ISSUE-18 acceptance drill at test scale: a hard replica
+    crash (no drain) and a full fleet restart (torn journal tail
+    included) must both recover every accepted request bitwise from the
+    write-ahead journal, with exactly one terminal per trace, zero
+    rtrace orphans (the crash hop linked via ``recovered``), a
+    replay-deterministic recovery schedule, serve-loop journal overhead
+    < 3% of engine iteration time, and a journal-off schedule digest
+    byte-identical to journal-on (zero behavior change)."""
+    from scripts.dmp_soak import run_crashrecovery_scenario
+
+    summary, ok = run_crashrecovery_scenario(
+        _fleet_args("crashrecovery"), str(tmp_path), 0)
+    assert ok, summary
+    assert summary["journal_transparent"] is True
+    assert summary["journal_overhead_fraction"] < 0.03
+    assert summary["crash_fired"] == 1
+    assert summary["crash_recovered"] >= 1
+    assert summary["crash_failed"] == 0
+    assert summary["crash_parity_bad"] == []
+    assert summary["crash_rtrace_orphans"] == []
+    assert summary["crash_recovered_hops"] >= 1
+    assert summary["crash_pending_after"] == []
+    assert summary["crash_terminals"] == summary["requests"]
+    assert summary["replay_deterministic"] is True
+    assert summary["restart_in_flight"] >= 1
+    assert summary["restart_torn_line_counted"] is True
+    assert summary["restart_failed"] == 0
+    assert summary["restart_parity_bad"] == []
+    assert summary["restart_rtrace_orphans"] == []
+    assert summary["restart_recovered_hops"] >= 1
+    assert summary["restart_pending_after"] == []
+    assert summary["restart_terminals"] == summary["requests"]
+
+
+@pytest.mark.chaos
 @pytest.mark.parametrize("scenario", ["failover", "flashcrowd", "flood",
                                       "diurnal"])
 def test_soak_scenarios_replay_deterministic(tmp_path, scenario):
