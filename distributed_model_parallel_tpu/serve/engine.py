@@ -50,6 +50,7 @@ from distributed_model_parallel_tpu.serve.scheduler import (
     summarize,
 )
 from distributed_model_parallel_tpu.utils import tracing
+from distributed_model_parallel_tpu.utils.metering import EngineMeter
 from distributed_model_parallel_tpu.utils.telemetry import registry
 from distributed_model_parallel_tpu.utils.tracing import span
 
@@ -137,7 +138,7 @@ class Engine:
     def __init__(self, params: dict, cfg: TransformerConfig,
                  serve: ServeConfig, *, telemetry=None, step_hook=None,
                  slo_metrics: bool = True, replica: str | None = None,
-                 clock=None, journal=None):
+                 clock=None, journal=None, meter: bool = True):
         if cfg.moe_experts:
             raise ValueError(
                 "MoE decode routing is batch-coupled (expert-capacity "
@@ -170,6 +171,12 @@ class Engine:
         # watermarks from the decode loop, exactly one terminal per
         # accepted request. None = journal off, zero behavior change.
         self.journal = journal
+        # Resource meter (utils/metering.py): per-request chip-second /
+        # page-second bills and the per-iteration utilization ledger.
+        # Pure observation — the soak drill gates a byte-identical
+        # schedule digest with metering on vs off, and metering overhead
+        # at < 2% of iteration time. meter=False turns the plane off.
+        self.meter = EngineMeter(replica=replica) if meter else None
         # Fleet membership (serve/fleet.py): the replica name tags this
         # engine's serve records and statusz provider so a multi-replica
         # stream stays attributable. None = standalone engine (PR 9
@@ -325,6 +332,11 @@ class Engine:
                                     if self.cache.prefix is not None
                                     else 0),
             "draft_accept_rate": self.draft_accept_rate,
+            # resource metering, live (utils/metering.py)
+            "utilization": (self.meter.utilization()
+                            if self.meter is not None else None),
+            "open_bills": (len(self.meter._bills)
+                           if self.meter is not None else None),
             "healthy": True,
         }
 
@@ -381,7 +393,8 @@ class Engine:
                arrival_s: float = 0.0, seed: int = 0,
                priority: str = "interactive",
                queue_budget_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> Request:
         prompt = [int(t) for t in prompt]
         if rid is None:
             rid = f"req-{self._auto_rid}"
@@ -390,7 +403,7 @@ class Engine:
                       max_new_tokens=int(max_new_tokens),
                       arrival_s=float(arrival_s), seed=int(seed),
                       priority=priority, queue_budget_s=queue_budget_s,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, tenant=tenant)
         # Stamp the request trace at entry into the serving tier: every
         # later rtrace record (admission, prefill, decode, terminal)
         # rides this identity. No stream, no stamp — rtrace then no-ops
@@ -464,6 +477,8 @@ class Engine:
             # journal drops unknown rids); a fleet-accepted one whose
             # re-dispatch bounced still owes its single terminal.
             self.journal.terminal(req.rid, "shed")
+        if self.meter is not None:
+            self.meter.terminal(req, "shed", self.telemetry)
         self._rtrace(req, "shed", reason=reason, state="queued")
         self._requests.append(req)
         self._rejected += 1
@@ -518,6 +533,12 @@ class Engine:
                               else "prefill"),
                 }
                 req.state = RequestState.QUEUED
+            if self.meter is not None:
+                # Residency ends here for this replica: a ``hop`` meter
+                # record bills it for exactly what it hosted (hop index
+                # = the residency being closed; the destination's next
+                # record carries migrations + 1, so the chain links).
+                self.meter.close_hop(req, self.telemetry)
             self.sched.withdraw(req)
             self._proposers.pop(req.rid, None)
             self._spec_streak.pop(req.rid, None)
@@ -660,10 +681,26 @@ class Engine:
         self._iterations += 1
         self._now = now
         w0 = time.monotonic()
+        progress = False
         try:
-            return self._iterate(now, t0)
+            progress = self._iterate(now, t0)
+            return progress
         finally:
-            self._iter_s.append(time.monotonic() - w0)
+            dt = time.monotonic() - w0
+            self._iter_s.append(dt)
+            if self.meter is not None:
+                # The SAME wall sample just appended to _iter_s — that
+                # identity is what makes the duty buckets partition the
+                # iteration wall exactly (dmp_capacity --gate). A raise
+                # out of _iterate ticks with progress=False; the dead
+                # engine's ledger still sums to its wall.
+                self.meter.tick(
+                    dt, progress=progress,
+                    brownout=(self.brownout is not None
+                              and self.brownout.level >= 1),
+                    has_work=(any(r is not None for r in self.sched.slots)
+                              or self.sched.arrived_backlog(now) > 0),
+                    cache=self.cache)
 
     def _iterate(self, now: float, t0: float) -> bool:
         progress = False
@@ -699,6 +736,11 @@ class Engine:
                               trace_fields=self._trace_fields)
         for req in self.sched.admit(now):
             self._tables_np[req.slot] = self.cache.table_array(req.rid)
+            if self.meter is not None:
+                # Residency starts here for cold, migrated-in and
+                # crash-replayed admissions alike — each replica bills
+                # only the residency it actually hosts.
+                self.meter.open_bill(req.rid)
             if req.resume is not None:
                 # A migrated-in request: its pages were imported by the
                 # scheduler; resume at the exact committed position —
@@ -791,9 +833,15 @@ class Engine:
         toks[0, :n_valid] = seq[lo:lo + n_valid]
         table = jnp.asarray(self._tables_np[req.slot])
         key = jax.random.key(req.seed)
+        m = self.meter
+        d0 = time.monotonic() if m is not None else 0.0
         self.cache.ck, self.cache.cv, tok = self._prefill(
             self.params, self.cache.ck, self.cache.cv, jnp.asarray(toks),
             jnp.int32(lo), jnp.int32(n_valid), table, key)
+        if m is not None:
+            # A prefill chunk owns the whole slice: its full dispatch
+            # wall bills to this one request (utils/metering.py).
+            m.bill_prefill(req.rid, time.monotonic() - d0)
         req.prefill_cursor = lo + n_valid
         if req.prefill_cursor < len(seq):
             self._rtrace(req, "prefill", cursor=req.prefill_cursor,
@@ -871,11 +919,18 @@ class Engine:
             seeds[s] = req.seed
         keys = (jax.vmap(jax.random.key)(jnp.asarray(seeds))
                 if self._sampled else None)
+        m = self.meter
+        d0 = time.monotonic() if m is not None else 0.0
         self.cache.ck, self.cache.cv, nxt = self._decode(
             self.params, self.cache.ck, self.cache.cv,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(self._tables_np), jnp.asarray(active), keys)
         nxt = np.asarray(jax.device_get(nxt))
+        if m is not None:
+            # The round's wall (dispatch + host sync) apportions evenly
+            # across the live decode slots it served.
+            m.bill_decode([r.rid for r in decoding],
+                          time.monotonic() - d0)
         self._decode_steps += 1
         self._decode_tokens += len(decoding)
         # Memory-pressure gauges ride every decode rtrace, computed once
@@ -965,12 +1020,19 @@ class Engine:
             seeds[s] = req.seed
         keys = (jax.vmap(jax.random.key)(jnp.asarray(seeds))
                 if self._sampled else None)
+        m = self.meter
+        d0 = time.monotonic() if m is not None else 0.0
         self.cache.ck, self.cache.cv, out = self._verify[width](
             self.params, self.cache.ck, self.cache.cv,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(n_valid), jnp.asarray(self._tables_np),
             jnp.asarray(active), keys)
         out = np.asarray(jax.device_get(out))
+        if m is not None:
+            # A verify round is one batched forward like plain decode —
+            # equal shares per live slot regardless of draft widths.
+            m.bill_decode([r.rid for r in decoding],
+                          time.monotonic() - d0)
         self._decode_steps += 1
         round_proposed = round_accepted = 0
         gauges = (memory_gauges(self.cache) if self.telemetry is not None
@@ -1067,6 +1129,13 @@ class Engine:
         self._proposers.pop(req.rid, None)
         self._spec_streak.pop(req.rid, None)
         self._spec_live.pop(req.rid, None)
+        if self.meter is not None:
+            # Close the bill BEFORE eviction drops the page table, so
+            # the meter record reflects the final page reservation.
+            self.meter.terminal(
+                req, "completed", self.telemetry,
+                good_tokens=(len(req.generated)
+                             if self._in_deadline(req) else 0))
         self.sched.evict(req)
         if self.brownout is not None:
             self.brownout.observe_completed(self._ttft(req), req.t_done)
@@ -1105,6 +1174,15 @@ class Engine:
         immediately (chunk-aligned mid-prefill aborts included: eviction
         frees the whole table). Terminal, counted, never silent."""
         state_at = req.state.value
+        if self.meter is not None:
+            # One terminal meter record whether the request was resident
+            # (deadline abort: its bill carries real cost) or still
+            # queued (zero bill) — matching the rtrace terminal below.
+            self.meter.terminal(
+                req,
+                "expired" if reason in ("total-deadline",
+                                        "queue-deadline") else "shed",
+                self.telemetry)
         if req.slot is not None:
             self.sched.evict(req)
         self._proposers.pop(req.rid, None)
@@ -1145,6 +1223,8 @@ class Engine:
         for req in self._requests:
             if req.done:
                 continue
+            if self.meter is not None:
+                self.meter.terminal(req, "failed", self.telemetry)
             if req.slot is not None:
                 self.sched.evict(req)
             elif any(q is req for q in self.sched.queue):
@@ -1294,7 +1374,16 @@ class Engine:
             # SimClock) — the denominator of the crashrecovery
             # scenario's journal-overhead gate (< 3% of p50).
             "iteration_s": summarize(self._iter_s),
+            # Resource-metering plane (utils/metering.py): duty-cycle
+            # ledger, per-tenant cost rollup, metering's own overhead.
+            "metering": (self.meter.summary()
+                         if self.meter is not None else None),
         }
         if record and self.telemetry is not None:
             self.telemetry.record("serve", event="summary", **out)
+            if self.meter is not None and self.replica is None:
+                # Standalone engines emit their own utilization record;
+                # fleet replicas' are emitted (with cell labels) by
+                # ServeFleet.summary so quarantine time is folded first.
+                self.meter.record_utilization(self.telemetry)
         return out

@@ -66,6 +66,10 @@ from distributed_model_parallel_tpu.serve.scheduler import (
 from distributed_model_parallel_tpu.utils import health as health_mod
 from distributed_model_parallel_tpu.utils import tracing
 from distributed_model_parallel_tpu.utils.faults import FaultInjector
+from distributed_model_parallel_tpu.utils.metering import (
+    LEDGER_BUCKETS,
+    emit_meter,
+)
 from distributed_model_parallel_tpu.utils.telemetry import registry
 
 __all__ = ["Replica", "ServeFleet"]
@@ -110,7 +114,7 @@ class ServeFleet:
                  faults=(), fault_replica: str | None = None,
                  cells=None, fault_cell: str | None = None,
                  cell_sick_threshold: float = 0.5, clock=None,
-                 journal=None):
+                 journal=None, meter: bool = True):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if not 0.0 < cell_sick_threshold <= 1.0:
@@ -139,6 +143,10 @@ class ServeFleet:
         self.revive_after = revive_after
         self.step_hook = step_hook
         self._slo_metrics = slo_metrics
+        # Resource metering (utils/metering.py): off switches the whole
+        # billing plane — engine meters AND the fleet's own zero-cost
+        # terminals — so the soak drill can A/B the schedule digest.
+        self._meter = meter
         # Pluggable clock (serve/traffic.SimClock for the deterministic
         # chaos scenarios; the real monotonic clock otherwise). Virtual
         # mode advances one fixed dt per fleet round and skips idle gaps
@@ -165,7 +173,7 @@ class ServeFleet:
             devs = pool.assign(f"serve-{name}", per)
             eng = Engine(params, cfg, serve, telemetry=telemetry,
                          slo_metrics=slo_metrics, replica=name,
-                         clock=clock, journal=journal)
+                         clock=clock, journal=journal, meter=meter)
             self.replicas.append(Replica(
                 name=name, engine=eng,
                 device_ids=tuple(d.id for d in devs)))
@@ -194,6 +202,11 @@ class ServeFleet:
                                      f"{missing}")
             for rep in self.replicas:
                 rep.cell = self.cells.cell_of(rep.name)
+        # Stamp each replica's meter with its cell so utilization
+        # records roll up per cell (utils/metering.py).
+        for rep in self.replicas:
+            if rep.engine.meter is not None:
+                rep.engine.meter.cell = rep.cell
         self.cell_sick_threshold = cell_sick_threshold
         self.router = Router(router_seed, affinity_slack=affinity_slack,
                              cells=self.cells)
@@ -258,6 +271,13 @@ class ServeFleet:
         self._requests: list[Request] = []
         self._ids: set[str] = set()
         self._shed_by_reason: dict[str, int] = {}
+        # Metering state the engines cannot see: per-tenant counts of
+        # queue-only sheds (the request never reached an engine meter),
+        # and the archived meters of hard-crashed engines — their
+        # closed per-tenant rollups and duty history must survive the
+        # engine object (crash_replica) or the cost table under-counts.
+        self._tenant_sheds: dict[str, int] = {}
+        self._dead_meters: list = []
         self._rejected = 0
         self._auto_rid = 0
         self._rounds = 0
@@ -309,6 +329,32 @@ class ServeFleet:
                 registry().gauge("serve_live_cells").set(
                     len(self._live_cells()))
 
+    def _meters(self, *, cell: str | None = None) -> list:
+        """Every meter in scope: the current engines' plus the archived
+        meters of hard-crashed predecessors (``crash_replica`` swaps the
+        engine object out, but its billed history must keep counting).
+        ``cell`` narrows to one cell's members."""
+        out = [r.engine.meter for r in self.replicas
+               if r.engine.meter is not None
+               and (cell is None or r.cell == cell)]
+        out += [m for m in self._dead_meters
+                if cell is None or m.cell == cell]
+        return out
+
+    @staticmethod
+    def _merged_utilization(meters) -> dict | None:
+        """Summed duty-cycle ledger across ``meters`` — the fleet and
+        per-cell rollups for /statusz and the summary. Buckets keep
+        partitioning wall exactly: sums of exact partitions."""
+        if not meters:
+            return None
+        out = {b: 0.0 for b in LEDGER_BUCKETS}
+        for m in meters:
+            for bucket, s in m.ledger.items():
+                out[bucket] += s
+        return {**{f"{b}_s": round(s, 6) for b, s in out.items()},
+                "wall_s": round(sum(out.values()), 6)}
+
     def _set_engine_gauges(self) -> None:
         """The fleet owns the process-global engine gauges: replica
         engines skip their own writes — N replicas flapping one
@@ -344,6 +390,20 @@ class ServeFleet:
             # like the occupancy max above.
             reg.gauge("serve_brownout_level").set(
                 max(r.engine.brownout.level for r in live))
+        # Fleet duty-cycle gauges (utils/metering.py): each bucket's
+        # fraction of the fleet's cumulative iteration wall, across ALL
+        # replicas — a quarantined replica's dead time is the point.
+        u = self._merged_utilization(self._meters())
+        if u is not None and u["wall_s"] > 0:
+            wall = u["wall_s"]
+            reg.gauge("serve_utilization_busy").set(u["busy_s"] / wall)
+            reg.gauge("serve_utilization_stalled").set(
+                u["stalled_s"] / wall)
+            reg.gauge("serve_utilization_brownout").set(
+                u["brownout_s"] / wall)
+            reg.gauge("serve_utilization_idle").set(u["idle_s"] / wall)
+            reg.gauge("serve_utilization_quarantined").set(
+                u["quarantined_s"] / wall)
 
     def _status(self) -> dict:
         """The fleet's /statusz provider: replica table + router state."""
@@ -381,6 +441,7 @@ class ServeFleet:
                                        else None),
                 } for r in self.replicas},
             "cells": self._cell_status(),
+            "utilization": self._merged_utilization(self._meters()),
             "healthy": bool(self._live()),
         }
 
@@ -404,6 +465,8 @@ class ServeFleet:
                 "assignments": sum(
                     self.router.assignments.get(r.name, 0)
                     for r in members),
+                "utilization": self._merged_utilization(
+                    self._meters(cell=c)),
                 **({"device_quarantined_fraction": round(
                         self.health.quarantined_fraction(devices), 3)}
                    if self.health is not None else {}),
@@ -444,7 +507,8 @@ class ServeFleet:
                arrival_s: float = 0.0, seed: int = 0,
                priority: str = "interactive",
                queue_budget_s: float | None = None,
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               tenant: str | None = None) -> Request:
         """Queue a request at fleet level; the router assigns it to a
         replica when it arrives (open loop), so placement sees the load
         at arrival time, not submission time. A full fleet queue
@@ -462,7 +526,7 @@ class ServeFleet:
                       max_new_tokens=int(max_new_tokens),
                       arrival_s=float(arrival_s), seed=int(seed),
                       priority=priority, queue_budget_s=queue_budget_s,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, tenant=tenant)
         # Geometry is fleet-uniform: any replica's cache speaks for all.
         ref = self.replicas[0].engine
         validate_request(req, ref.cache)
@@ -548,6 +612,17 @@ class ServeFleet:
                        **({"waited_s": round(waited_s, 4)}
                           if waited_s is not None else {}))
         self._shed_by_reason[reason] = self._shed_by_reason.get(reason, 0) + 1
+        # Exactly one terminal meter record per terminal trace: a
+        # queue-only request never reached an engine meter, so the
+        # fleet bills its zero-cost terminal here (utils/metering.py)
+        # and counts the shed against its tenant for the SLO rollup.
+        if self._meter:
+            emit_meter(self.telemetry, req,
+                       "expired" if reason in ("total-deadline",
+                                               "queue-deadline")
+                       else "shed", replica="fleet")
+        t = req.tenant or "-"
+        self._tenant_sheds[t] = self._tenant_sheds.get(t, 0) + 1
         if reason == "queue-full":
             self._rejected += 1
         if self._slo_metrics:
@@ -595,6 +670,7 @@ class ServeFleet:
                     # requests the replicas just absorbed must not count
                     # against the bound).
                     self._bound_pending(now)
+                    q0 = time.monotonic()
                     for rep in self.replicas:
                         if rep.state != LIVE:
                             continue
@@ -629,6 +705,17 @@ class ServeFleet:
                             # and a healthy replica gets quarantined.
                             self._observe(rep, time.monotonic() - w0)
                         progress = progress or stepped
+                    # Quarantined duty: while its peers stepped, a
+                    # quarantined replica's chips sat out the whole
+                    # round — that wall lands in its ledger's
+                    # ``quarantined`` bucket, same real-monotonic
+                    # clock as the live replicas' iteration samples
+                    # (utils/metering.py).
+                    qdt = time.monotonic() - q0
+                    for rep in self.replicas:
+                        if (rep.state == QUARANTINED
+                                and rep.engine.meter is not None):
+                            rep.engine.meter.add_quarantined(qdt)
                     self._set_engine_gauges()
                     self._apply_health()
                     self._maybe_revive()
@@ -914,6 +1001,14 @@ class ServeFleet:
         lost = [r for r in rep.engine._requests if not r.done]
         params, cfg = rep.engine.params, rep.engine.cfg
         rep.engine.kill(reason=reason)
+        if rep.engine.meter is not None:
+            # The dead engine's meter outlives it: closed per-tenant
+            # rollups and duty history keep counting in the fleet
+            # summary. Its OPEN bills die unbilled — the residents'
+            # chip time since their last terminal/hop is lost, which is
+            # the safe direction for the capacity gate (billed chip-
+            # seconds can only under-shoot wall × live replicas).
+            self._dead_meters.append(rep.engine.meter)
         # The crash: the old engine (scheduler, page pool, prefix tree)
         # is dropped on the floor — no drain, no clear_cache invariant
         # to satisfy, its pages die with it. A FRESH engine takes the
@@ -924,7 +1019,9 @@ class ServeFleet:
                             telemetry=self.telemetry,
                             slo_metrics=self._slo_metrics,
                             replica=rep.name, clock=self._engine_clock,
-                            journal=self.journal)
+                            journal=self.journal, meter=self._meter)
+        if rep.engine.meter is not None:
+            rep.engine.meter.cell = rep.cell
         rep.state = QUARANTINED
         rep.quarantined_round = self._rounds
         rep.kills += 1
@@ -984,6 +1081,9 @@ class ServeFleet:
                 self.journal.terminal(req.rid, "failed")
                 tracing.rtrace(req, "failed", sink=self.telemetry,
                                error="no-live-replica")
+                if self._meter:
+                    emit_meter(self.telemetry, req, "failed",
+                               replica="fleet")
                 if self._slo_metrics:
                     registry().counter("serve_requests_failed").inc()
                 if self.telemetry is not None:
@@ -1126,6 +1226,12 @@ class ServeFleet:
                 self.journal.terminal(req.rid, "failed")
             tracing.rtrace(req, "failed", sink=self.telemetry,
                            error="no-live-replica")
+            # The source engine's drain already closed its hop bill;
+            # this terminal is the zero-cost fleet-side record that
+            # pairs the rtrace terminal (utils/metering.py).
+            if self._meter:
+                emit_meter(self.telemetry, req, "failed",
+                           replica="fleet")
             if self._slo_metrics:
                 registry().counter("serve_requests_failed").inc()
             if self.telemetry is not None:
@@ -1229,7 +1335,8 @@ class ServeFleet:
                 seed=int(rec.get("seed", 0)),
                 priority=rec.get("priority", "interactive"),
                 queue_budget_s=rec.get("queue_budget_s"),
-                deadline_s=rec.get("deadline_s"))
+                deadline_s=rec.get("deadline_s"),
+                tenant=rec.get("tenant"))
             req.trace_id = rec.get("trace")
             req.generated = list(toks)
             req.replay = bool(toks)
@@ -1259,6 +1366,11 @@ class ServeFleet:
                 self.journal.terminal(req.rid, "failed")
             tracing.rtrace(req, "failed", sink=self.telemetry,
                            error="fleet-killed")
+            if self._meter:
+                emit_meter(self.telemetry, req, "failed",
+                           replica="fleet")
+            t = req.tenant or "-"
+            self._tenant_sheds[t] = self._tenant_sheds.get(t, 0) + 1
             if self._slo_metrics:
                 registry().counter("serve_requests_failed").inc()
             if self.telemetry is not None:
@@ -1352,7 +1464,72 @@ class ServeFleet:
             "ttft_s": summarize(ttft),
             "queue_wait_s": summarize(waits),
             "token_latency_s": summarize(token_lat),
+            "metering": self._metering_summary() if self._meter else None,
         }
         if record and self.telemetry is not None:
+            # Per-replica utilization records BEFORE the summary: the
+            # capacity observatory (serve/capacity.py) reads both, and
+            # crashed predecessors' duty history rides the same stream
+            # under the replica name it served as.
+            for m in self._meters():
+                m.record_utilization(self.telemetry)
             self.telemetry.record("serve", event="summary", **out)
         return out
+
+    def _metering_summary(self) -> dict | None:
+        """Fleet metering rollup (utils/metering.py): the per-tenant
+        cost + SLO-attainment table (every replica meter's closed bills
+        plus the fleet's queue-only sheds), per-replica duty-cycle
+        ledgers (a crashed predecessor's ledger folds into its replica
+        name), per-cell and fleet-wide utilization, and the metering
+        plane's own bookkeeping overhead — what ``dmp_capacity`` and
+        the ``== capacity ==`` report section render."""
+        meters = self._meters()
+        if not meters:
+            return None
+
+        def _blank() -> dict:
+            return {"requests": 0, "chip_s": 0.0, "page_s": 0.0,
+                    "resident_s": 0.0, "tokens": 0, "good_tokens": 0,
+                    "sheds": 0}
+
+        by_tenant: dict[str, dict] = {}
+        for m in meters:
+            for tenant, row in m.by_tenant.items():
+                agg = by_tenant.setdefault(tenant, _blank())
+                for k, v in row.items():
+                    agg[k] = agg.get(k, 0) + v
+        for tenant, n in self._tenant_sheds.items():
+            # Queue-only losses: no engine ever metered them, but the
+            # tenant offered the demand — they count as requests and
+            # sheds with zero chip time.
+            agg = by_tenant.setdefault(tenant, _blank())
+            agg["requests"] += n
+            agg["sheds"] += n
+        for agg in by_tenant.values():
+            for k in ("chip_s", "page_s", "resident_s"):
+                agg[k] = round(agg[k], 6)
+            agg["goodput_fraction"] = (
+                round(agg["good_tokens"] / agg["tokens"], 4)
+                if agg["tokens"] else None)
+        util: dict[str, dict] = {}
+        for m in meters:
+            name = m.replica or "-"
+            u = m.utilization()
+            if name in util:      # a crashed predecessor's ledger
+                prev = util[name]
+                for k, v in u.items():
+                    prev[k] = prev.get(k, 0) + v
+            else:
+                util[name] = u
+        return {
+            "by_tenant": dict(sorted(by_tenant.items())),
+            "utilization": util,
+            "fleet_utilization": self._merged_utilization(meters),
+            "cell_utilization": (
+                {c: self._merged_utilization(self._meters(cell=c))
+                 for c in self.cells.cells}
+                if self.cells is not None else None),
+            "chip_s": round(sum(m.chip_s_total() for m in meters), 6),
+            "meter_write_s": round(sum(m.write_s for m in meters), 6),
+        }

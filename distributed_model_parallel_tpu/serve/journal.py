@@ -100,7 +100,7 @@ _DURABLE_KINDS = frozenset({"intent"})
 # onto the reconstructed Request at recovery — the request identity,
 # not its runtime state.
 _INTENT_FIELDS = ("prompt", "seed", "max_new_tokens", "priority",
-                  "queue_budget_s", "deadline_s", "arrival_s")
+                  "queue_budget_s", "deadline_s", "arrival_s", "tenant")
 
 
 @dataclasses.dataclass
@@ -287,7 +287,7 @@ class RequestJournal:
         self._intents.add(req.rid)
         self._committed.setdefault(req.rid, 0)
         self.record("intent", rid=req.rid, trace=req.trace_id,
-                    **{f: getattr(req, f) for f in _INTENT_FIELDS})
+                    **{f: getattr(req, f, None) for f in _INTENT_FIELDS})
         return True
 
     def commit(self, rid: str, tokens) -> None:

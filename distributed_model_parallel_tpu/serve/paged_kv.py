@@ -316,6 +316,20 @@ class PagedKVCache:
             return 0
         return self.prefix.evictable_pages()
 
+    def page_share(self, sid) -> float:
+        """``sid``'s fractional page-pool reservation: one per exclusive
+        page, ``1/refcount`` per shared one — a page three holders share
+        costs each of them a third. The resource meter integrates this
+        over residency into page-seconds (utils/metering.py); pages held
+        only by the prefix tree belong to nobody and cost nobody. 0.0
+        for an unknown/evicted sid (the meter may tick between eviction
+        and bill close)."""
+        table = self._tables.get(sid)
+        if not table:
+            return 0.0
+        refcount = self.pool.refcount
+        return sum(1.0 / c for p in table if (c := refcount(p)) > 0)
+
     @property
     def shared_pages(self) -> int:
         return self.pool.shared_pages

@@ -129,6 +129,11 @@ class Request:                     # objects in slots/queues, not values
     # pages returned immediately.
     queue_budget_s: float | None = None
     deadline_s: float | None = None
+    # Billing identity (utils/metering.py): which tenant's cost bucket
+    # this request's chip-seconds and page-seconds land in. Rides the
+    # traffic programs' ``tenant`` field (serve/traffic.py); None bills
+    # to the "-" bucket.
+    tenant: str | None = None
 
     # -- runtime state (engine-owned) --
     state: RequestState = RequestState.QUEUED

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""dmp_capacity — fleet capacity observatory over metering streams.
+
+Reads the typed ``meter`` / ``utilization`` / ``rtrace`` / ``serve``
+records a metered serving run emits (utils/metering.py,
+serve/capacity.py) and renders:
+
+* the per-tenant cost table — chip-seconds, page-seconds, residency,
+  tokens, sheds and migration hops billed to each tenant;
+* the per-replica utilization timeline — each replica's duty cycle
+  (busy / stalled / brownout / idle / quarantined) as a bar, with its
+  observed, sustainable and headroom tokens/s;
+* ``--what-if N`` — project fleet capacity at replicas ± N, pricing
+  dispatch-launch overhead with the autotune cost model's ``alpha_s``;
+* ``--gate`` — enforce the billing invariants (exit non-zero on any):
+  duty buckets partition each replica's wall within 1%, billed
+  chip-seconds never exceed the fleet's iterated wall, and every
+  terminal rtrace pairs 1:1 with a terminal meter record.
+
+Usage:
+    python scripts/dmp_capacity.py /tmp/run/serve.jsonl
+    python scripts/dmp_capacity.py a.jsonl b.jsonl --what-if -2 --what-if 2
+    python scripts/dmp_capacity.py serve.jsonl --gate --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_model_parallel_tpu.serve.capacity import (  # noqa: E402
+    build_capacity,
+    check_invariants,
+    what_if,
+)
+from distributed_model_parallel_tpu.utils.metering import (  # noqa: E402
+    LEDGER_BUCKETS,
+)
+from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
+    read_records,
+)
+
+# One glyph per duty bucket, in LEDGER_BUCKETS order: busy, stalled,
+# brownout, idle, quarantined.
+_BAR_GLYPHS = {"busy": "#", "stalled": "~", "brownout": "!",
+               "idle": ".", "quarantined": "x"}
+
+
+def load_records(paths: list[str]) -> list[dict]:
+    records: list[dict] = []
+    for path in paths:
+        records.extend(read_records(path))
+    return records
+
+
+def duty_bar(duty: dict, width: int = 24) -> str:
+    """Fixed-width duty-cycle bar: each bucket's glyph run sized by its
+    fraction (largest-remainder rounding keeps the bar exactly
+    ``width`` wide)."""
+    cells = []
+    acc = 0
+    for i, b in enumerate(LEDGER_BUCKETS):
+        n = (width - acc if i == len(LEDGER_BUCKETS) - 1
+             else int(round(duty.get(b, 0.0) * width)))
+        n = max(0, min(n, width - acc))
+        cells.append(_BAR_GLYPHS[b] * n)
+        acc += n
+    return "".join(cells).ljust(width, _BAR_GLYPHS["idle"])[:width]
+
+
+def render(cap: dict, out) -> None:
+    print("== capacity ==", file=out)
+    print(f"wall: {cap['wall_s']:.3f}s  replicas: {cap['n_replicas']}"
+          + (f" (live {cap['live_replicas']})"
+             if cap.get("live_replicas") is not None else "")
+          + f"  tokens: {cap['tokens']}  observed: "
+            f"{cap['tokens_per_s']:.1f} tok/s  sustainable: "
+            f"{cap['sustainable_tokens_per_s']:.1f} tok/s  headroom: "
+            f"{cap['headroom_tokens_per_s']:.1f} tok/s"
+          + (f" ({cap['headroom_fraction']:.0%})"
+             if cap.get("headroom_fraction") is not None else ""),
+          file=out)
+    print(f"billed: chip {cap['billed_chip_s']:.4f}s  page "
+          f"{cap['billed_page_s']:.4f}s  meter records: "
+          f"{cap['meter_records']}  metering overhead: "
+          f"{cap['metering_overhead']['fraction']:.2%} of iteration "
+          f"wall", file=out)
+    if cap["tenants"]:
+        print("-- per-tenant cost --", file=out)
+        print(f"  {'tenant':<14} {'requests':>8} {'chip_s':>10} "
+              f"{'page_s':>10} {'tokens':>8} {'sheds':>6} {'hops':>5}",
+              file=out)
+        for name, row in cap["tenants"].items():
+            print(f"  {name:<14} {row['requests']:>8} "
+                  f"{row['chip_s']:>10.4f} {row['page_s']:>10.4f} "
+                  f"{row['tokens']:>8} {row['sheds']:>6} "
+                  f"{row['hops']:>5}", file=out)
+    if cap["replicas"]:
+        print("-- utilization timeline (#busy ~stalled !brownout "
+              ".idle xquarantined) --", file=out)
+        for name, row in cap["replicas"].items():
+            cell = f" cell={row['cell']}" if row.get("cell") else ""
+            print(f"  {name:<6} [{duty_bar(row['duty'])}] "
+                  f"busy={row['duty']['busy']:.0%}"
+                  f" obs={row['tokens_per_s']:.1f}"
+                  f" sust={row['sustainable_tokens_per_s']:.1f}"
+                  f" headroom={row['headroom_tokens_per_s']:.1f}"
+                  f" tok/s{cell}", file=out)
+
+
+def render_what_if(proj: dict, out) -> None:
+    sat = "  SATURATED" if proj["saturated"] else ""
+    print(f"what-if {proj['delta']:+d} -> {proj['replicas']} replicas: "
+          f"capacity {proj['capacity_tokens_per_s']:.1f} tok/s, "
+          f"offered {proj['offered_tokens_per_s']:.1f} tok/s"
+          + (f", projected utilization "
+             f"{proj['projected_utilization']:.0%}"
+             if proj.get("projected_utilization") is not None else "")
+          + f", headroom {proj['headroom_tokens_per_s']:.1f} tok/s"
+          + sat, file=out)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dmp_capacity",
+        description="Fleet capacity observatory over metering streams.")
+    p.add_argument("streams", nargs="+",
+                   help="telemetry stream path(s) (.jsonl; rotated "
+                        "parts fold in automatically)")
+    p.add_argument("--what-if", type=int, action="append", default=None,
+                   metavar="N", dest="what_if",
+                   help="project capacity at replicas +/- N "
+                        "(repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="emit JSON instead of text")
+    p.add_argument("--gate", action="store_true",
+                   help="exit non-zero when a billing invariant fails")
+    p.add_argument("--gate-tolerance", type=float, default=0.01,
+                   help="relative tolerance for the partition and "
+                        "chip-bound invariants (default: 0.01)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    records = load_records(args.streams)
+    cap = build_capacity(records)
+    out = sys.stdout
+
+    projections = [what_if(cap, d) for d in (args.what_if or ())]
+    failures: list[str] = []
+    rc = 0
+    if args.gate:
+        failures = check_invariants(records,
+                                    tolerance=args.gate_tolerance)
+        if not any(r.get("kind") == "meter" for r in records):
+            failures.append("no meter records found (metering off, or "
+                            "not a serving stream)")
+        rc = 1 if failures else 0
+
+    if args.json:
+        payload = {"capacity": cap}
+        if projections:
+            payload["what_if"] = projections
+        if args.gate:
+            payload["gate_failures"] = failures
+        json.dump(payload, out, default=str)
+        print(file=out)
+        return rc
+
+    render(cap, out)
+    for proj in projections:
+        render_what_if(proj, out)
+    if args.gate:
+        for f in failures:
+            print(f"GATE FAIL: {f}", file=out)
+        if not failures:
+            print(f"GATE OK: {cap['meter_records']} meter records "
+                  f"billed {cap['billed_chip_s']:.4f} chip-seconds "
+                  f"within the iterated wall; duty buckets partition "
+                  f"every replica's wall within "
+                  f"{args.gate_tolerance:.0%}", file=out)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -424,6 +424,19 @@ def _fleet_serving_section(lines: list[str], by_kind: dict) -> None:
                 + "  ".join(f"{c}[{len(m)}]"
                             for c, m in sorted(layout.items()))
                 + ")" + extra)
+        # Per-tenant SLO attainment from the fleet summary's metering
+        # rollup (utils/metering.py): goodput fraction = in-deadline
+        # tokens / tokens, next to the tenant's shed count.
+        mt = s.get("metering") or {}
+        for name, row in (mt.get("by_tenant") or {}).items():
+            gf = row.get("goodput_fraction")
+            lines.append(
+                f"  tenant {name:<12} {row.get('requests', 0):>4} req   "
+                f"goodput "
+                + (f"{gf:6.1%}" if isinstance(gf, (int, float))
+                   else "     -")
+                + f"   sheds {row.get('sheds', 0)}   chip "
+                  f"{row.get('chip_s', 0.0):.4f}s")
 
 
 def _rtrace_summary(by_kind: dict) -> dict | None:
@@ -473,6 +486,56 @@ def _rtrace_section(lines: list[str], by_kind: dict) -> None:
             f"{p}={v:.4f}s" for p, v in s["phase_seconds"].items()))
     lines.append("  (per-request waterfall: "
                  "python scripts/dmp_xray.py <stream> --worst 5)")
+
+
+def _capacity_data(records: list[dict], by_kind: dict) -> dict | None:
+    """Capacity observatory fold (serve/capacity.py over the ``meter``
+    and ``utilization`` records, utils/metering.py). None when the
+    stream carries no metering plane — training-only reports stay
+    terse."""
+    if not (by_kind.get("meter") or by_kind.get("utilization")):
+        return None
+    from distributed_model_parallel_tpu.serve.capacity import (
+        build_capacity,
+    )
+    return build_capacity(records)
+
+
+def _capacity_section(lines: list[str], records: list[dict],
+                      by_kind: dict) -> None:
+    """Fleet capacity rollup: billed cost per tenant, per-replica duty
+    cycles, and sustainable-throughput headroom. The zoomable version
+    (duty bars, what-if projections, billing-invariant gate) is
+    ``scripts/dmp_capacity.py``."""
+    cap = _capacity_data(records, by_kind)
+    if cap is None:
+        return
+    lines.append(f"== capacity ({cap['meter_records']} meter records) ==")
+    lines.append(
+        f"observed {cap['tokens_per_s']:.1f} tok/s   sustainable "
+        f"{cap['sustainable_tokens_per_s']:.1f} tok/s   headroom "
+        f"{cap['headroom_tokens_per_s']:.1f} tok/s"
+        + (f" ({cap['headroom_fraction']:.0%})"
+           if cap.get("headroom_fraction") is not None else "")
+        + f"   billed chip {cap['billed_chip_s']:.4f}s page "
+          f"{cap['billed_page_s']:.4f}s   metering overhead "
+          f"{cap['metering_overhead']['fraction']:.2%}")
+    for name, row in cap["replicas"].items():
+        duty = row["duty"]
+        lines.append(
+            f"  {name:<6} busy {duty['busy']:>4.0%}  stalled "
+            f"{duty['stalled']:>4.0%}  brownout {duty['brownout']:>4.0%}  "
+            f"idle {duty['idle']:>4.0%}  quarantined "
+            f"{duty['quarantined']:>4.0%}  sustainable "
+            f"{row['sustainable_tokens_per_s']:.1f} tok/s")
+    for name, row in cap["tenants"].items():
+        lines.append(
+            f"  tenant {name:<12} {row['requests']:>4} req   chip "
+            f"{row['chip_s']:.4f}s   page {row['page_s']:.4f}s   "
+            f"{row['tokens']} tokens   {row['sheds']} sheds   "
+            f"{row['hops']} hops")
+    lines.append("  (observatory: python scripts/dmp_capacity.py "
+                 "<stream> --what-if 2 --gate)")
 
 
 def _plan_section(lines: list[str], by_kind: dict) -> None:
@@ -753,6 +816,7 @@ def build_report(records: list[dict], *, trace_dir: str | None = None,
     _phase_section(lines, by_kind)
     _serving_section(lines, by_kind)
     _fleet_serving_section(lines, by_kind)
+    _capacity_section(lines, records, by_kind)
     _rtrace_section(lines, by_kind)
     _plan_section(lines, by_kind)
     _spans_section(lines, by_kind)
@@ -882,6 +946,7 @@ def build_report_data(records: list[dict]) -> dict:
         "resilience": resilience,
         "serving": serving,
         "rtrace": _rtrace_summary(by_kind),
+        "capacity": _capacity_data(records, by_kind),
         "gate": gate,
         "plan": by_kind.get("plan") or [],
         "spans": spans,

@@ -1049,7 +1049,12 @@ def run_fleet_scenario(args, workdir: str, seed: int,
        records, slow_cell fired, flood burst present, partition + heal
        records) — a drill whose fault never fired proves nothing;
     6. ``failover`` only: EXACT grow-back — every replica live again on
-       exactly its original device slice.
+       exactly its original device slice;
+    7. billing (serve/capacity.py): the chaos stream passes every
+       capacity-gate invariant (duty partition, chip bound, 1:1
+       terminal meter/rtrace pairing), metering serve-loop overhead
+       measures < 2% of iteration wall, and a metering-off rerun of the
+       clean trace yields a byte-identical schedule digest.
 
     The normalized event schedule (``_schedule_digest``) rides the
     summary: same seed => same digest, the replay-determinism property
@@ -1171,19 +1176,19 @@ def run_fleet_scenario(args, workdir: str, seed: int,
         raise RuntimeError(f"reference run failed requests: {bad_ref}")
     reference = {q.rid: list(q.generated) for q in ref_reqs}
 
-    def run_fleet(trace_, faults_, stream, label):
+    def run_fleet(trace_, faults_, stream, label, meter=True):
         tel = TelemetryRun(stream, run=label)
         fleet = ServeFleet(
             params, cfg, serve, n_replicas,
             pool=DevicePool([_FakeDev(i) for i in range(n_replicas)]),
             telemetry=tel, cells=n_cells, router_seed=seed,
             clock=SimClock(dt), faults=faults_,
-            revive_after=revive_after)
+            revive_after=revive_after, meter=meter)
         slices = {r.name: r.device_ids for r in fleet.replicas}
         for r in trace_:
             fleet.submit(r["prompt"], r["max_new"], rid=r["rid"],
                          arrival_s=r["arrival_s"], seed=r["seed"],
-                         priority=r["priority"])
+                         priority=r["priority"], tenant=r.get("tenant"))
         s = fleet.run(max_rounds=20000)
         tel.finish()
         fleet.close()
@@ -1205,6 +1210,18 @@ def run_fleet_scenario(args, workdir: str, seed: int,
                  if scenario == "flood" else None)
     clean_rate = goodput_rate(clean_fleet, clean_sum, band_rids)
 
+    # -- metering-off A/B (same methodology as the crashrecovery
+    # journal gate): the clean trace rerun with the billing plane OFF
+    # must produce a byte-identical normalized event schedule — the
+    # meter observes the serve loop, it must never steer it.
+    meteroff_stream = os.path.join(workdir, f"{scenario}_meteroff.jsonl")
+    run_fleet(clean_trace, (), meteroff_stream,
+              f"{scenario}-meteroff", meter=False)
+    clean_digest = _schedule_digest(read_records(clean_stream))
+    meteroff_digest = _schedule_digest(read_records(meteroff_stream))
+    metering_transparent = (clean_digest["sha256"]
+                            == meteroff_digest["sha256"])
+
     # -- chaos: the same traffic with the correlated fault armed
     stream = os.path.join(workdir, f"{scenario}.jsonl")
     fleet, chaos, slices = run_fleet(trace, faults, stream,
@@ -1212,6 +1229,22 @@ def run_fleet_scenario(args, workdir: str, seed: int,
     chaos_rate = goodput_rate(fleet, chaos, band_rids)
     recs = read_records(stream)
     print(build_report(recs))
+
+    # -- capacity gate (serve/capacity.py): the billing invariants over
+    # the chaos stream — duty buckets partition each replica's wall,
+    # billed chip-seconds fit inside the iterated wall, every terminal
+    # rtrace pairs 1:1 with a terminal meter record — plus the metering
+    # serve-loop overhead the acceptance pins at < 2%.
+    from distributed_model_parallel_tpu.serve.capacity import (
+        build_capacity,
+        check_invariants,
+    )
+
+    cap = build_capacity(recs)
+    billing_failures = check_invariants(recs)
+    if not any(r.get("kind") == "meter" for r in recs):
+        billing_failures.append("no meter records in chaos stream")
+    metering_overhead = cap["metering_overhead"]["fraction"]
 
     results = {q.rid: q for q in fleet.results()}
     # Gate 2: bitwise parity (brownout-clamped: the bitwise prefix).
@@ -1301,8 +1334,16 @@ def run_fleet_scenario(args, workdir: str, seed: int,
         "rtrace_timelines": len(traces),
         "rtrace_orphans": trace_orphans,
         "schedule_digest": _schedule_digest(recs),
+        "capacity": {k: cap[k] for k in (
+            "tokens_per_s", "sustainable_tokens_per_s",
+            "headroom_tokens_per_s", "headroom_fraction",
+            "billed_chip_s", "billed_page_s", "meter_records",
+            "tenants")},
+        "billing_invariant_failures": billing_failures,
+        "metering_overhead_fraction": round(metering_overhead, 5),
+        "metering_transparent": metering_transparent,
         "artifact": artifact,
-        "telemetry": [stream, clean_stream],
+        "telemetry": [stream, clean_stream, meteroff_stream],
     }
     ok = (not unaccounted
           and chaos["requests_failed"] == 0
@@ -1312,7 +1353,10 @@ def run_fleet_scenario(args, workdir: str, seed: int,
           and event_seen
           and (grow_back_exact is None or grow_back_exact)
           and goodput_fraction is not None
-          and goodput_fraction >= args.goodput_band)
+          and goodput_fraction >= args.goodput_band
+          and not billing_failures
+          and metering_overhead < 0.02
+          and metering_transparent)
     return out, ok
 
 
@@ -1444,7 +1488,7 @@ def run_crashrecovery_scenario(args, workdir: str,
         for r in trace:
             fleet.submit(r["prompt"], r["max_new"], rid=r["rid"],
                          arrival_s=r["arrival_s"], seed=r["seed"],
-                         priority=r["priority"])
+                         priority=r["priority"], tenant=r.get("tenant"))
         # Intent records are written inside submit() — admission-path
         # latency, not serve-loop overhead. Snapshot the split so the
         # overhead gate charges the serve loop only for what rides it

@@ -35,6 +35,9 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributed_model_parallel_tpu.utils.metering import (  # noqa: E402
+    LEDGER_BUCKETS,
+)
 from distributed_model_parallel_tpu.utils.telemetry import (  # noqa: E402
     RTRACE_TERMINAL_EVENTS,
     StreamFollower,
@@ -66,6 +69,14 @@ class FleetState:
         # how many requests have a trace open vs. terminally accounted.
         self.rtrace_open: set[str] = set()
         self.rtrace_terminals: dict[str, int] = {}
+        # Resource metering (utils/metering.py): live per-tenant cost
+        # fold off the typed ``meter`` records, the fleet duty-cycle
+        # fold off ``utilization`` records, and the last fleet
+        # summary's metering rollup (source of goodput fractions —
+        # meter records themselves carry cost, not SLO attainment).
+        self.meter_tenants: dict[str, dict] = {}
+        self.duty_s: dict[str, float] = {}
+        self.metering_summary: dict | None = None
         # Untenanted streams (a plain trainer run) attribute their
         # records to the last run_start's run name.
         self._default_run = ""
@@ -160,6 +171,27 @@ class FleetState:
                     self.rtrace_terminals.get(event, 0) + 1)
             else:
                 self.rtrace_open.add(trace)
+        elif kind == "meter":
+            row = self.meter_tenants.setdefault(
+                str(rec.get("tenant") or "-"),
+                {"requests": 0, "tokens": 0, "sheds": 0,
+                 "chip_s": 0.0, "hops": 0})
+            row["chip_s"] += rec.get("chip_s") or 0.0
+            event = str(rec.get("event"))
+            if event == "hop":
+                row["hops"] += 1
+            else:
+                row["requests"] += 1
+                row["tokens"] += rec.get("tokens") or 0
+                if event in ("shed", "expired"):
+                    row["sheds"] += 1
+        elif kind == "utilization":
+            for b in LEDGER_BUCKETS:
+                self.duty_s[b] = (self.duty_s.get(b, 0.0)
+                                  + (rec.get(f"{b}_s") or 0.0))
+        elif (kind == "serve" and rec.get("event") == "summary"
+                and rec.get("metering")):
+            self.metering_summary = rec["metering"]
 
     def _refresh_mfu(self, t: dict) -> None:
         """MFU from stream data alone: FLOPs/step / n_devices /
@@ -245,6 +277,21 @@ class FleetState:
                               sorted(self.rtrace_terminals.items())) or "-")
             lines.append(f"traces  open={len(self.rtrace_open)}  "
                          f"terminal={terms}")
+        if any(self.duty_s.values()):
+            wall = sum(self.duty_s.values())
+            lines.append("utilization  " + "  ".join(
+                f"{b}={self.duty_s.get(b, 0.0) / wall:.0%}"
+                for b in LEDGER_BUCKETS) + f"  wall={wall:.1f}s")
+        summary_tenants = ((self.metering_summary or {}).get("by_tenant")
+                           or {})
+        for name, row in sorted(self.meter_tenants.items()):
+            gf = (summary_tenants.get(name) or {}).get("goodput_fraction")
+            lines.append(
+                f"tenant {name[:12]:<13} req={row['requests']}"
+                f"  chip={row['chip_s']:.3f}s  tokens={row['tokens']}"
+                f"  goodput="
+                + (f"{gf:.0%}" if isinstance(gf, (int, float)) else "-")
+                + f"  sheds={row['sheds']}  hops={row['hops']}")
         if self.statusz is not None:
             if "error" in self.statusz:
                 lines.append(f"statusz: {self.statusz['error']}")

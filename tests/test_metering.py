@@ -1,0 +1,334 @@
+"""Resource metering & capacity observatory (utils/metering.py,
+serve/capacity.py, scripts/dmp_capacity.py).
+
+The load-bearing properties (docs/OBSERVABILITY.md "Cost & capacity"):
+
+* every terminal rtrace pairs 1:1 with exactly one terminal ``meter``
+  record carrying the request's chip-seconds and page-seconds;
+* the per-replica utilization ledger partitions iteration wall exactly
+  across busy / stalled / brownout / idle / quarantined;
+* a migrated request's residencies bill separately — a ``hop`` meter
+  record closes the source replica's bill, the destination opens its
+  own, and no interval is billed twice;
+* a crash-replayed request (write-ahead journal, serve/journal.py)
+  bills only its post-recovery residency on the peer — the crashed
+  engine's open bills die unbilled (under-billing is the safe
+  direction);
+* ``check_invariants`` — the ``dmp_capacity --gate`` core — passes on
+  real streams and catches each violation class on synthetic ones.
+"""
+
+import jax
+import pytest
+
+from distributed_model_parallel_tpu.models import transformer as tfm
+from distributed_model_parallel_tpu.serve import (
+    Engine,
+    ServeConfig,
+    ServeFleet,
+)
+from distributed_model_parallel_tpu.serve.capacity import (
+    build_capacity,
+    check_invariants,
+)
+from distributed_model_parallel_tpu.serve.journal import RequestJournal
+from distributed_model_parallel_tpu.serve.scheduler import RequestState
+from distributed_model_parallel_tpu.utils.metering import LEDGER_BUCKETS
+from distributed_model_parallel_tpu.utils.telemetry import (
+    TelemetryRun,
+    read_records,
+)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq_len=128,
+                                pos_embedding="rope")
+    return cfg, tfm.init_params(jax.random.key(0), cfg)
+
+
+def _serve(**kw):
+    base = dict(n_slots=2, page_size=8, n_pages=32, max_seq_len=64,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [10, 11, 12, 13, 14, 15, 16],
+           [3, 3, 3]]
+GENS = [12, 18, 7, 10]
+
+
+def _meter_records(recs, event=None):
+    out = [r for r in recs if r.get("kind") == "meter"]
+    if event is not None:
+        out = [r for r in out if r.get("event") == event]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# single engine: one terminal bill per request, ledger partitions wall
+# ---------------------------------------------------------------------------
+
+def test_engine_bills_every_request_exactly_once(model, tmp_path):
+    cfg, params = model
+    stream = str(tmp_path / "meter.jsonl")
+    tel = TelemetryRun(stream, run="meter")
+    eng = Engine(params, cfg, _serve(), telemetry=tel)
+    reqs = [eng.submit(p, g, seed=i, rid=f"req-{i}", tenant="team-a")
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    eng.run()
+    tel.finish()
+    assert all(r.state is RequestState.COMPLETED for r in reqs)
+    recs = read_records(stream)
+    terminals = _meter_records(recs, "completed")
+    assert sorted(t["request"] for t in terminals) == \
+        sorted(r.rid for r in reqs)
+    for t in terminals:
+        assert t["tenant"] == "team-a"
+        assert t["chip_s"] > 0, "a completed request must cost chip time"
+        assert t["page_s"] > 0, "residency must integrate page-seconds"
+        assert t["resident_s"] > 0
+        assert t["prefill_chunks"] >= 1
+        assert t["decode_rounds"] >= 1
+        assert t["trace"], "meter records ride the rtrace id"
+    assert check_invariants(recs) == [], check_invariants(recs)
+    # Tenant rollup with SLO attainment (no deadlines: all tokens good).
+    row = eng.meter.by_tenant["team-a"]
+    assert row["requests"] == len(reqs)
+    assert row["tokens"] == sum(len(r.generated) for r in reqs)
+    assert row["good_tokens"] == row["tokens"]
+    assert row["sheds"] == 0
+
+
+def test_ledger_partitions_iteration_wall(model):
+    cfg, params = model
+    eng = Engine(params, cfg, _serve())
+    # A late arrival forces idle iterations before the busy ones.
+    reqs = [eng.submit(p, g, seed=i, arrival_s=0.05)
+            for i, (p, g) in enumerate(zip(PROMPTS[:2], GENS[:2]))]
+    eng.run()
+    assert all(r.state is RequestState.COMPLETED for r in reqs)
+    m = eng.meter
+    u = m.utilization()
+    assert u["iterations"] == m.iterations > 0
+    # The buckets partition wall exactly (same dt sample feeds both).
+    assert abs(sum(u[f"{b}_s"] for b in LEDGER_BUCKETS)
+               - u["wall_s"]) < 1e-9
+    assert u["busy_s"] > 0
+    assert u["idle_s"] > 0, "the pre-arrival lull must classify idle"
+    assert u["quarantined_s"] == 0
+    # Billed chip time is dispatch wall — a strict subset of busy wall.
+    assert 0 < m.chip_s_total() <= u["busy_s"]
+
+
+def test_shed_request_gets_zero_cost_terminal(model, tmp_path):
+    """A queue-shed request never reached residency: its meter terminal
+    exists (the gate's 1:1 pairing) but bills nothing."""
+    cfg, params = model
+    stream = str(tmp_path / "shed.jsonl")
+    tel = TelemetryRun(stream, run="shed")
+    # One slot, queue of one: the third concurrent request is rejected.
+    eng = Engine(params, cfg, _serve(n_slots=1, max_queue=1),
+                 telemetry=tel)
+    for i, (p, g) in enumerate(zip(PROMPTS, GENS)):
+        eng.submit(p, g, seed=i, rid=f"req-{i}", tenant="bursty")
+    eng.run()
+    tel.finish()
+    recs = read_records(stream)
+    sheds = _meter_records(recs, "shed")
+    assert sheds, "the over-queue submissions must shed"
+    for s in sheds:
+        assert s["chip_s"] == 0 and s["page_s"] == 0
+    assert check_invariants(recs) == [], check_invariants(recs)
+    assert eng.meter.by_tenant["bursty"]["sheds"] == len(sheds)
+
+
+# ---------------------------------------------------------------------------
+# chaos: billing under migration and crash-replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_migration_bills_each_replica_its_own_residency(model, tmp_path):
+    """Kill r0 mid-stream (drain path): every migrated request closes a
+    hop-linked bill on r0 and opens a fresh one on r1 — two meter
+    records per migrated request, residency indices chained, chip time
+    billed once per interval."""
+    cfg, params = model
+    stream = str(tmp_path / "mig.jsonl")
+    tel = TelemetryRun(stream, run="mig")
+    fleet = ServeFleet(params, cfg, _serve(), 2, telemetry=tel,
+                       router_seed=0, revive_after=3)
+    migrated = {}
+    fleet.step_hook = (lambda rnd: migrated.setdefault(
+        "n", fleet.kill_replica("r0")) if rnd == 4 else None)
+    reqs = [fleet.submit(p, g, seed=i, rid=f"req-{i}", tenant="t0")
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    summary = fleet.run()
+    tel.finish()
+    fleet.close()
+    assert migrated["n"] > 0, "the kill must catch live requests"
+    assert all(r.state is RequestState.COMPLETED for r in reqs)
+    recs = read_records(stream)
+    mig_rids = {r["request"] for r in recs
+                if r.get("kind") == "migration"}
+    assert len(mig_rids) == migrated["n"]
+    for rid in mig_rids:
+        mine = [r for r in _meter_records(recs)
+                if r["request"] == rid]
+        hops = [r for r in mine if r["event"] == "hop"]
+        terms = [r for r in mine if r["event"] == "completed"]
+        assert len(hops) == 1 and len(terms) == 1
+        # Residency chain: hop i on the source, terminal at hop i+1 on
+        # the destination — each replica billed only its own interval.
+        assert hops[0]["replica"] == "r0"
+        assert terms[0]["replica"] == "r1"
+        assert terms[0]["hop"] == hops[0]["hop"] + 1
+        assert hops[0]["resident_s"] >= 0
+        assert terms[0]["chip_s"] >= 0
+    # Unmigrated requests: exactly one terminal, zero hop records.
+    for rid in {r.rid for r in reqs} - mig_rids:
+        mine = [r for r in _meter_records(recs)
+                if r["request"] == rid]
+        assert [r["event"] for r in mine] == ["completed"]
+    assert check_invariants(recs) == [], check_invariants(recs)
+    # The fleet summary's tenant rollup sees one row, full goodput.
+    row = summary["metering"]["by_tenant"]["t0"]
+    assert row["requests"] == len(reqs)
+    assert row["goodput_fraction"] == 1.0
+
+
+@pytest.mark.chaos
+def test_crash_replay_bills_only_post_recovery_residency(model,
+                                                         tmp_path):
+    """Hard-crash r0 (no drain) with a write-ahead journal installed:
+    the crashed engine's open bills die unbilled, and each replayed
+    request's single terminal meter record bills the peer's residency
+    only — no hop record, no double-billing, invariants green."""
+    cfg, params = model
+    stream = str(tmp_path / "crash.jsonl")
+    tel = TelemetryRun(stream, run="crash")
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    fleet = ServeFleet(params, cfg, _serve(), 2, telemetry=tel,
+                       router_seed=0, revive_after=3, journal=j)
+    recovered = {}
+    fleet.step_hook = (lambda rnd: recovered.setdefault(
+        "n", fleet.crash_replica("r0")) if rnd == 4 else None)
+    reqs = [fleet.submit(p, g, seed=i, rid=f"req-{i}", tenant="t0")
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    fleet.run()
+    tel.finish()
+    fleet.close()
+    assert recovered["n"] > 0, "the crash must catch live requests"
+    assert all(r.state is RequestState.COMPLETED for r in reqs)
+    recs = read_records(stream)
+    replayed = {r["request"] for r in recs if r.get("kind") == "rtrace"
+                and r.get("event") == "recovered"}
+    assert len(replayed) == recovered["n"]
+    for rid in replayed:
+        mine = [r for r in _meter_records(recs) if r["request"] == rid]
+        # The r0 residency died unbilled with the engine: one terminal,
+        # billed by the peer, and never a drain-style hop record.
+        assert [r["event"] for r in mine] == ["completed"]
+        assert mine[0]["replica"] == "r1"
+    assert check_invariants(recs) == [], check_invariants(recs)
+    # The journal round-trips the billing identity.
+    assert all(i.get("tenant") == "t0"
+               for i in j.state().intents.values())
+
+
+# ---------------------------------------------------------------------------
+# the capacity gate: catches each violation class
+# ---------------------------------------------------------------------------
+
+def _clean_records():
+    return [
+        {"kind": "rtrace", "trace": "t1", "request": "a",
+         "event": "admitted"},
+        {"kind": "rtrace", "trace": "t1", "request": "a",
+         "event": "completed"},
+        {"kind": "meter", "trace": "t1", "request": "a", "tenant": "x",
+         "replica": "r0", "event": "completed", "hop": 0,
+         "chip_s": 0.5, "page_s": 1.0, "resident_s": 1.0, "tokens": 8},
+        {"kind": "utilization", "replica": "r0", "busy_s": 0.6,
+         "stalled_s": 0.1, "brownout_s": 0.0, "idle_s": 0.3,
+         "quarantined_s": 0.0, "wall_s": 1.0, "iterations": 10},
+    ]
+
+
+def test_gate_passes_clean_synthetic_stream():
+    assert check_invariants(_clean_records()) == []
+
+
+def test_gate_catches_duty_partition_violation():
+    recs = _clean_records()
+    recs[-1]["idle_s"] = 0.9           # buckets now exceed wall
+    assert any("partition" in f for f in check_invariants(recs))
+
+
+def test_gate_catches_overbilled_chip_seconds():
+    recs = _clean_records()
+    recs[2]["chip_s"] = 5.0            # > the fleet's iterated wall
+    assert any("chip" in f for f in check_invariants(recs))
+
+
+def test_gate_catches_unmetered_terminal():
+    recs = [r for r in _clean_records() if r["kind"] != "meter"]
+    assert any("meter" in f for f in check_invariants(recs))
+
+
+def test_gate_catches_double_billed_terminal():
+    recs = _clean_records()
+    recs.append(dict(recs[2]))         # second terminal for the trace
+    assert any("t1" in f for f in check_invariants(recs))
+
+
+def test_capacity_report_folds_stream(model, tmp_path):
+    """build_capacity over a real stream: headroom + overhead shapes
+    the CLI and dmp_report both consume."""
+    cfg, params = model
+    stream = str(tmp_path / "cap.jsonl")
+    tel = TelemetryRun(stream, run="cap")
+    fleet = ServeFleet(params, cfg, _serve(), 2, telemetry=tel,
+                       router_seed=0)
+    for i, (p, g) in enumerate(zip(PROMPTS, GENS)):
+        fleet.submit(p, g, seed=i, rid=f"req-{i}",
+                     tenant="a" if i % 2 else "b")
+    fleet.run()                        # records the summary itself
+    tel.finish()
+    fleet.close()
+    cap = build_capacity(read_records(stream))
+    assert cap["meter_records"] == len(PROMPTS)
+    assert set(cap["tenants"]) == {"a", "b"}
+    assert cap["tokens"] == sum(GENS)
+    assert cap["billed_chip_s"] > 0
+    assert set(cap["replicas"]) == {"r0", "r1"}
+    for row in cap["replicas"].values():
+        duty = row["duty"]
+        assert abs(sum(duty.values()) - 1.0) < 1e-3
+    assert cap["sustainable_tokens_per_s"] >= cap["tokens_per_s"] > 0
+    assert 0 <= cap["metering_overhead"]["fraction"] < 0.05
+
+
+def test_metering_off_engine_emits_nothing(model, tmp_path):
+    """meter=False switches the whole billing plane off: no meter or
+    utilization records, no EngineMeter on the engine."""
+    cfg, params = model
+    stream = str(tmp_path / "off.jsonl")
+    tel = TelemetryRun(stream, run="off")
+    fleet = ServeFleet(params, cfg, _serve(), 2, telemetry=tel,
+                       router_seed=0, meter=False)
+    reqs = [fleet.submit(p, g, seed=i)
+            for i, (p, g) in enumerate(zip(PROMPTS, GENS))]
+    summary = fleet.run()
+    tel.finish()
+    fleet.close()
+    assert all(r.state is RequestState.COMPLETED for r in reqs)
+    assert summary["metering"] is None
+    recs = read_records(stream)
+    assert _meter_records(recs) == []
+    assert [r for r in recs if r.get("kind") == "utilization"] == []
+    assert all(rep.engine.meter is None for rep in fleet.replicas)

@@ -61,9 +61,12 @@ def stream(tmp_path_factory):
 def test_report_json_section_keys_are_stable(stream):
     report = _load("dmp_report")
     data = report.build_report_data(telemetry.read_records(stream))
-    assert {"run", "headline", "resilience", "serving", "gate", "plan",
-            "spans", "alerts", "counters", "epochs",
+    assert {"run", "headline", "resilience", "serving", "capacity",
+            "gate", "plan", "spans", "alerts", "counters", "epochs",
             "wall_s"} <= set(data)
+    # No meter/utilization records in this stream: the capacity
+    # observatory stays out of the way.
+    assert data["capacity"] is None
 
 
 def test_headline_section_schema(stream):
@@ -116,6 +119,42 @@ def test_gate_section_schema(stream):
     assert g["ok"] is False
     assert g["regressions"][0]["metric"] == "x:throughput"
     assert g["no_baseline"] == ["k2"]
+
+
+def test_capacity_section_schema(tmp_path):
+    """A metered stream grows the shape-pinned ``capacity`` key
+    (serve/capacity.build_capacity — additive changes only)."""
+    report = _load("dmp_report")
+    path = str(tmp_path / "cap.jsonl")
+    run = telemetry.TelemetryRun(path, run="cap", track_compiles=False,
+                                 device={"platform": "cpu"})
+    run.record("rtrace", trace="t1", request="a", event="completed")
+    run.record("meter", trace="t1", request="a", tenant="web",
+               replica="r0", event="completed", hop=0, chip_s=0.5,
+               page_s=1.0, resident_s=1.0, prefill_chunks=1,
+               decode_rounds=8, tokens=8)
+    run.record("utilization", replica="r0", busy_s=0.6, stalled_s=0.1,
+               brownout_s=0.0, idle_s=0.3, quarantined_s=0.0,
+               wall_s=1.0, iterations=10, meter_write_s=0.001)
+    run.record("serve", event="summary", policy="fleet", wall_s=1.0,
+               n_replicas=1, tokens_generated=8)
+    run.finish()
+    data = report.build_report_data(telemetry.read_records(path))
+    cap = data["capacity"]
+    assert {"wall_s", "n_replicas", "tokens", "tokens_per_s",
+            "billed_chip_s", "billed_page_s", "meter_records",
+            "tenants", "replicas", "sustainable_tokens_per_s",
+            "headroom_tokens_per_s", "headroom_fraction",
+            "metering_overhead"} <= set(cap)
+    assert cap["meter_records"] == 1
+    assert cap["tenants"]["web"]["chip_s"] == 0.5
+    assert cap["tenants"]["web"]["requests"] == 1
+    r0 = cap["replicas"]["r0"]
+    assert r0["duty"]["busy"] == 0.6
+    assert {"meter_write_s", "iteration_wall_s",
+            "fraction"} == set(cap["metering_overhead"])
+    # 8 tok/s observed at 60% busy duty -> ~13.3 tok/s sustainable.
+    assert cap["sustainable_tokens_per_s"] > cap["tokens_per_s"] == 8.0
 
 
 def test_gate_none_when_no_gate_records(tmp_path):

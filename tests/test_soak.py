@@ -119,6 +119,16 @@ def test_soak_failover_scenario(tmp_path):
     assert summary["goodput_fraction"] >= 0.8
     assert summary["rtrace_timelines"] == summary["requests"]
     assert len(summary["cells"]) == 4
+    # ISSUE-19 metering gates (same methodology as the PR-18 journal
+    # gates): billing invariants hold on the chaos stream, metering
+    # serve-loop overhead < 2% of iteration wall, and a metering-off
+    # rerun produces a byte-identical schedule digest.
+    assert summary["billing_invariant_failures"] == []
+    assert summary["metering_overhead_fraction"] < 0.02
+    assert summary["metering_transparent"] is True
+    assert summary["capacity"]["meter_records"] > 0
+    assert set(summary["capacity"]["tenants"]) == {"web", "mobile",
+                                                   "etl"}
 
 
 @pytest.mark.chaos
